@@ -1,0 +1,46 @@
+"""specpride_tpu: a TPU-native framework for merging clustered MS/MS spectra.
+
+Re-designed from scratch with the capabilities of the specpride reference
+(EuBIC 2020 "methods to merge spectra" hackathon): given MS/MS spectra grouped
+into clusters, produce one representative spectrum per cluster by
+
+* consensus by m/z-grid binning        (ref: src/binning.py:170-231)
+* consensus by gap-clustering average  (ref: src/average_spectrum_clustering.py:26-103)
+* best-PSM-score member selection      (ref: src/best_spectrum.py:67-100)
+* medoid under binned-dot-product      (ref: src/most_similar_representative.py:60-111)
+
+plus clustered-MGF format conversion, quality metrics (binned cosine,
+b/y-ion fraction) and mirror plotting.
+
+Architecture (TPU-first, not a port):
+
+* ``specpride_tpu.data``     ragged peak model + bucketed padded device batches
+* ``specpride_tpu.io``       host-side MGF / mzML / TSV ingest (C++ fast path)
+* ``specpride_tpu.ops``      JAX/XLA + Pallas device kernels (the compute core)
+* ``specpride_tpu.backends`` numpy oracle and tpu execution backends
+* ``specpride_tpu.methods``  the four merge strategies as a uniform API
+* ``specpride_tpu.parallel`` device mesh / sharding / multi-host scale-out
+* ``specpride_tpu.metrics``  quality metrics on device
+"""
+
+__version__ = "0.1.0"
+
+from specpride_tpu.config import (
+    BinMeanConfig,
+    GapAverageConfig,
+    MedoidConfig,
+    BestSpectrumConfig,
+    CosineConfig,
+)
+from specpride_tpu.data.peaks import Spectrum, Cluster
+
+__all__ = [
+    "BinMeanConfig",
+    "GapAverageConfig",
+    "MedoidConfig",
+    "BestSpectrumConfig",
+    "CosineConfig",
+    "Spectrum",
+    "Cluster",
+    "__version__",
+]

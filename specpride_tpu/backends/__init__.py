@@ -1,0 +1,10 @@
+"""Execution backends.
+
+``numpy_backend`` is the behavioural oracle: an exact (and documented-where-
+divergent) reimplementation of the reference algorithms on the host.  It is
+the ground truth for parity tests and the ``--backend=numpy`` CLI path.
+
+``tpu_backend`` is the production path: bucketed cluster batches executed by
+the JAX/XLA (and Pallas) kernels in ``specpride_tpu.ops``, vmapped over the
+cluster axis and shardable over a device mesh.
+"""

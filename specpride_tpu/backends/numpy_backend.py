@@ -1,0 +1,432 @@
+"""NumPy oracle implementations of every merge strategy and metric.
+
+Each function is a from-scratch behavioural reimplementation of a reference
+algorithm, cited per function.  Divergences from the reference are limited to
+(a) crash bugs we refuse to reproduce and (b) explicitly flagged config
+switches; each is called out in the docstring of the function concerned.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from specpride_tpu.config import (
+    BestSpectrumConfig,
+    BinMeanConfig,
+    CosineConfig,
+    GapAverageConfig,
+    MedoidConfig,
+)
+from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.ops.fragments import PROTON_MASS
+
+
+# ---------------------------------------------------------------------------
+# C1: binned-mean consensus (ref src/binning.py:170-231 combine_bin_mean)
+# ---------------------------------------------------------------------------
+
+def bin_mean_consensus(
+    members: list[Spectrum],
+    config: BinMeanConfig = BinMeanConfig(),
+    cluster_id: str = "",
+) -> Spectrum:
+    """Grid-bin all member peaks and take per-bin means.
+
+    Semantics reproduced from ref src/binning.py:170-231:
+
+    * bin index ``int((mz - min) / binsize)`` over [min_mz, max_mz)
+    * quorum ``int(n_members * 0.25) + 1`` — a bin kept only if at least that
+      many members contributed a peak
+    * numpy fancy-index ``+=`` buffering: when one member has several peaks
+      in the same bin, only the LAST such peak contributes (and the member is
+      counted once) — ref src/binning.py:197-199.  Reproduced here by the
+      same numpy construct.
+    * per-bin mean m/z and mean intensity, means over contributing members
+    * precursor m/z = mean over members; all charges must be equal
+      (ref src/binning.py:206 assert → here a ValueError)
+    """
+    n_bins = config.n_bins
+    counts = np.zeros(n_bins, dtype=np.int32)
+    inten_sum = np.zeros(n_bins, dtype=np.float32)
+    mz_sum = np.zeros(n_bins, dtype=np.float32)
+
+    charges = [s.precursor_charge for s in members]
+    if any(z != charges[0] for z in charges):
+        raise ValueError("Not all precursor charges in cluster are equal")
+
+    for s in members:
+        keep = (s.mz >= config.min_mz) & (s.mz < config.max_mz)
+        mz = s.mz[keep]
+        inten = s.intensity[keep]
+        bins = ((mz - config.min_mz) / config.bin_size).astype(int)
+        # numpy buffered fancy-index += : duplicate bins within this member
+        # collapse to the last occurrence (ref src/binning.py:197-199)
+        counts[bins] += 1
+        inten_sum[bins] += inten.astype(np.float32)
+        mz_sum[bins] += mz.astype(np.float32)
+
+    quorum = 1
+    if config.apply_peak_quorum:
+        quorum = int(len(members) * config.quorum_fraction) + 1
+
+    with np.errstate(invalid="ignore", divide="ignore"):
+        inten_mean = np.where(counts < quorum, np.nan, inten_sum)
+        inten_mean = inten_mean / counts
+        mz_mean = np.where(mz_sum == 0, np.nan, mz_sum) / counts
+
+    keep_mask = ~np.isnan(inten_mean)
+    return Spectrum(
+        mz=mz_mean[keep_mask].astype(np.float64),
+        intensity=inten_mean[keep_mask].astype(np.float64),
+        precursor_mz=float(np.mean([s.precursor_mz for s in members])),
+        precursor_charge=charges[0] if charges else 0,
+        title=cluster_id,
+    )
+
+
+# ---------------------------------------------------------------------------
+# C2: gap-clustered average consensus
+# (ref src/average_spectrum_clustering.py:26-103 average_spectrum)
+# ---------------------------------------------------------------------------
+
+def gap_average_consensus(
+    members: list[Spectrum],
+    config: GapAverageConfig = GapAverageConfig(),
+    cluster_id: str = "",
+    precursor_mz: float = 0.0,
+    precursor_charge: int = 0,
+    rt: float = 0.0,
+) -> Spectrum:
+    """Sort-concatenated peaks, split at m/z gaps >= mz_accuracy, average
+    each group, keep groups spanning >= min_fraction of members, then apply
+    the dynamic-range floor (max / dyn_range).
+
+    Group semantics reproduced from ref src/average_spectrum_clustering.py:
+    group mean m/z = group_sum / group_size but group intensity =
+    group_sum / n_members (ref :76-77,81-82,86-87).  ``config.tail_mode ==
+    "reference"`` also reproduces the loop over ``ind_list[1:-1]`` (ref
+    :79-87): with >= 2 gaps, the final gap is ignored and the last two groups
+    merge.  Divergences (reference crashes we fix): zero gaps → one group;
+    all groups failing quorum → empty output (ref would crash on
+    ``.max()`` of an empty array at :95).
+    """
+    if not members:
+        raise ValueError("cannot average an empty cluster")
+
+    if len(members) == 1:
+        new_mz = members[0].mz.copy()
+        new_inten = members[0].intensity.copy()
+    else:
+        mz_all = np.concatenate([s.mz for s in members])
+        inten_all = np.concatenate([s.intensity for s in members])
+        order = np.argsort(mz_all, kind="stable")
+        mz_all = mz_all[order]
+        inten_all = inten_all[order]
+
+        gaps = np.where(np.diff(mz_all) >= config.mz_accuracy)[0] + 1
+        if config.tail_mode == "reference" and gaps.size >= 2:
+            gaps = gaps[:-1]  # final gap ignored (ref :79 iterates [1:-1])
+
+        bounds = np.concatenate(([0], gaps, [mz_all.size]))
+        sizes = np.diff(bounds)
+        mz_csum = np.concatenate(([0.0], np.cumsum(mz_all)))
+        inten_csum = np.concatenate(([0.0], np.cumsum(inten_all)))
+        group_mz = (mz_csum[bounds[1:]] - mz_csum[bounds[:-1]]) / sizes
+        group_inten = (inten_csum[bounds[1:]] - inten_csum[bounds[:-1]]) / len(members)
+
+        min_l = config.min_fraction * len(members)
+        quorum_ok = sizes >= min_l
+        new_mz = group_mz[quorum_ok]
+        new_inten = group_inten[quorum_ok]
+
+    if new_inten.size:
+        floor = new_inten.max() / config.dyn_range
+        keep = new_inten >= floor
+        new_mz, new_inten = new_mz[keep], new_inten[keep]
+
+    return Spectrum(
+        mz=new_mz,
+        intensity=new_inten,
+        precursor_mz=precursor_mz,
+        precursor_charge=precursor_charge,
+        rt=rt,
+        title=cluster_id,
+    )
+
+
+# --- precursor-mass / RT estimators
+# (ref src/average_spectrum_clustering.py:106-148) -------------------------
+
+def _neutral_masses(members: list[Spectrum]) -> tuple[np.ndarray, np.ndarray]:
+    """m*z - z*H per member (ref src/average_spectrum_clustering.py:134-138)."""
+    mzs = np.array([s.precursor_mz for s in members])
+    charges = np.array([s.precursor_charge for s in members])
+    return mzs * charges - charges * PROTON_MASS, charges
+
+
+def _lower_median_index(values: np.ndarray) -> int:
+    """Index of the lower median: sorted rank (n-1)//2
+    (ref src/average_spectrum_clustering.py:106-110)."""
+    order = np.argsort(values)
+    return int(order[(len(values) - 1) // 2])
+
+
+def naive_average_mass_and_charge(members: list[Spectrum]) -> tuple[float, int]:
+    """Mean precursor m/z; all charges must agree
+    (ref src/average_spectrum_clustering.py:127-132)."""
+    charges = {s.precursor_charge for s in members}
+    if len(charges) > 1:
+        raise ValueError(
+            "There are different charge states in the cluster. "
+            "Cannot average precursor m/z."
+        )
+    return float(np.mean([s.precursor_mz for s in members])), charges.pop()
+
+
+def neutral_average_mass_and_charge(members: list[Spectrum]) -> tuple[float, int]:
+    """Mean neutral mass re-charged at the rounded mean charge
+    (ref src/average_spectrum_clustering.py:140-144)."""
+    masses, charges = _neutral_masses(members)
+    z = int(round(float(np.mean(charges))))
+    return (float(np.mean(masses)) + z * PROTON_MASS) / z, z
+
+
+def lower_median_mass_and_charge(members: list[Spectrum]) -> tuple[float, int]:
+    """Lower-median neutral mass, converted back at that member's charge
+    (ref src/average_spectrum_clustering.py:112-116)."""
+    masses, charges = _neutral_masses(members)
+    i = _lower_median_index(masses)
+    z = int(charges[i])
+    return (float(masses[i]) + z * PROTON_MASS) / z, z
+
+
+def median_rt(members: list[Spectrum]) -> float:
+    """(ref src/average_spectrum_clustering.py:146-148)"""
+    return float(np.median([s.rt for s in members]))
+
+
+def lower_median_mass_rt(members: list[Spectrum]) -> float:
+    """RT of the lower-median-mass member
+    (ref src/average_spectrum_clustering.py:118-122)."""
+    masses, _ = _neutral_masses(members)
+    return float(members[_lower_median_index(masses)].rt)
+
+
+PEPMASS_ESTIMATORS = {
+    "naive_average": naive_average_mass_and_charge,
+    "neutral_average": neutral_average_mass_and_charge,
+    "lower_median": lower_median_mass_and_charge,
+}
+RT_ESTIMATORS = {
+    "median": median_rt,
+    "mass_lower_median": lower_median_mass_rt,
+}
+
+
+# ---------------------------------------------------------------------------
+# C4: medoid representative
+# (ref src/most_similar_representative.py:13-19,87-111)
+# ---------------------------------------------------------------------------
+
+def xcorr_prescore(s1: Spectrum, s2: Spectrum, bin_size: float = 0.1) -> float:
+    """Occupancy-grid binned dot product normalised by the smaller raw peak
+    count — the capability of OpenMS ``XQuestScores::xCorrelationPrescore``
+    consumed at ref src/most_similar_representative.py:15 ("simple, binned
+    dot product, normalized by number of peaks", ref :11).  Bin index is
+    ``floor(mz / bin_size)``; each occupied bin contributes 1 regardless of
+    how many peaks fall in it.  Empty spectra score 0.
+    """
+    if s1.n_peaks == 0 or s2.n_peaks == 0:
+        return 0.0
+    b1 = np.unique((s1.mz / bin_size).astype(np.int64))
+    b2 = np.unique((s2.mz / bin_size).astype(np.int64))
+    shared = np.intersect1d(b1, b2, assume_unique=True).size
+    return float(shared) / min(s1.n_peaks, s2.n_peaks)
+
+
+def xcorr_distance(s1: Spectrum, s2: Spectrum, bin_size: float = 0.1) -> float:
+    """1 - xcorr (ref src/most_similar_representative.py:13-16)."""
+    return 1.0 - xcorr_prescore(s1, s2, bin_size)
+
+
+def medoid_index(
+    members: list[Spectrum], config: MedoidConfig = MedoidConfig()
+) -> int:
+    """Index of the member with minimal total distance to all others.
+
+    Total-distance semantics reproduced from ref
+    src/most_similar_representative.py:88-110: the reference fills an upper
+    triangular matrix INCLUDING the diagonal and sums row i + column i, so
+    the self-distance D[i,i] counts twice; ties break to the lowest index
+    (ref :103-110).  Singleton clusters return index 0 (ref :79-81).
+    """
+    n = len(members)
+    if n == 0:
+        raise ValueError("empty cluster")
+    if n == 1:
+        return 0
+    dist = np.zeros((n, n))
+    for i in range(n):
+        for j in range(i, n):
+            dist[i, j] = xcorr_distance(members[i], members[j], config.bin_size)
+    sym = dist + dist.T  # row_i + col_i of the triangular fill, diag twice
+    total = sym.sum(axis=1) / n
+    return int(np.argmin(total))  # np.argmin: first (lowest-index) minimum
+
+
+# ---------------------------------------------------------------------------
+# C3: best-spectrum representative (ref src/best_spectrum.py:67-100)
+# ---------------------------------------------------------------------------
+
+def _normalize_usi(usi: str) -> str:
+    """Collapse empty USI fields and drop any interpretation suffix so the
+    scores join matches on (collection, run, scan).
+
+    The reference builds score USIs with a double colon
+    (``...raw::scan:N``, ref src/best_spectrum.py:61-62) while its own
+    converter emits single-colon USIs (ref src/convert_mgf_cluster.py:15) —
+    making the join silently empty, a latent reference bug we fix by
+    normalising both sides here.
+    """
+    parts = [p for p in usi.split(":") if p != ""]
+    if "scan" in parts:
+        k = parts.index("scan")
+        parts = parts[: k + 2]  # drop :PEPTIDE/z interpretation suffix
+    return ":".join(parts)
+
+
+def best_spectrum_index(
+    members: list[Spectrum],
+    scores: dict[str, float],
+    config: BestSpectrumConfig = BestSpectrumConfig(),
+) -> int:
+    """Index of the member with the highest PSM score.
+
+    Raises ValueError when no member has a score (ref src/best_spectrum.py:
+    98-99; callers drop such clusters — ref :170-174).  Tie-break: the
+    lexicographically smallest USI among the tied maxima, matching pandas
+    ``idxmax`` over the USI-sorted series built at ref :64.  USIs are
+    normalised on both sides (see ``_normalize_usi``).
+    """
+    norm_scores = {_normalize_usi(k): v for k, v in scores.items()}
+    best_i: int | None = None
+    best: tuple[float, str] | None = None
+    for i, s in enumerate(members):
+        usi = _normalize_usi(s.usi)
+        if usi not in norm_scores:
+            continue
+        key = (-norm_scores[usi], usi)
+        if best is None or key < best:
+            best = key
+            best_i = i
+    if best_i is None:
+        raise ValueError("No scores found for the given scan numbers")
+    return best_i
+
+
+# ---------------------------------------------------------------------------
+# C5: binned-cosine quality metric (ref src/benchmark.py:11-38)
+# ---------------------------------------------------------------------------
+
+def binned_cosine(
+    a: Spectrum, b: Spectrum, config: CosineConfig = CosineConfig()
+) -> float:
+    """Cosine similarity of two spectra on a shared ~0.005 Da grid.
+
+    Grid semantics reproduced from ref src/benchmark.py:11-29: bin edges
+    ``arange(-mz_space/2, max_mz, mz_space)`` where max_mz is the larger LAST
+    m/z of the pair (assumes sorted peaks, ref :20); peaks at or beyond the
+    last edge are excluded, as scipy ``binned_statistic`` does.  Despite the
+    reference's name ``cos_dist`` this is a similarity; zero-norm inputs
+    score 0 (ref :26-27).
+    """
+    if a.n_peaks == 0 or b.n_peaks == 0:
+        return 0.0
+    space = config.mz_space
+    max_mz = max(a.mz[-1], b.mz[-1])
+    edges = np.arange(-space / 2.0, max_mz, space)
+    if edges.size < 2:
+        return 0.0
+
+    def binned(s: Spectrum) -> np.ndarray:
+        vec = np.zeros(edges.size - 1)
+        idx = np.floor((s.mz - edges[0]) / space).astype(np.int64)
+        ok = (s.mz >= edges[0]) & (s.mz <= edges[-1])
+        # scipy binned_statistic puts values equal to the last edge into the
+        # final bin (right-closed last bin)
+        idx = np.where(idx == edges.size - 1, edges.size - 2, idx)
+        np.add.at(vec, idx[ok], s.intensity[ok])
+        return vec
+
+    va, vb = binned(a), binned(b)
+    na, nb = float(va @ va), float(vb @ vb)
+    if na == 0.0 or nb == 0.0:
+        return 0.0
+    return float(va @ vb) / np.sqrt(na * nb)
+
+
+def average_cosine(
+    representative: Spectrum,
+    members: list[Spectrum],
+    config: CosineConfig = CosineConfig(),
+) -> float:
+    """Mean binned cosine of a representative to the cluster members
+    (ref src/benchmark.py:31-38); empty member list scores 0."""
+    if not members:
+        return 0.0
+    return float(
+        np.mean([binned_cosine(representative, m, config) for m in members])
+    )
+
+
+# ---------------------------------------------------------------------------
+# Cluster-level drivers
+# ---------------------------------------------------------------------------
+
+def run_bin_mean(clusters: list[Cluster], config: BinMeanConfig = BinMeanConfig()) -> list[Spectrum]:
+    """Per-cluster loop of ref src/binning.py:291-297."""
+    return [bin_mean_consensus(c.members, config, c.cluster_id) for c in clusters]
+
+
+def run_gap_average(
+    clusters: list[Cluster], config: GapAverageConfig = GapAverageConfig()
+) -> list[Spectrum]:
+    """Per-cluster loop of ref src/average_spectrum_clustering.py:158-164."""
+    get_pepmass = PEPMASS_ESTIMATORS[config.pepmass]
+    rt_mode = config.rt
+    if config.pepmass == "lower_median":
+        # ref src/average_spectrum_clustering.py:190-191: lower_median pepmass
+        # forces the lower-median-mass member's RT
+        rt_mode = "mass_lower_median"
+    get_rt = RT_ESTIMATORS[rt_mode]
+    out = []
+    for c in clusters:
+        mz, z = get_pepmass(c.members)
+        rt = get_rt(c.members)
+        out.append(
+            gap_average_consensus(c.members, config, c.cluster_id, mz, z, rt)
+        )
+    return out
+
+
+def run_medoid(
+    clusters: list[Cluster], config: MedoidConfig = MedoidConfig()
+) -> list[Spectrum]:
+    """Per-cluster loop of ref src/most_similar_representative.py:60-111."""
+    return [c.members[medoid_index(c.members, config)] for c in clusters]
+
+
+def run_best_spectrum(
+    clusters: list[Cluster],
+    scores: dict[str, float],
+    config: BestSpectrumConfig = BestSpectrumConfig(),
+) -> list[Spectrum]:
+    """Scoreless clusters are silently dropped (ref src/best_spectrum.py:
+    170-174)."""
+    out = []
+    for c in clusters:
+        try:
+            out.append(c.members[best_spectrum_index(c.members, scores, config)])
+        except ValueError:
+            pass
+    return out

@@ -1,0 +1,131 @@
+"""Configuration dataclasses for all merge strategies and metrics.
+
+The reference scatters its parameters across four inconsistent CLI styles,
+module constants and hardcoded call-site literals (survey §5 "Config / flag
+system"; e.g. ref src/binning.py:294, src/average_spectrum_clustering.py:21-23,
+src/most_similar_representative.py:15, src/benchmark.py:8-9).  Here every
+knob lives in one frozen dataclass per method, shared by the numpy oracle,
+the TPU backend, and the CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+
+Backend = Literal["numpy", "tpu", "pallas"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BinMeanConfig:
+    """Binned-mean consensus (ref src/binning.py:170 combine_bin_mean).
+
+    ``min_mz``/``max_mz``/``bin_size`` reproduce the hardcoded call at
+    ref src/binning.py:294 (100, 2000, 0.02).  ``quorum_fraction`` is the
+    0.25 literal at ref src/binning.py:183; quorum = int(n*frac)+1.
+    """
+
+    min_mz: float = 100.0
+    max_mz: float = 2000.0
+    bin_size: float = 0.02
+    apply_peak_quorum: bool = True
+    quorum_fraction: float = 0.25
+
+    @property
+    def n_bins(self) -> int:
+        # ref src/binning.py:172: int((max-min)/binsize) + 1
+        return int((self.max_mz - self.min_mz) / self.bin_size) + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class GapAverageConfig:
+    """Gap-clustered average consensus
+    (ref src/average_spectrum_clustering.py:21-23,26-103).
+
+    ``tail_mode`` documents a deliberate behavioural switch:
+
+    * ``"reference"`` reproduces the reference loop over ``ind_list[1:-1]``
+      (ref src/average_spectrum_clustering.py:79-87), which ignores the final
+      m/z gap when there are >= 2 gaps, merging the last two peak groups.
+    * ``"split"`` honours every gap (the mathematically intended behaviour).
+    """
+
+    mz_accuracy: float = 0.01
+    dyn_range: float = 1000.0
+    min_fraction: float = 0.5
+    tail_mode: Literal["reference", "split"] = "reference"
+    pepmass: Literal["naive_average", "neutral_average", "lower_median"] = "lower_median"
+    rt: Literal["median", "mass_lower_median"] = "median"
+
+
+@dataclasses.dataclass(frozen=True)
+class MedoidConfig:
+    """Most-similar (medoid) representative
+    (ref src/most_similar_representative.py:13-19,60-111).
+
+    Similarity is an occupancy-grid binned dot product normalised by the
+    smaller raw peak count — the capability pyOpenMS
+    ``XQuestScores::xCorrelationPrescore(spec1, spec2, 0.1)`` supplies at
+    ref src/most_similar_representative.py:15.  ``bin_size`` is that 0.1 Da
+    literal.  Bin index is ``round(mz / bin_size)``.
+    """
+
+    bin_size: float = 0.1
+
+
+@dataclasses.dataclass(frozen=True)
+class BestSpectrumConfig:
+    """Best-PSM-score representative (ref src/best_spectrum.py:43-100).
+
+    ``px_accession`` replaces the hardcoded ``mzspec:PXD004732:`` prefix
+    (ref src/best_spectrum.py:61-62, marked FIXME there).
+    """
+
+    px_accession: str = "PXD004732"
+    raw_suffix: str = ".raw"
+
+
+@dataclasses.dataclass(frozen=True)
+class CosineConfig:
+    """Binned-cosine quality metric (ref src/benchmark.py:8-29).
+
+    ``mz_unit``/``mz_space`` reproduce ref src/benchmark.py:8-9: bins of
+    ~0.005 Da on a grid starting at -mz_space/2.
+    """
+
+    mz_unit: float = 1.000508
+    mz_space_factor: float = 0.005
+
+    @property
+    def mz_space(self) -> float:
+        return self.mz_unit * self.mz_space_factor
+
+
+@dataclasses.dataclass(frozen=True)
+class FragmentConfig:
+    """b/y-ion annotation (ref src/benchmark.py:40-61 fraction_of_by).
+
+    50 ppm tolerance and the [100, 1400] m/z preprocessing window reproduce
+    ref src/benchmark.py:47-52.
+    """
+
+    tol: float = 50.0
+    tol_mode: Literal["ppm", "Da"] = "ppm"
+    min_mz: float = 100.0
+    max_mz: float = 1400.0
+    ion_types: str = "by"
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchConfig:
+    """Bucketed padding of ragged clusters into device tensors.
+
+    ``member_buckets``/``peak_buckets`` are the allowed padded sizes; each
+    cluster is padded up to the smallest bucket that fits.  Fewer buckets
+    means fewer XLA recompiles but more padding waste (survey §7 hard part a).
+    """
+
+    member_buckets: tuple[int, ...] = (2, 4, 8, 16, 32, 64, 128)
+    peak_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048)
+    clusters_per_batch: int = 256

@@ -1,0 +1,11 @@
+from specpride_tpu.data.peaks import Spectrum, Cluster, parse_title, build_title
+from specpride_tpu.data.ragged import ClusterBatch, bucketize_clusters
+
+__all__ = [
+    "Spectrum",
+    "Cluster",
+    "parse_title",
+    "build_title",
+    "ClusterBatch",
+    "bucketize_clusters",
+]

@@ -1,0 +1,186 @@
+"""Host-side peak data model.
+
+The reference passes spectra around as pyteomics-style dicts of numpy arrays
+(`'m/z array'`, `'intensity array'`, precursor fields — ref
+src/binning.py:98-103, src/average_spectrum_clustering.py:100-103).  Here the
+unit is an immutable ``Spectrum`` with contiguous float32/float64 arrays, and
+a ``Cluster`` groups members; both are host-side staging types — device
+compute happens on ``specpride_tpu.data.ragged.ClusterBatch`` tensors.
+
+Title convention for the clustered-MGF interchange format
+(ref file_formats.md:5-9): ``TITLE=<cluster_id>;<usi>`` where the USI is
+``mzspec:<PX>:<raw>:scan:<n>[:<PEPTIDE>/<z>]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator, Mapping
+
+import numpy as np
+
+
+def parse_title(title: str) -> tuple[str, str]:
+    """Split an MGF TITLE into (cluster_id, usi).
+
+    Reference behaviour: split on the first ';'
+    (ref src/binning.py:143-144, src/average_spectrum_clustering.py:124-125).
+    A title without ';' is a bare cluster id with an empty USI
+    (consensus spectra — ref file_formats.md:57).
+    """
+    cluster_id, sep, usi = title.partition(";")
+    return cluster_id, usi
+
+
+def build_title(
+    cluster_id: str,
+    px_accession: str,
+    raw_name: str,
+    scan: int,
+    peptide: str | None = None,
+    charge: int | None = None,
+) -> str:
+    """Build the clustered-MGF TITLE (ref src/convert_mgf_cluster.py:14-18).
+
+    The reference function is named ``buid_usi_accession`` (typo); the
+    behaviour is reproduced, the name fixed (survey "known bugs" list).
+    """
+    usi = f"mzspec:{px_accession}:{raw_name}:scan:{scan}"
+    if peptide is not None:
+        usi = f"{usi}:{peptide}/{charge}"
+    return f"{cluster_id};{usi}"
+
+
+def scan_from_usi(usi: str) -> int | None:
+    """Extract the scan number from a USI, or None if absent."""
+    parts = usi.split(":")
+    for i, part in enumerate(parts):
+        if part == "scan" and i + 1 < len(parts):
+            try:
+                return int(parts[i + 1])
+            except ValueError:
+                return None
+    return None
+
+
+def peptide_from_usi(usi: str) -> tuple[str | None, int | None]:
+    """Extract (peptide, charge) from a USI interpretation suffix, if any."""
+    parts = usi.split(":")
+    if len(parts) >= 6 and "/" in parts[-1]:
+        pep, _, z = parts[-1].rpartition("/")
+        try:
+            return pep, int(z)
+        except ValueError:
+            return None, None
+    return None, None
+
+
+@dataclasses.dataclass
+class Spectrum:
+    """One MS/MS spectrum: parallel m/z / intensity arrays + precursor info."""
+
+    mz: np.ndarray
+    intensity: np.ndarray
+    precursor_mz: float = 0.0
+    precursor_charge: int = 0
+    rt: float = 0.0
+    title: str = ""
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.mz = np.asarray(self.mz, dtype=np.float64)
+        self.intensity = np.asarray(self.intensity, dtype=np.float64)
+        if self.mz.shape != self.intensity.shape:
+            raise ValueError(
+                f"mz and intensity must have equal length, got "
+                f"{self.mz.shape} vs {self.intensity.shape}"
+            )
+
+    @property
+    def n_peaks(self) -> int:
+        return int(self.mz.size)
+
+    @property
+    def cluster_id(self) -> str:
+        return parse_title(self.title)[0]
+
+    @property
+    def usi(self) -> str:
+        return parse_title(self.title)[1]
+
+    @property
+    def neutral_mass(self) -> float:
+        """Neutral (uncharged) precursor mass: m*z - z*H
+        (ref src/average_spectrum_clustering.py:134-138)."""
+        from specpride_tpu.ops.fragments import PROTON_MASS
+
+        z = self.precursor_charge
+        return self.precursor_mz * z - z * PROTON_MASS
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Spectrum":
+        """Accept a pyteomics-style dict ('m/z array', 'params', ...)."""
+        params = d.get("params", {})
+        pepmass = params.get("pepmass", (0.0,))
+        if isinstance(pepmass, (int, float)):
+            pepmass = (pepmass,)
+        charge = params.get("charge", (0,))
+        if isinstance(charge, int):
+            charge = (charge,)
+        return cls(
+            mz=d["m/z array"],
+            intensity=d["intensity array"],
+            precursor_mz=float(pepmass[0]) if pepmass else 0.0,
+            precursor_charge=int(charge[0]) if charge else 0,
+            rt=float(params.get("rtinseconds", 0.0) or 0.0),
+            title=str(params.get("title", "")),
+        )
+
+    def to_dict(self) -> dict:
+        """Export as a pyteomics-style dict (for interop / MGF writing)."""
+        return {
+            "m/z array": self.mz,
+            "intensity array": self.intensity,
+            "params": {
+                "title": self.title,
+                "pepmass": (self.precursor_mz,),
+                "charge": (self.precursor_charge,),
+                "rtinseconds": self.rt,
+            },
+        }
+
+
+@dataclasses.dataclass
+class Cluster:
+    """A cluster of member spectra sharing a cluster id."""
+
+    cluster_id: str
+    members: list[Spectrum]
+
+    @property
+    def n_members(self) -> int:
+        return len(self.members)
+
+    @property
+    def total_peaks(self) -> int:
+        return sum(s.n_peaks for s in self.members)
+
+    @property
+    def max_peaks(self) -> int:
+        return max((s.n_peaks for s in self.members), default=0)
+
+    def __iter__(self) -> Iterator[Spectrum]:
+        return iter(self.members)
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+
+def group_into_clusters(spectra: Iterable[Spectrum]) -> list[Cluster]:
+    """Group spectra by the cluster id encoded in their titles, preserving
+    first-seen cluster order and in-file member order
+    (ref src/binning.py:159-165, src/best_spectrum.py:144-148)."""
+    by_id: dict[str, list[Spectrum]] = {}
+    for s in spectra:
+        by_id.setdefault(s.cluster_id, []).append(s)
+    return [Cluster(cid, members) for cid, members in by_id.items()]

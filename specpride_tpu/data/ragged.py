@@ -1,0 +1,157 @@
+"""Ragged clusters → bucketed, padded device batches.
+
+The compute core operates on dense ``(cluster, member, peak)`` tensors with
+validity masks.  Peaks per spectrum and members per cluster vary wildly
+(survey §7 hard part a), so clusters are bucketed by padded (member, peak)
+size: each distinct bucket shape is one XLA compilation, and padding waste is
+bounded by the bucket granularity.
+
+The reference has no equivalent — it loops Python lists of dicts
+(ref src/binning.py:291-297).  This module is the boundary where the host
+data model becomes an HBM-resident ragged tensor (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from specpride_tpu.config import BatchConfig
+from specpride_tpu.data.peaks import Cluster
+
+
+@dataclasses.dataclass
+class ClusterBatch:
+    """A dense batch of B clusters, each padded to M members × P peaks.
+
+    Numpy-backed on the host; becomes device-resident (and shardable along
+    the leading cluster axis) when passed into a jitted kernel.  Padding
+    convention: invalid peaks have mz = 0, intensity = 0, mask False;
+    invalid members have n_peaks = 0 and member_mask False.
+    """
+
+    mz: np.ndarray  # (B, M, P) float32
+    intensity: np.ndarray  # (B, M, P) float32
+    peak_mask: np.ndarray  # (B, M, P) bool
+    member_mask: np.ndarray  # (B, M) bool
+    precursor_mz: np.ndarray  # (B, M) float32
+    precursor_charge: np.ndarray  # (B, M) int32
+    rt: np.ndarray  # (B, M) float32
+    n_members: np.ndarray  # (B,) int32
+    n_peaks: np.ndarray  # (B, M) int32
+    cluster_ids: list[str]  # length B (host-only metadata)
+
+    @property
+    def shape(self) -> tuple[int, int, int]:
+        return self.mz.shape  # type: ignore[return-value]
+
+    @property
+    def n_clusters(self) -> int:
+        return self.mz.shape[0]
+
+    def device_arrays(self) -> dict[str, np.ndarray]:
+        """The tensors that participate in device compute (no host metadata)."""
+        return {
+            "mz": self.mz,
+            "intensity": self.intensity,
+            "peak_mask": self.peak_mask,
+            "member_mask": self.member_mask,
+            "precursor_mz": self.precursor_mz,
+            "precursor_charge": self.precursor_charge,
+            "rt": self.rt,
+            "n_members": self.n_members,
+            "n_peaks": self.n_peaks,
+        }
+
+
+def _bucket_for(value: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= value; the largest bucket if value exceeds all."""
+    i = bisect.bisect_left(buckets, value)
+    return buckets[min(i, len(buckets) - 1)]
+
+
+def pad_clusters(clusters: Sequence[Cluster], n_members: int, n_peaks: int) -> ClusterBatch:
+    """Pad a homogeneous group of clusters into one dense ClusterBatch."""
+    b = len(clusters)
+    mz = np.zeros((b, n_members, n_peaks), dtype=np.float32)
+    intensity = np.zeros((b, n_members, n_peaks), dtype=np.float32)
+    peak_mask = np.zeros((b, n_members, n_peaks), dtype=bool)
+    member_mask = np.zeros((b, n_members), dtype=bool)
+    precursor_mz = np.zeros((b, n_members), dtype=np.float32)
+    precursor_charge = np.zeros((b, n_members), dtype=np.int32)
+    rt = np.zeros((b, n_members), dtype=np.float32)
+    n_members_arr = np.zeros((b,), dtype=np.int32)
+    n_peaks_arr = np.zeros((b, n_members), dtype=np.int32)
+
+    for ci, cluster in enumerate(clusters):
+        if cluster.n_members > n_members:
+            raise ValueError(
+                f"cluster {cluster.cluster_id} has {cluster.n_members} "
+                f"members > member bucket {n_members}"
+            )
+        n_members_arr[ci] = cluster.n_members
+        for mi, s in enumerate(cluster.members):
+            k = s.n_peaks
+            if k > n_peaks:
+                raise ValueError(
+                    f"cluster {cluster.cluster_id} member {mi} has {s.n_peaks} "
+                    f"peaks > peak bucket {n_peaks}"
+                )
+            mz[ci, mi, :k] = s.mz[:k]
+            intensity[ci, mi, :k] = s.intensity[:k]
+            peak_mask[ci, mi, :k] = True
+            member_mask[ci, mi] = True
+            precursor_mz[ci, mi] = s.precursor_mz
+            precursor_charge[ci, mi] = s.precursor_charge
+            rt[ci, mi] = s.rt
+            n_peaks_arr[ci, mi] = k
+
+    return ClusterBatch(
+        mz=mz,
+        intensity=intensity,
+        peak_mask=peak_mask,
+        member_mask=member_mask,
+        precursor_mz=precursor_mz,
+        precursor_charge=precursor_charge,
+        rt=rt,
+        n_members=n_members_arr,
+        n_peaks=n_peaks_arr,
+        cluster_ids=[c.cluster_id for c in clusters],
+    )
+
+
+def bucketize_clusters(
+    clusters: Iterable[Cluster],
+    config: BatchConfig = BatchConfig(),
+) -> list[ClusterBatch]:
+    """Group clusters into padded batches of homogeneous (M, P) bucket shape.
+
+    Singleton clusters (n_members == 1) are bucketed too: every kernel has a
+    defined singleton behaviour (passthrough — ref
+    src/average_spectrum_clustering.py:88-90,
+    src/most_similar_representative.py:79-81), so they ride the same path.
+    Order within a bucket is preserved; callers that need global output order
+    should reorder by cluster id afterwards.
+    """
+    buckets: dict[tuple[int, int], list[Cluster]] = {}
+    for c in clusters:
+        if c.n_members == 0:
+            continue
+        mkey = _bucket_for(c.n_members, config.member_buckets)
+        pkey = _bucket_for(max(c.max_peaks, 1), config.peak_buckets)
+        if c.n_members > mkey:
+            # exceeds the largest member bucket: grow to the next power of two
+            mkey = 1 << (c.n_members - 1).bit_length()
+        if c.max_peaks > pkey:
+            pkey = 1 << (c.max_peaks - 1).bit_length()
+        buckets.setdefault((mkey, pkey), []).append(c)
+
+    batches: list[ClusterBatch] = []
+    for (mkey, pkey), group in buckets.items():
+        for start in range(0, len(group), config.clusters_per_batch):
+            chunk = group[start : start + config.clusters_per_batch]
+            batches.append(pad_clusters(chunk, mkey, pkey))
+    return batches

@@ -1,0 +1,13 @@
+from specpride_tpu.io.mgf import read_mgf, write_mgf, IndexedMGF
+from specpride_tpu.io.maracluster import read_maracluster_clusters, scan_to_cluster
+from specpride_tpu.io.maxquant import read_msms_scores, read_msms_peptides
+
+__all__ = [
+    "read_mgf",
+    "write_mgf",
+    "IndexedMGF",
+    "read_maracluster_clusters",
+    "scan_to_cluster",
+    "read_msms_scores",
+    "read_msms_peptides",
+]

@@ -1,0 +1,64 @@
+"""MaxQuant ``msms.txt`` ingest (PSM scores and peptide sequences).
+
+The reference consumes msms.txt two ways:
+
+* pandas read of columns 'Raw file', 'Scan number', 'Score' keyed by USI
+  (ref src/best_spectrum.py:43-64 get_scores);
+* positional-column read (col 1 = scan, col 7 = peptide, with the reference's
+  ``words[7][1:-1]`` stripping the flanking '_' characters MaxQuant puts
+  around 'Modified sequence') (ref src/convert_mgf_cluster.py:21-30
+  read_peptides).
+
+Both are reimplemented header-aware (no pandas needed on this path).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+
+def read_msms_scores(
+    path: str | os.PathLike,
+    px_accession: str = "PXD004732",
+    raw_suffix: str = ".raw",
+) -> dict[str, float]:
+    """USI → MaxQuant PSM score.
+
+    USI construction matches ref src/best_spectrum.py:61-62:
+    ``mzspec:<PX>:<raw file>.raw::scan:<n>`` — note the reference's double
+    colon (empty index-type field) is reproduced for join parity.
+    When a USI occurs more than once, the max score wins (pandas idxmax over
+    a duplicated index effectively compares all entries).
+    """
+    scores: dict[str, float] = {}
+    with open(path, newline="") as fh:
+        reader = csv.DictReader(fh, delimiter="\t")
+        for row in reader:
+            raw = row["Raw file"]
+            scan = row["Scan number"]
+            score = float(row["Score"])
+            usi = f"mzspec:{px_accession}:{raw}{raw_suffix}::scan:{scan}"
+            if usi not in scores or score > scores[usi]:
+                scores[usi] = score
+    return scores
+
+
+def read_msms_peptides(path: str | os.PathLike) -> dict[int, str]:
+    """Scan number → (modified) peptide sequence.
+
+    Positional parity with ref src/convert_mgf_cluster.py:21-30: column 1 is
+    the scan, column 7 the sequence with its first and last characters
+    stripped.  Later rows overwrite earlier ones for the same scan, as the
+    reference dict assignment does.
+    """
+    peptides: dict[int, str] = {}
+    with open(path) as fh:
+        next(fh)  # header
+        for line in fh:
+            words = line.rstrip("\n").split("\t")
+            if len(words) <= 7:
+                continue
+            scan = int(words[1])
+            peptides[scan] = words[7][1:-1]
+    return peptides

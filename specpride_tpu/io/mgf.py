@@ -1,0 +1,231 @@
+"""MGF (Mascot Generic Format) reading and writing.
+
+Built from scratch (pyteomics is not a dependency of this framework).
+Capabilities covered, with reference provenance:
+
+* sequential full-file read        (ref src/binning.py:122-167 hand parser)
+* random access by TITLE           (ref src/average_spectrum_clustering.py:156
+                                    via pyteomics ``IndexedMGF``)
+* write                            (ref src/binning.py:234-245 hand writer;
+                                    pyteomics ``mgf.write`` elsewhere)
+
+Parsing accepts the clustered-MGF interchange dialect of
+ref file_formats.md:3-53: BEGIN IONS / TITLE= / PEPMASS= / CHARGE=N+ /
+RTINSECONDS= / SEQUENCE= / numeric peak lines "mz intensity" / END IONS.
+Gzip-transparent (ref src/binning.py:72-77 handles .gz mzML the same way).
+
+A C++ fast path (``specpride_tpu.io.native``) parses large files into flat
+arrays; this module is the always-available fallback and the semantics oracle.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+from typing import IO, Iterator, Sequence
+
+import numpy as np
+
+from specpride_tpu.data.peaks import Spectrum
+
+
+def _open_text(path: str | os.PathLike) -> IO[str]:
+    path = os.fspath(path)
+    if path.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
+    return open(path, "rt", encoding="utf-8")
+
+
+def _parse_charge(value: str) -> int:
+    """CHARGE=2+ / 2- / 2 → signed int (ref src/binning.py:148 strips '+')."""
+    value = value.strip()
+    sign = 1
+    if value.endswith("+"):
+        value = value.rstrip("+")
+    elif value.endswith("-"):
+        value = value.rstrip("-")
+        sign = -1
+    return sign * int(value) if value else 0
+
+
+def _finish_spectrum(
+    headers: dict[str, str], mzs: list[float], intensities: list[float]
+) -> Spectrum:
+    pepmass = headers.get("PEPMASS", "0")
+    # PEPMASS may carry "mz intensity"; only the first field is the m/z
+    pepmass_mz = float(pepmass.split()[0]) if pepmass.split() else 0.0
+    return Spectrum(
+        mz=np.array(mzs, dtype=np.float64),
+        intensity=np.array(intensities, dtype=np.float64),
+        precursor_mz=pepmass_mz,
+        precursor_charge=_parse_charge(headers.get("CHARGE", "0")),
+        rt=float(headers.get("RTINSECONDS", 0.0) or 0.0),
+        title=headers.get("TITLE", ""),
+        extra={k: v for k, v in headers.items()
+               if k not in ("TITLE", "PEPMASS", "CHARGE", "RTINSECONDS")},
+    )
+
+
+def parse_mgf_stream(stream: IO[str]) -> Iterator[Spectrum]:
+    """Yield spectra from an MGF text stream."""
+    headers: dict[str, str] = {}
+    mzs: list[float] = []
+    intensities: list[float] = []
+    in_ions = False
+    for line in stream:
+        line = line.strip()
+        if not line:
+            continue
+        if line == "BEGIN IONS":
+            in_ions = True
+            headers, mzs, intensities = {}, [], []
+        elif line == "END IONS":
+            if in_ions:
+                yield _finish_spectrum(headers, mzs, intensities)
+            in_ions = False
+        elif not in_ions:
+            continue
+        elif line[0].isdigit() or line[0] in "+-.":
+            fields = line.split()
+            if len(fields) >= 2:
+                mzs.append(float(fields[0]))
+                intensities.append(float(fields[1]))
+            elif len(fields) == 1:
+                mzs.append(float(fields[0]))
+                intensities.append(0.0)
+        else:
+            key, sep, value = line.partition("=")
+            if sep:
+                headers[key.strip().upper()] = value.strip()
+    return
+
+
+def read_mgf(path: str | os.PathLike, use_native: bool | None = None) -> list[Spectrum]:
+    """Read all spectra from an MGF file.
+
+    ``use_native`` selects the C++ parser: True forces it, False forbids it,
+    None (default) uses it when the shared library is available.
+    """
+    if use_native is not False:
+        try:
+            from specpride_tpu.io import native
+
+            if native.available():
+                return native.read_mgf_native(os.fspath(path))
+            if use_native:
+                raise RuntimeError("native MGF parser requested but not built")
+        except ImportError:
+            if use_native:
+                raise
+    with _open_text(path) as fh:
+        return list(parse_mgf_stream(fh))
+
+
+class IndexedMGF:
+    """Random access to an MGF file by TITLE.
+
+    Capability parity with pyteomics ``IndexedMGF`` as used at
+    ref src/average_spectrum_clustering.py:156-160: exposes the in-file title
+    order and batch fetch by title list.  Implementation: one indexing pass
+    recording byte offsets, then seeks.
+    """
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+        self._offsets: dict[str, tuple[int, int]] = {}
+        self._titles: list[str] = []
+        self._index()
+
+    def _index(self) -> None:
+        # Byte-offset scan; works on plain files (gz falls back to full read)
+        if self.path.endswith(".gz"):
+            self._spectra = {s.title: s for s in read_mgf(self.path, use_native=False)}
+            self._titles = list(self._spectra)
+            return
+        self._spectra = None
+        with open(self.path, "rb") as fh:
+            offset = 0
+            begin = -1
+            title = None
+            for line in fh:
+                stripped = line.strip()
+                if stripped == b"BEGIN IONS":
+                    begin = offset
+                    title = None
+                elif stripped.startswith(b"TITLE="):
+                    title = stripped[6:].decode("utf-8")
+                elif stripped == b"END IONS" and begin >= 0:
+                    end = offset + len(line)
+                    key = title if title is not None else f"index={len(self._titles)}"
+                    self._offsets[key] = (begin, end)
+                    self._titles.append(key)
+                    begin = -1
+                offset += len(line)
+
+    @property
+    def titles(self) -> list[str]:
+        return list(self._titles)
+
+    def __len__(self) -> int:
+        return len(self._titles)
+
+    def __getitem__(self, key: str | Sequence[str]) -> Spectrum | list[Spectrum]:
+        if isinstance(key, str):
+            return self._get_one(key)
+        return [self._get_one(k) for k in key]
+
+    def _get_one(self, title: str) -> Spectrum:
+        if self._spectra is not None:
+            return self._spectra[title]
+        begin, end = self._offsets[title]
+        with open(self.path, "rb") as fh:
+            fh.seek(begin)
+            chunk = fh.read(end - begin).decode("utf-8")
+        return next(parse_mgf_stream(io.StringIO(chunk)))
+
+
+def format_spectrum(spectrum: Spectrum, skip_nan: bool = True) -> str:
+    """Format one spectrum as an MGF record.
+
+    Field order TITLE / PEPMASS / RTINSECONDS / CHARGE matches the
+    interchange examples (ref file_formats.md:5-9); NaN-intensity peaks are
+    skipped as in the reference writer (ref src/binning.py:242).
+    """
+    lines = ["BEGIN IONS", f"TITLE={spectrum.title}"]
+    lines.append(f"PEPMASS={spectrum.precursor_mz}")
+    if spectrum.rt:
+        lines.append(f"RTINSECONDS={spectrum.rt}")
+    z = spectrum.precursor_charge
+    if z:
+        lines.append(f"CHARGE={abs(z)}{'+' if z > 0 else '-'}")
+    for mz, inten in zip(spectrum.mz, spectrum.intensity):
+        if skip_nan and (np.isnan(inten) or np.isnan(mz)):
+            continue
+        lines.append(f"{mz} {inten}")
+    lines.append("END IONS")
+    return "\n".join(lines) + "\n\n"
+
+
+def write_mgf(
+    spectra: Sequence[Spectrum] | Iterator[Spectrum],
+    path_or_file: str | os.PathLike | IO[str] | None,
+    append: bool = False,
+) -> str | None:
+    """Write spectra to an MGF file, file object, or (path None) a string.
+
+    Streams one record at a time — never materialises the whole file in
+    memory.  ``append`` reproduces the reference's ``--append`` output mode
+    (ref src/average_spectrum_clustering.py:183-184,198).
+    """
+    if path_or_file is None:
+        return "".join(format_spectrum(s) for s in spectra)
+    if hasattr(path_or_file, "write"):
+        for s in spectra:
+            path_or_file.write(format_spectrum(s))  # type: ignore[union-attr]
+        return None
+    mode = "a" if append else "w"
+    with open(os.fspath(path_or_file), mode, encoding="utf-8") as fh:
+        for s in spectra:
+            fh.write(format_spectrum(s))
+    return None

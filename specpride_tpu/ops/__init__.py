@@ -1,0 +1,12 @@
+"""Device kernels (JAX/XLA + Pallas) and spectrum math.
+
+The architectural insight from the survey (§3.5): two device kernels serve
+almost every capability —
+
+* K1 binned scatter-add (peaks → dense or compact grid): consensus binning,
+  occupancy grids, cosine binning
+* K2 batched gram matmul + argmin/argmax reductions: medoid selection,
+  all-pairs and rep-vs-member cosine
+
+plus K3, a sort + segment-reduction pipeline for gap-average consensus.
+"""

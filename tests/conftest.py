@@ -1,0 +1,81 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+The standard JAX idiom for testing pod sharding without TPU hardware
+(survey §4d): force the host platform and split it into 8 virtual devices.
+Must run before jax initialises, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+from specpride_tpu.data.peaks import Spectrum
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def make_spectrum(
+    rng: np.random.Generator,
+    n_peaks: int = 50,
+    cluster_id: str = "cluster-1",
+    scan: int = 1,
+    precursor_mz: float = 500.0,
+    charge: int = 2,
+    rt: float = 100.0,
+    peptide: str | None = None,
+) -> Spectrum:
+    mz = np.sort(rng.uniform(100.0, 1900.0, size=n_peaks))
+    intensity = rng.uniform(1.0, 1e4, size=n_peaks)
+    usi = f"mzspec:PXD000001:run1:scan:{scan}"
+    if peptide:
+        usi += f":{peptide}/{charge}"
+    return Spectrum(
+        mz=mz,
+        intensity=intensity,
+        precursor_mz=precursor_mz,
+        precursor_charge=charge,
+        rt=rt,
+        title=f"{cluster_id};{usi}",
+    )
+
+
+def make_cluster(
+    rng: np.random.Generator,
+    cluster_id: str = "cluster-1",
+    n_members: int = 4,
+    n_peaks: int = 50,
+    jitter: float = 0.004,
+    base_scan: int = 1000,
+    charge: int = 2,
+):
+    """Members share a peak skeleton with m/z jitter — a realistic cluster."""
+    from specpride_tpu.data.peaks import Cluster
+
+    skeleton = np.sort(rng.uniform(120.0, 1800.0, size=n_peaks))
+    members = []
+    for m in range(n_members):
+        mz = np.sort(skeleton + rng.normal(0.0, jitter, size=n_peaks))
+        intensity = rng.uniform(10.0, 1e4, size=n_peaks)
+        usi = f"mzspec:PXD000001:run1:scan:{base_scan + m}"
+        members.append(
+            Spectrum(
+                mz=mz,
+                intensity=intensity,
+                precursor_mz=500.0 + rng.normal(0, 0.01),
+                precursor_charge=charge,
+                rt=100.0 + m,
+                title=f"{cluster_id};{usi}",
+            )
+        )
+    return Cluster(cluster_id, members)

@@ -1,0 +1,108 @@
+"""Fragment theory tests (capability of spectrum_utils/pyteomics consumed at
+ref src/benchmark.py:40-61 and src/plot_cluster.py:36-41)."""
+
+import numpy as np
+import pytest
+
+from specpride_tpu.ops import fragments as fr
+
+
+def test_proton_mass():
+    # pyteomics nist_mass['H+'][0][0] (ref src/average_spectrum_clustering.py:6)
+    assert fr.PROTON_MASS == pytest.approx(1.00727646677, abs=1e-9)
+
+
+def test_peptide_mass_known_value():
+    # glycine: residue + water = 75.032...
+    assert fr.peptide_mass("G") == pytest.approx(75.03203, abs=1e-3)
+    # angiotensin fragment DRVYIHPF monoisotopic mass ≈ 1045.534
+    assert fr.peptide_mass("DRVYIHPF") == pytest.approx(1045.534, abs=5e-3)
+
+
+def test_fragment_count():
+    frags = fr.fragment_mzs("PEPTIDE", "by", max_charge=1)
+    # 6 b-ions + 6 y-ions
+    assert frags.size == 12
+    frags2 = fr.fragment_mzs("PEPTIDE", "by", max_charge=2)
+    assert frags2.size == 24
+
+
+def test_by_complementarity():
+    # b_k + y_{n-k} = peptide mass + 2 protons (singly charged ions)
+    seq = "VLHPLEGAVVIIFK"
+    residues, deltas = fr.parse_peptide(seq)
+    masses = np.array([fr.RESIDUE_MASSES[r] + d for r, d in zip(residues, deltas)])
+    b = np.cumsum(masses)[:-1] + fr.PROTON_MASS
+    y = np.cumsum(masses[::-1])[:-1] + fr.WATER_MASS + fr.PROTON_MASS
+    total = fr.peptide_mass(seq)
+    np.testing.assert_allclose(b + y[::-1], total + 2 * fr.PROTON_MASS, rtol=1e-9)
+
+
+def test_modified_peptide():
+    plain = fr.peptide_mass("PEPTMIDE")
+    ox = fr.peptide_mass("PEPTM(ox)IDE")
+    assert ox - plain == pytest.approx(15.9949, abs=1e-3)
+
+
+def test_parse_maxquant_flanks():
+    residues, _ = fr.parse_peptide("_PEPTIDE_")
+    assert "".join(residues) == "PEPTIDE"
+
+
+def test_parse_maxquant_nested_mod():
+    # modern MaxQuant dialect: _M(Oxidation (M))PEPTIDEK_
+    residues, deltas = fr.parse_peptide("_M(Oxidation (M))PEPTIDEK_")
+    assert "".join(residues) == "MPEPTIDEK"
+    assert deltas[0] == pytest.approx(15.9949, abs=1e-3)
+
+
+def test_parse_nterm_mod():
+    residues, deltas = fr.parse_peptide("(ac)PEPTIDEK")
+    assert "".join(residues) == "PEPTIDEK"
+    assert deltas[0] == pytest.approx(42.0106, abs=1e-3)
+
+
+def test_fraction_of_by_hostile_sequences_score_zero():
+    mz, inten = np.array([200.0]), np.array([1.0])
+    # unknown mod, unbalanced parens, single residue: score 0, never raise
+    assert fr.fraction_of_by("P(weird)EP", 500.0, 2, mz, inten) == 0.0
+    assert fr.fraction_of_by("P(EP", 500.0, 2, mz, inten) == 0.0
+    assert fr.fraction_of_by("K", 500.0, 2, mz, inten) == 0.0
+    assert fr.fraction_of_by("(ac)PEPTIDEK", 500.0, 2, mz, inten) >= 0.0
+
+
+def test_is_valid():
+    assert fr.is_valid_peptide("PEPTIDE")
+    assert not fr.is_valid_peptide("PEPT1DE")
+    assert not fr.is_valid_peptide("")
+
+
+def test_match_fragments_window():
+    frags = np.array([200.0, 500.0])
+    mz = np.array([200.0 + 200.0 * 40e-6, 200.0 + 200.0 * 60e-6, 499.9])
+    hit = fr.match_fragments(mz, frags, tol=50.0, tol_mode="ppm")
+    assert hit.tolist() == [True, False, False]
+
+
+def test_fraction_of_by_perfect_and_noise():
+    seq = "VLHPLEGAVVIIFK"
+    frags = fr.fragment_mzs(seq, "by", max_charge=1)
+    frags = frags[(frags > 100) & (frags < 1400)]
+    inten = np.ones_like(frags)
+    f = fr.fraction_of_by(seq, 779.48, 2, frags, inten)
+    assert f == pytest.approx(1.0)
+    # peaks far from any fragment annotate nothing
+    noise = frags + 5.0
+    f0 = fr.fraction_of_by(seq, 779.48, 2, noise, np.ones_like(noise))
+    assert f0 < 0.2
+
+
+def test_fraction_of_by_invalid_sequence():
+    assert fr.fraction_of_by("XX1", 500.0, 2, np.array([100.0]), np.array([1.0])) == 0.0
+
+
+def test_fraction_of_by_precursor_removed():
+    seq = "PEPTIDEK"
+    pmz = (fr.peptide_mass(seq) + 2 * fr.PROTON_MASS) / 2
+    mz = np.array([pmz])  # only the precursor peak, removed in preprocessing
+    assert fr.fraction_of_by(seq, pmz, 2, mz, np.array([100.0])) == 0.0

@@ -1,0 +1,156 @@
+"""MGF reader/writer and TSV ingest tests.
+
+Fixture records follow the clustered-MGF interchange contract of
+ref file_formats.md:3-53.
+"""
+
+import numpy as np
+import pytest
+
+from specpride_tpu.data.peaks import (
+    Spectrum,
+    build_title,
+    group_into_clusters,
+    parse_title,
+    peptide_from_usi,
+    scan_from_usi,
+)
+from specpride_tpu.io.maracluster import read_maracluster_clusters, scan_to_cluster
+from specpride_tpu.io.maxquant import read_msms_peptides, read_msms_scores
+from specpride_tpu.io.mgf import IndexedMGF, read_mgf, write_mgf
+
+MGF_TEXT = """\
+BEGIN IONS
+TITLE=cluster-1;mzspec:PXD004732:run1.raw:scan:17555:VLHPLEGAVVIIFK/2
+PEPMASS=318.185
+CHARGE=2+
+RTINSECONDS=1234.5
+1.5 8.84
+97.999 1.1
+132.017 445.98
+END IONS
+
+BEGIN IONS
+TITLE=cluster-1;mzspec:PXD004732:run1.raw:scan:17556
+PEPMASS=318.19
+CHARGE=2+
+132.02 400.0
+169.955 4235.4
+END IONS
+
+BEGIN IONS
+TITLE=cluster-2;mzspec:PXD004732:run1.raw:scan:99
+PEPMASS=500.25
+CHARGE=3+
+100.5 1.0
+END IONS
+"""
+
+
+@pytest.fixture
+def mgf_file(tmp_path):
+    p = tmp_path / "test.mgf"
+    p.write_text(MGF_TEXT)
+    return p
+
+
+def test_read_mgf(mgf_file):
+    spectra = read_mgf(mgf_file, use_native=False)
+    assert len(spectra) == 3
+    s = spectra[0]
+    assert s.cluster_id == "cluster-1"
+    assert s.usi == "mzspec:PXD004732:run1.raw:scan:17555:VLHPLEGAVVIIFK/2"
+    assert s.precursor_mz == pytest.approx(318.185)
+    assert s.precursor_charge == 2
+    assert s.rt == pytest.approx(1234.5)
+    np.testing.assert_allclose(s.mz, [1.5, 97.999, 132.017])
+    np.testing.assert_allclose(s.intensity, [8.84, 1.1, 445.98])
+    assert spectra[2].precursor_charge == 3
+
+
+def test_roundtrip(mgf_file, tmp_path):
+    spectra = read_mgf(mgf_file, use_native=False)
+    out = tmp_path / "out.mgf"
+    write_mgf(spectra, out)
+    again = read_mgf(out, use_native=False)
+    assert len(again) == 3
+    for a, b in zip(spectra, again):
+        np.testing.assert_allclose(a.mz, b.mz)
+        np.testing.assert_allclose(a.intensity, b.intensity)
+        assert a.title == b.title
+        assert a.precursor_charge == b.precursor_charge
+
+
+def test_append_mode(mgf_file, tmp_path):
+    spectra = read_mgf(mgf_file, use_native=False)
+    out = tmp_path / "out.mgf"
+    write_mgf(spectra[:1], out)
+    write_mgf(spectra[1:], out, append=True)
+    assert len(read_mgf(out, use_native=False)) == 3
+
+
+def test_nan_peaks_skipped(tmp_path):
+    s = Spectrum(
+        mz=[100.0, 200.0], intensity=[1.0, np.nan], title="c", precursor_mz=1.0,
+        precursor_charge=2,
+    )
+    out = tmp_path / "nan.mgf"
+    write_mgf([s], out)
+    again = read_mgf(out, use_native=False)[0]
+    assert again.n_peaks == 1
+
+
+def test_indexed_mgf(mgf_file):
+    idx = IndexedMGF(mgf_file)
+    assert len(idx) == 3
+    titles = idx.titles
+    assert titles[0].startswith("cluster-1;")
+    s = idx[titles[1]]
+    np.testing.assert_allclose(s.mz, [132.02, 169.955])
+    batch = idx[titles[:2]]
+    assert len(batch) == 2
+
+
+def test_group_into_clusters(mgf_file):
+    clusters = group_into_clusters(read_mgf(mgf_file, use_native=False))
+    assert [c.cluster_id for c in clusters] == ["cluster-1", "cluster-2"]
+    assert clusters[0].n_members == 2
+
+
+def test_title_helpers():
+    t = build_title("cluster-7", "PXD1", "run.raw", 42, "PEPTIDE", 2)
+    assert t == "cluster-7;mzspec:PXD1:run.raw:scan:42:PEPTIDE/2"
+    cid, usi = parse_title(t)
+    assert cid == "cluster-7"
+    assert scan_from_usi(usi) == 42
+    assert peptide_from_usi(usi) == ("PEPTIDE", 2)
+    assert parse_title("cluster-1") == ("cluster-1", "")
+
+
+def test_maracluster(tmp_path):
+    p = tmp_path / "clusters.tsv"
+    p.write_text(
+        "run1\t10\t0.9\nrun1\t11\t0.8\n\nrun1\t20\t0.7\n\nrun1\t30\t0.5\n"
+    )
+    clusters = read_maracluster_clusters(p)
+    assert clusters == [[10, 11], [20], [30]]
+    mapping = scan_to_cluster(p)
+    assert mapping == {10: "cluster-1", 11: "cluster-1", 20: "cluster-2", 30: "cluster-3"}
+
+
+def test_maxquant(tmp_path):
+    p = tmp_path / "msms.txt"
+    header = "\t".join(
+        ["Raw file", "Scan number", "a", "b", "c", "d", "e", "Modified sequence", "Score"]
+    )
+    rows = [
+        "\t".join(["run1", "10", "", "", "", "", "", "_PEPTIDE_", "95.5"]),
+        "\t".join(["run1", "11", "", "", "", "", "", "_AAAK_", "10.0"]),
+        "\t".join(["run1", "11", "", "", "", "", "", "_AAAK_", "20.0"]),
+    ]
+    p.write_text(header + "\n" + "\n".join(rows) + "\n")
+    scores = read_msms_scores(p, px_accession="PXD1")
+    assert scores["mzspec:PXD1:run1.raw::scan:10"] == 95.5
+    assert scores["mzspec:PXD1:run1.raw::scan:11"] == 20.0
+    peptides = read_msms_peptides(p)
+    assert peptides == {10: "PEPTIDE", 11: "AAAK"}

@@ -1,0 +1,312 @@
+"""Golden tests for the NumPy oracle backend — hand-computed expectations
+pinning the reference semantics (survey §4 test plan item a)."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from specpride_tpu.backends import numpy_backend as nb
+from specpride_tpu.config import (
+    BinMeanConfig,
+    CosineConfig,
+    GapAverageConfig,
+    MedoidConfig,
+)
+from specpride_tpu.data.peaks import Cluster, Spectrum
+
+
+def spec(mz, inten, pmz=500.0, z=2, rt=0.0, title="cluster-1;usi:1"):
+    return Spectrum(
+        mz=np.array(mz, dtype=float),
+        intensity=np.array(inten, dtype=float),
+        precursor_mz=pmz,
+        precursor_charge=z,
+        rt=rt,
+        title=title,
+    )
+
+
+# ---------------------------------------------------------------------------
+# bin-mean (ref src/binning.py:170-231)
+# ---------------------------------------------------------------------------
+
+class TestBinMean:
+    def test_quorum_golden(self):
+        members = [
+            spec([100.005, 150.01], [10, 20]),
+            spec([100.015, 200.0], [30, 40]),
+            spec([100.009], [50]),
+            spec([500.0], [60]),
+        ]
+        out = nb.bin_mean_consensus(members, BinMeanConfig(), "cluster-1")
+        # quorum = int(4*0.25)+1 = 2: only bin 0 (3 contributors) survives
+        assert out.n_peaks == 1
+        assert out.intensity[0] == pytest.approx((10 + 30 + 50) / 3, rel=1e-6)
+        assert out.mz[0] == pytest.approx((100.005 + 100.015 + 100.009) / 3, rel=1e-6)
+        assert out.precursor_mz == pytest.approx(500.0)
+        assert out.precursor_charge == 2
+        assert out.title == "cluster-1"
+
+    def test_duplicate_bin_last_wins(self):
+        # numpy fancy += : within one member, the last peak in a bin wins
+        # (ref src/binning.py:197-199)
+        members = [
+            spec([100.001, 100.002], [5, 7]),
+            spec([100.005], [9]),
+        ]
+        out = nb.bin_mean_consensus(members, BinMeanConfig())
+        assert out.n_peaks == 1
+        assert out.intensity[0] == pytest.approx((7 + 9) / 2)
+        assert out.mz[0] == pytest.approx((100.002 + 100.005) / 2)
+
+    def test_range_mask(self):
+        # peaks outside [min_mz, max_mz) are dropped (ref src/binning.py:191-192)
+        members = [spec([50.0, 2000.0, 150.0], [1, 2, 3])]
+        out = nb.bin_mean_consensus(members, BinMeanConfig(apply_peak_quorum=False))
+        assert out.n_peaks == 1
+        assert out.mz[0] == pytest.approx(150.0)
+
+    def test_mixed_charges_raise(self):
+        members = [spec([150.0], [1], z=2), spec([150.0], [1], z=3)]
+        with pytest.raises(ValueError, match="charges"):
+            nb.bin_mean_consensus(members)
+
+    def test_quorum_disabled(self):
+        members = [spec([150.0], [10]), spec([900.0], [20]), spec([901.0], [5]),
+                   spec([902.0], [5])]
+        out = nb.bin_mean_consensus(members, BinMeanConfig(apply_peak_quorum=False))
+        assert out.n_peaks == 4
+
+
+# ---------------------------------------------------------------------------
+# gap-average (ref src/average_spectrum_clustering.py:26-103)
+# ---------------------------------------------------------------------------
+
+class TestGapAverage:
+    def members(self):
+        return [
+            spec([100.0, 100.005, 200.0], [10, 20, 30]),
+            spec([100.002, 300.0], [40, 50]),
+        ]
+
+    def test_reference_tail_merges_last_groups(self):
+        out = nb.gap_average_consensus(self.members(), GapAverageConfig())
+        # gaps at positions [3, 4]; reference mode drops the final gap:
+        # groups [0,3) and [3,5)
+        np.testing.assert_allclose(
+            out.mz, [(100.0 + 100.002 + 100.005) / 3, (200.0 + 300.0) / 2]
+        )
+        np.testing.assert_allclose(out.intensity, [35.0, 40.0])
+
+    def test_split_tail_honours_every_gap(self):
+        out = nb.gap_average_consensus(
+            self.members(), GapAverageConfig(tail_mode="split")
+        )
+        np.testing.assert_allclose(
+            out.mz, [(100.0 + 100.002 + 100.005) / 3, 200.0, 300.0]
+        )
+        np.testing.assert_allclose(out.intensity, [35.0, 15.0, 25.0])
+
+    def test_min_fraction_quorum(self):
+        # min_fraction=1.0 → group must contain >= n_members peaks
+        out = nb.gap_average_consensus(
+            self.members(), GapAverageConfig(min_fraction=1.0, tail_mode="split")
+        )
+        # only the 3-peak group passes (3 >= 2); singleton groups fail
+        np.testing.assert_allclose(out.intensity, [35.0])
+
+    def test_dyn_range(self):
+        members = [
+            spec([100.0, 500.0], [10000.0, 1.0]),
+            spec([100.004, 500.004], [10000.0, 1.0]),
+        ]
+        out = nb.gap_average_consensus(
+            members, GapAverageConfig(dyn_range=1000.0, tail_mode="split")
+        )
+        # group intensities: 10000 and 1; floor = 10000/1000 = 10 → drop 1
+        np.testing.assert_allclose(out.intensity, [10000.0])
+
+    def test_singleton_passthrough(self):
+        # ref src/average_spectrum_clustering.py:88-90
+        s = spec([100.0, 200.0], [5.0, 6.0])
+        out = nb.gap_average_consensus([s], GapAverageConfig())
+        np.testing.assert_allclose(out.mz, s.mz)
+        np.testing.assert_allclose(out.intensity, s.intensity)
+
+    def test_no_gaps_single_group(self):
+        # divergence: reference IndexErrors when no gap exists
+        members = [spec([100.0], [10.0]), spec([100.004], [20.0])]
+        out = nb.gap_average_consensus(members, GapAverageConfig())
+        np.testing.assert_allclose(out.mz, [100.002])
+        np.testing.assert_allclose(out.intensity, [15.0])
+
+
+class TestEstimators:
+    def members(self):
+        return [
+            spec([100.0], [1.0], pmz=500.0, z=2, rt=10.0),
+            spec([100.0], [1.0], pmz=500.2, z=2, rt=20.0),
+            spec([100.0], [1.0], pmz=334.0, z=3, rt=30.0),
+        ]
+
+    def test_naive_average_mixed_charge_raises(self):
+        with pytest.raises(ValueError):
+            nb.naive_average_mass_and_charge(self.members())
+
+    def test_naive_average(self):
+        m = self.members()[:2]
+        mz, z = nb.naive_average_mass_and_charge(m)
+        assert mz == pytest.approx(500.1)
+        assert z == 2
+
+    def test_neutral_average(self):
+        m = self.members()
+        masses, charges = nb._neutral_masses(m)
+        expected_z = int(round(np.mean(charges)))
+        expected = (np.mean(masses) + expected_z * nb.PROTON_MASS) / expected_z
+        mz, z = nb.neutral_average_mass_and_charge(m)
+        assert z == expected_z
+        assert mz == pytest.approx(expected)
+
+    def test_lower_median(self):
+        m = self.members()
+        masses, _ = nb._neutral_masses(m)
+        # neutral masses: 2*500-2H≈998, 2*500.2-2H≈998.4, 3*334-3H≈999
+        # sorted rank (3-1)//2 = 1 → the 998.4 member (z=2, rt=20)
+        mz, z = nb.lower_median_mass_and_charge(m)
+        assert z == 2
+        assert mz == pytest.approx(500.2)
+        assert nb.lower_median_mass_rt(m) == pytest.approx(20.0)
+
+    def test_median_rt(self):
+        assert nb.median_rt(self.members()) == pytest.approx(20.0)
+
+
+# ---------------------------------------------------------------------------
+# medoid (ref src/most_similar_representative.py)
+# ---------------------------------------------------------------------------
+
+class TestMedoid:
+    def test_xcorr_identity(self):
+        s = spec([100.01, 200.01], [1, 1])
+        assert nb.xcorr_prescore(s, s) == pytest.approx(1.0)
+
+    def test_xcorr_partial(self):
+        s0 = spec([100.01, 200.01], [1, 1])
+        s2 = spec([100.01, 300.0], [1, 1])
+        assert nb.xcorr_prescore(s0, s2) == pytest.approx(0.5)
+
+    def test_xcorr_empty(self):
+        s0 = spec([100.01], [1])
+        empty = spec([], [])
+        assert nb.xcorr_prescore(s0, empty) == 0.0
+
+    def test_xcorr_dedup_occupancy(self):
+        # two peaks in one 0.1 Da bin occupy it once
+        s1 = spec([100.01, 100.02], [1, 1])
+        s2 = spec([100.03], [1])
+        assert nb.xcorr_prescore(s1, s2) == pytest.approx(1.0)  # 1 shared / min(2,1)
+
+    def test_medoid_golden(self):
+        members = [
+            spec([100.01, 200.01], [1, 1]),
+            spec([100.02, 200.09], [1, 1]),
+            spec([100.01, 300.0], [1, 1]),
+        ]
+        assert nb.medoid_index(members, MedoidConfig()) == 0
+
+    def test_medoid_singleton(self):
+        assert nb.medoid_index([spec([1.0], [1.0])]) == 0
+
+    def test_medoid_tie_lowest_index(self):
+        a = spec([100.01], [1])
+        assert nb.medoid_index([a, a]) == 0
+
+
+# ---------------------------------------------------------------------------
+# best spectrum (ref src/best_spectrum.py)
+# ---------------------------------------------------------------------------
+
+class TestBestSpectrum:
+    def members(self):
+        return [
+            spec([100.0], [1.0], title="cluster-1;usi:a"),
+            spec([100.0], [1.0], title="cluster-1;usi:b"),
+            spec([100.0], [1.0], title="cluster-1;usi:c"),
+        ]
+
+    def test_highest_score(self):
+        scores = {"usi:a": 1.0, "usi:b": 9.0, "usi:c": 5.0}
+        assert nb.best_spectrum_index(self.members(), scores) == 1
+
+    def test_no_scores_raises(self):
+        with pytest.raises(ValueError):
+            nb.best_spectrum_index(self.members(), {"other": 1.0})
+
+    def test_tie_lexicographic_usi(self):
+        scores = {"usi:c": 9.0, "usi:b": 9.0}
+        assert nb.best_spectrum_index(self.members(), scores) == 1
+
+    def test_usi_normalization_join(self):
+        # MaxQuant-side USIs carry '::scan:' (ref src/best_spectrum.py:61-62)
+        # while converter titles use ':scan:' and may carry ':PEPTIDE/z';
+        # the join must still match (reference latent bug, fixed here)
+        members = [
+            spec([1.0], [1.0], title="c;mzspec:PXD1:run1.raw:scan:10:PEP/2"),
+            spec([1.0], [1.0], title="c;mzspec:PXD1:run1.raw:scan:11"),
+        ]
+        scores = {
+            "mzspec:PXD1:run1.raw::scan:10": 5.0,
+            "mzspec:PXD1:run1.raw::scan:11": 50.0,
+        }
+        assert nb.best_spectrum_index(members, scores) == 1
+
+    def test_scoreless_cluster_dropped(self):
+        clusters = [
+            Cluster("cluster-1", self.members()),
+            Cluster("cluster-2", [spec([1.0], [1.0], title="cluster-2;usi:x")]),
+        ]
+        out = nb.run_best_spectrum(clusters, {"usi:a": 1.0})
+        assert len(out) == 1
+        assert out[0].usi == "usi:a"
+
+
+# ---------------------------------------------------------------------------
+# cosine metric (ref src/benchmark.py:11-38)
+# ---------------------------------------------------------------------------
+
+class TestCosine:
+    def test_self_similarity_is_one(self, rng):
+        # the reference's only self-test invariant (ref src/benchmark.py:80)
+        mz = np.sort(rng.uniform(100, 1500, size=80))
+        s = spec(mz, rng.uniform(1, 100, size=80))
+        assert nb.binned_cosine(s, s) == pytest.approx(1.0)
+
+    def test_disjoint_is_zero(self):
+        a = spec([100.0, 200.0], [1, 1])
+        b = spec([500.0, 600.0], [1, 1])
+        assert nb.binned_cosine(a, b) == pytest.approx(0.0)
+
+    def test_matches_scipy_binned_statistic(self, rng):
+        # cross-check our floor-binning against the reference's scipy grid
+        cfg = CosineConfig()
+        for _ in range(5):
+            a = spec(np.sort(rng.uniform(100, 1400, 60)), rng.uniform(1, 100, 60))
+            b = spec(np.sort(rng.uniform(100, 1400, 50)), rng.uniform(1, 100, 50))
+            max_mz = max(a.mz[-1], b.mz[-1])
+            edges = np.arange(-cfg.mz_space / 2.0, max_mz, cfg.mz_space)
+            va, _, _ = scipy.stats.binned_statistic(
+                a.mz, a.intensity, statistic="sum", bins=edges
+            )
+            vb, _, _ = scipy.stats.binned_statistic(
+                b.mz, b.intensity, statistic="sum", bins=edges
+            )
+            va, vb = np.nan_to_num(va), np.nan_to_num(vb)
+            expected = va @ vb / np.sqrt((va @ va) * (vb @ vb))
+            assert nb.binned_cosine(a, b, cfg) == pytest.approx(expected, rel=1e-9)
+
+    def test_average_cosine(self, rng):
+        mz = np.sort(rng.uniform(100, 1000, 40))
+        s = spec(mz, rng.uniform(1, 10, 40))
+        assert nb.average_cosine(s, [s, s]) == pytest.approx(1.0)
+        assert nb.average_cosine(s, []) == 0.0
