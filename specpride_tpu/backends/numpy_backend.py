@@ -21,6 +21,15 @@ from specpride_tpu.data.peaks import Cluster, Spectrum
 from specpride_tpu.ops.fragments import PROTON_MASS
 
 
+def check_uniform_charge(members: list[Spectrum]) -> None:
+    """All precursor charges in a cluster must be equal (ref
+    src/binning.py:206 assert → ValueError here).  Shared by the numpy and
+    TPU bin-mean drivers so the rule lives in exactly one place."""
+    charges = [s.precursor_charge for s in members]
+    if any(z != charges[0] for z in charges):
+        raise ValueError("Not all precursor charges in cluster are equal")
+
+
 # ---------------------------------------------------------------------------
 # C1: binned-mean consensus (ref src/binning.py:170-231 combine_bin_mean)
 # ---------------------------------------------------------------------------
@@ -50,9 +59,8 @@ def bin_mean_consensus(
     inten_sum = np.zeros(n_bins, dtype=np.float32)
     mz_sum = np.zeros(n_bins, dtype=np.float32)
 
+    check_uniform_charge(members)
     charges = [s.precursor_charge for s in members]
-    if any(z != charges[0] for z in charges):
-        raise ValueError("Not all precursor charges in cluster are equal")
 
     for s in members:
         keep = (s.mz >= config.min_mz) & (s.mz < config.max_mz)
@@ -221,6 +229,17 @@ RT_ESTIMATORS = {
     "median": median_rt,
     "mass_lower_median": lower_median_mass_rt,
 }
+
+
+def resolve_gap_estimators(config: GapAverageConfig):
+    """(pepmass_fn, rt_fn) for a GapAverageConfig, including the coupled rule
+    that lower_median pepmass forces the lower-median-mass member's RT
+    (ref src/average_spectrum_clustering.py:190-191).  Shared by the numpy
+    and TPU drivers so the override lives in exactly one place."""
+    rt_mode = config.rt
+    if config.pepmass == "lower_median":
+        rt_mode = "mass_lower_median"
+    return PEPMASS_ESTIMATORS[config.pepmass], RT_ESTIMATORS[rt_mode]
 
 
 # ---------------------------------------------------------------------------
@@ -392,13 +411,7 @@ def run_gap_average(
     clusters: list[Cluster], config: GapAverageConfig = GapAverageConfig()
 ) -> list[Spectrum]:
     """Per-cluster loop of ref src/average_spectrum_clustering.py:158-164."""
-    get_pepmass = PEPMASS_ESTIMATORS[config.pepmass]
-    rt_mode = config.rt
-    if config.pepmass == "lower_median":
-        # ref src/average_spectrum_clustering.py:190-191: lower_median pepmass
-        # forces the lower-median-mass member's RT
-        rt_mode = "mass_lower_median"
-    get_rt = RT_ESTIMATORS[rt_mode]
+    get_pepmass, get_rt = resolve_gap_estimators(config)
     out = []
     for c in clusters:
         mz, z = get_pepmass(c.members)

@@ -1,0 +1,280 @@
+"""TPU execution backend: drives the device kernels over bucketed batches.
+
+Mirrors the numpy-oracle driver API (``backends.numpy_backend.run_*``) with
+the same semantics, but executes each padded ``ClusterBatch`` as one jitted
+XLA program on the default JAX backend (TPU on real hardware; CPU — incl. a
+forced multi-device CPU mesh — in tests).  Host responsibilities: float64
+m/z quantization (``ops.quantize``), precursor/RT estimators, unpadding, and
+reassembly into the caller's original cluster order.
+
+Memory is bounded by chunking each batch along the cluster axis so that the
+largest on-device intermediate (the (B, n_bins) consensus grids or the
+(B, M, grid) occupancy tensors) stays under ``max_grid_elements``; the final
+chunk is zero-padded to the chunk shape so every chunk of a batch reuses one
+compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from specpride_tpu.config import (
+    BatchConfig,
+    BestSpectrumConfig,
+    BinMeanConfig,
+    CosineConfig,
+    GapAverageConfig,
+    MedoidConfig,
+)
+from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.data.ragged import ClusterBatch, bucketize_clusters
+from specpride_tpu.ops import quantize
+from specpride_tpu.backends import numpy_backend
+
+
+def _chunk_ranges(b: int, chunk: int):
+    for start in range(0, b, chunk):
+        yield start, min(start + chunk, b)
+
+
+def _check_no_empty(clusters: list[Cluster]) -> None:
+    """Zero-member clusters are rejected up front on every device driver so
+    bucket-skipping can never silently misalign outputs against inputs (the
+    numpy oracle raises for gap-average and medoid; for bin-mean it returns a
+    degenerate NaN-precursor spectrum — we raise there too, documented
+    divergence)."""
+    for c in clusters:
+        if c.n_members == 0:
+            raise ValueError(f"empty cluster {c.cluster_id!r}")
+
+
+def _pad_axis0(arr: np.ndarray, size: int) -> np.ndarray:
+    if arr.shape[0] == size:
+        return arr
+    pad = [(0, size - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+@dataclasses.dataclass
+class TpuBackend:
+    """Device-execution backend (``--backend=tpu``).
+
+    ``batch_config`` controls bucketing; ``max_grid_elements`` bounds the
+    largest device intermediate per dispatch (default ~64M f32 = 256 MB).
+    """
+
+    batch_config: BatchConfig = dataclasses.field(default_factory=BatchConfig)
+    max_grid_elements: int = 64 * 1024 * 1024
+
+    # -- binned-mean consensus (K1) -------------------------------------
+
+    def run_bin_mean(
+        self, clusters: list[Cluster], config: BinMeanConfig = BinMeanConfig()
+    ) -> list[Spectrum]:
+        """Batched equivalent of ref src/binning.py:291-297."""
+        from specpride_tpu.ops.binning import bin_mean_batch
+
+        _check_no_empty(clusters)
+        for c in clusters:
+            numpy_backend.check_uniform_charge(c.members)
+
+        out: list[Spectrum | None] = [None] * len(clusters)
+        for batch in bucketize_clusters(clusters, self.batch_config):
+            bins = quantize.bin_mean_bins(batch, config)
+            b, m, p = batch.shape
+            out_size = min(m * p, config.n_bins)
+            # largest per-cluster intermediate: the (n_bins,) grids or the
+            # flattened (m*p,) sort/mask arrays, whichever is bigger
+            chunk = max(
+                1, self.max_grid_elements // max(config.n_bins, m * p, 1)
+            )
+            for lo, hi in _chunk_ranges(b, chunk):
+                size = min(chunk, b)
+                mzs, intens, n_out, prec = bin_mean_batch(
+                    _pad_axis0(batch.mz[lo:hi], size),
+                    _pad_axis0(batch.intensity[lo:hi], size),
+                    _pad_axis0(bins[lo:hi], size),
+                    _pad_axis0(batch.member_mask[lo:hi], size),
+                    _pad_axis0(batch.n_members[lo:hi], size),
+                    _pad_axis0(batch.precursor_mz[lo:hi], size),
+                    config,
+                    out_size,
+                )
+                mzs = np.asarray(mzs)
+                intens = np.asarray(intens)
+                n_out = np.asarray(n_out)
+                prec = np.asarray(prec)
+                for ci in range(hi - lo):
+                    k = int(n_out[ci])
+                    gi = batch.source_indices[lo + ci]
+                    charge = int(
+                        batch.precursor_charge[lo + ci][
+                            batch.member_mask[lo + ci]
+                        ][0]
+                    )
+                    out[gi] = Spectrum(
+                        mz=mzs[ci, :k].astype(np.float64),
+                        intensity=intens[ci, :k].astype(np.float64),
+                        precursor_mz=float(prec[ci]),
+                        precursor_charge=charge,
+                        title=batch.cluster_ids[lo + ci],
+                    )
+        return [s for s in out if s is not None]
+
+    # -- gap-average consensus (K3) -------------------------------------
+
+    def run_gap_average(
+        self,
+        clusters: list[Cluster],
+        config: GapAverageConfig = GapAverageConfig(),
+    ) -> list[Spectrum]:
+        """Batched equivalent of ref src/average_spectrum_clustering.py:158-164;
+        precursor/RT estimators run host-side (tiny, O(members))."""
+        from specpride_tpu.ops.gap_average import gap_average_batch
+
+        _check_no_empty(clusters)
+        get_pepmass, get_rt = numpy_backend.resolve_gap_estimators(config)
+
+        out: list[Spectrum | None] = [None] * len(clusters)
+        for batch in bucketize_clusters(clusters, self.batch_config):
+            b, m, p = batch.shape
+            chunk = max(1, self.max_grid_elements // max(m * p * 4, 1))
+            for lo, hi in _chunk_ranges(b, chunk):
+                size = min(chunk, b)
+                mzs, intens, n_out = gap_average_batch(
+                    _pad_axis0(batch.mz[lo:hi], size),
+                    _pad_axis0(batch.intensity[lo:hi], size),
+                    _pad_axis0(batch.peak_mask[lo:hi], size),
+                    _pad_axis0(batch.member_mask[lo:hi], size),
+                    _pad_axis0(batch.n_members[lo:hi], size),
+                    config,
+                )
+                mzs = np.asarray(mzs)
+                intens = np.asarray(intens)
+                n_out = np.asarray(n_out)
+                for ci in range(hi - lo):
+                    k = int(n_out[ci])
+                    gi = batch.source_indices[lo + ci]
+                    members = clusters[gi].members
+                    pep_mz, pep_z = get_pepmass(members)
+                    out[gi] = Spectrum(
+                        mz=mzs[ci, :k].astype(np.float64),
+                        intensity=intens[ci, :k].astype(np.float64),
+                        precursor_mz=pep_mz,
+                        precursor_charge=pep_z,
+                        rt=get_rt(members),
+                        title=batch.cluster_ids[lo + ci],
+                    )
+        return [s for s in out if s is not None]
+
+    # -- medoid representative (K2) -------------------------------------
+
+    def medoid_indices(
+        self, clusters: list[Cluster], config: MedoidConfig = MedoidConfig()
+    ) -> list[int]:
+        """Per-cluster medoid member index (ref
+        src/most_similar_representative.py:87-110 semantics)."""
+        from specpride_tpu.ops.similarity import medoid_finalize, shared_bins_batch
+
+        _check_no_empty(clusters)
+        out: list[int] = [0] * len(clusters)
+        for batch in bucketize_clusters(clusters, self.batch_config):
+            bins, grid = quantize.medoid_bins(batch, config)
+            b, m, p = batch.shape
+            chunk = max(1, self.max_grid_elements // max(m * grid, 1))
+            for lo, hi in _chunk_ranges(b, chunk):
+                size = min(chunk, b)
+                shared = np.asarray(
+                    shared_bins_batch(_pad_axis0(bins[lo:hi], size), grid)
+                )[: hi - lo]
+                idx = medoid_finalize(
+                    shared,
+                    batch.n_peaks[lo:hi],
+                    batch.member_mask[lo:hi],
+                    batch.n_members[lo:hi],
+                )
+                for ci in range(hi - lo):
+                    out[batch.source_indices[lo + ci]] = int(idx[ci])
+        return out
+
+    def run_medoid(
+        self, clusters: list[Cluster], config: MedoidConfig = MedoidConfig()
+    ) -> list[Spectrum]:
+        indices = self.medoid_indices(clusters, config)
+        return [c.members[i] for c, i in zip(clusters, indices)]
+
+    # -- best-spectrum representative (host-only; ref src/best_spectrum.py) --
+
+    def run_best_spectrum(
+        self,
+        clusters: list[Cluster],
+        scores: dict[str, float],
+        config: BestSpectrumConfig = BestSpectrumConfig(),
+    ) -> list[Spectrum]:
+        """Pure join/argmax — negligible compute, host-side by design
+        (survey §3.4)."""
+        return numpy_backend.run_best_spectrum(clusters, scores, config)
+
+    # -- quality metrics (K2 cosine) ------------------------------------
+
+    def average_cosines(
+        self,
+        representatives: list[Spectrum],
+        clusters: list[Cluster],
+        config: CosineConfig = CosineConfig(),
+    ) -> np.ndarray:
+        """Mean binned cosine of each representative to its cluster's members
+        (ref src/benchmark.py:31-38), one device pass per bucket shape."""
+        from specpride_tpu.ops.similarity import cosine_rep_vs_members
+
+        if len(representatives) != len(clusters):
+            raise ValueError("representatives and clusters must align")
+        _check_no_empty(clusters)
+        out = np.zeros((len(clusters),), dtype=np.float64)
+        for batch in bucketize_clusters(clusters, self.batch_config):
+            idxs = batch.source_indices
+            b, m, p = batch.shape
+            pr_raw = max(
+                max((representatives[i].n_peaks for i in idxs), default=1), 1
+            )
+            # bucket the rep-peak axis (multiple of 128) so the jitted pair
+            # kernel compiles once per bucket shape, not once per batch
+            pr = ((pr_raw + 127) // 128) * 128
+            rep_mz = np.zeros((b, pr), np.float64)
+            rep_int = np.zeros((b, pr), np.float32)
+            rep_valid = np.zeros((b, pr), bool)
+            for ci, gi in enumerate(idxs):
+                r = representatives[gi]
+                k = r.n_peaks
+                rep_mz[ci, :k] = r.mz
+                rep_int[ci, :k] = r.intensity
+                rep_valid[ci, :k] = True
+            rep_bins, rep_edges = quantize.cosine_bins(rep_mz, rep_valid, config)
+            mem_valid = batch.peak_mask & batch.member_mask[:, :, None]
+            mem_bins, mem_edges = quantize.cosine_bins(
+                batch.mz64, mem_valid, config
+            )
+            mem_int = batch.intensity  # already float32
+
+            # per-cluster pair workspace: ~m concatenated (pr+p) key/value
+            # arrays plus sort scratch
+            per_cluster = m * (pr + p) * 8
+            chunk = max(1, self.max_grid_elements // max(per_cluster, 1))
+            for lo, hi in _chunk_ranges(b, chunk):
+                size = min(chunk, b)
+                mean, _ = cosine_rep_vs_members(
+                    _pad_axis0(rep_bins[lo:hi], size),
+                    _pad_axis0(rep_int[lo:hi], size),
+                    _pad_axis0(rep_edges[lo:hi], size),
+                    _pad_axis0(mem_bins[lo:hi], size),
+                    _pad_axis0(mem_int[lo:hi], size),
+                    _pad_axis0(mem_edges[lo:hi], size),
+                    _pad_axis0(batch.member_mask[lo:hi], size),
+                    _pad_axis0(batch.n_members[lo:hi], size),
+                )
+                mean = np.asarray(mean)
+                for ci in range(hi - lo):
+                    out[idxs[lo + ci]] = float(mean[ci])
+        return out

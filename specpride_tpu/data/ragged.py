@@ -34,6 +34,8 @@ class ClusterBatch:
     """
 
     mz: np.ndarray  # (B, M, P) float32
+    mz64: np.ndarray  # (B, M, P) float64 — HOST-ONLY: exact m/z for f64
+    # quantization (ops.quantize); never shipped to device
     intensity: np.ndarray  # (B, M, P) float32
     peak_mask: np.ndarray  # (B, M, P) bool
     member_mask: np.ndarray  # (B, M) bool
@@ -43,6 +45,9 @@ class ClusterBatch:
     n_members: np.ndarray  # (B,) int32
     n_peaks: np.ndarray  # (B, M) int32
     cluster_ids: list[str]  # length B (host-only metadata)
+    source_indices: list[int] = dataclasses.field(default_factory=list)
+    # position of each cluster in the caller's original sequence (host-only;
+    # lets drivers reassemble bucket-shuffled outputs into input order)
 
     @property
     def shape(self) -> tuple[int, int, int]:
@@ -73,10 +78,16 @@ def _bucket_for(value: int, buckets: Sequence[int]) -> int:
     return buckets[min(i, len(buckets) - 1)]
 
 
-def pad_clusters(clusters: Sequence[Cluster], n_members: int, n_peaks: int) -> ClusterBatch:
+def pad_clusters(
+    clusters: Sequence[Cluster],
+    n_members: int,
+    n_peaks: int,
+    source_indices: Sequence[int] | None = None,
+) -> ClusterBatch:
     """Pad a homogeneous group of clusters into one dense ClusterBatch."""
     b = len(clusters)
     mz = np.zeros((b, n_members, n_peaks), dtype=np.float32)
+    mz64 = np.zeros((b, n_members, n_peaks), dtype=np.float64)
     intensity = np.zeros((b, n_members, n_peaks), dtype=np.float32)
     peak_mask = np.zeros((b, n_members, n_peaks), dtype=bool)
     member_mask = np.zeros((b, n_members), dtype=bool)
@@ -101,6 +112,7 @@ def pad_clusters(clusters: Sequence[Cluster], n_members: int, n_peaks: int) -> C
                     f"peaks > peak bucket {n_peaks}"
                 )
             mz[ci, mi, :k] = s.mz[:k]
+            mz64[ci, mi, :k] = s.mz[:k]
             intensity[ci, mi, :k] = s.intensity[:k]
             peak_mask[ci, mi, :k] = True
             member_mask[ci, mi] = True
@@ -111,6 +123,7 @@ def pad_clusters(clusters: Sequence[Cluster], n_members: int, n_peaks: int) -> C
 
     return ClusterBatch(
         mz=mz,
+        mz64=mz64,
         intensity=intensity,
         peak_mask=peak_mask,
         member_mask=member_mask,
@@ -120,6 +133,9 @@ def pad_clusters(clusters: Sequence[Cluster], n_members: int, n_peaks: int) -> C
         n_members=n_members_arr,
         n_peaks=n_peaks_arr,
         cluster_ids=[c.cluster_id for c in clusters],
+        source_indices=(
+            list(source_indices) if source_indices is not None else list(range(b))
+        ),
     )
 
 
@@ -133,11 +149,12 @@ def bucketize_clusters(
     defined singleton behaviour (passthrough — ref
     src/average_spectrum_clustering.py:88-90,
     src/most_similar_representative.py:79-81), so they ride the same path.
-    Order within a bucket is preserved; callers that need global output order
-    should reorder by cluster id afterwards.
+    Order within a bucket is preserved; each batch records the position of
+    its clusters in the input sequence (``ClusterBatch.source_indices``) so
+    callers can reassemble outputs into input order.
     """
-    buckets: dict[tuple[int, int], list[Cluster]] = {}
-    for c in clusters:
+    buckets: dict[tuple[int, int], list[tuple[int, Cluster]]] = {}
+    for i, c in enumerate(clusters):
         if c.n_members == 0:
             continue
         mkey = _bucket_for(c.n_members, config.member_buckets)
@@ -147,11 +164,15 @@ def bucketize_clusters(
             mkey = 1 << (c.n_members - 1).bit_length()
         if c.max_peaks > pkey:
             pkey = 1 << (c.max_peaks - 1).bit_length()
-        buckets.setdefault((mkey, pkey), []).append(c)
+        buckets.setdefault((mkey, pkey), []).append((i, c))
 
     batches: list[ClusterBatch] = []
     for (mkey, pkey), group in buckets.items():
         for start in range(0, len(group), config.clusters_per_batch):
             chunk = group[start : start + config.clusters_per_batch]
-            batches.append(pad_clusters(chunk, mkey, pkey))
+            batches.append(
+                pad_clusters(
+                    [c for _, c in chunk], mkey, pkey, [i for i, _ in chunk]
+                )
+            )
     return batches

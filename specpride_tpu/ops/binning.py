@@ -1,0 +1,131 @@
+"""K1: binned-mean consensus device kernel (JAX/XLA).
+
+TPU-native replacement for the per-cluster Python loop + numpy scatter-add of
+ref src/binning.py:170-231 (``combine_bin_mean``): the whole (cluster,
+member, peak) batch is one jitted program — per-member duplicate-bin
+resolution via a stable sort, a flat scatter-add onto the per-cluster grid,
+quorum/NaN/mean finalize, and on-device compaction of surviving bins so only
+(B, K) arrays travel device→host instead of (B, n_bins) grids.
+
+Semantics reproduced from the reference (and the numpy oracle
+``backends.numpy_backend.bin_mean_consensus``):
+
+* numpy fancy-index ``+=`` buffering — within one member, several peaks in
+  the same bin collapse to the LAST occurrence (ref src/binning.py:197-199);
+  here an explicit last-occurrence-per-bin mask (sort by (bin, position)).
+* quorum ``int(n_members * quorum_fraction) + 1`` (ref src/binning.py:181-183)
+  with n_members dynamic per cluster.
+* per-bin mean m/z and mean intensity over contributing members, sub-quorum
+  bins dropped (ref src/binning.py:209-222).
+* mean precursor m/z over members (ref src/binning.py:224).
+
+Bin indices arrive precomputed host-side in float64
+(``ops.quantize.bin_mean_bins``) with sentinel = n_bins for out-of-range /
+padded peaks; scatters use ``mode='drop'`` so sentinels vanish.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from specpride_tpu.config import BinMeanConfig
+
+
+def last_occurrence_mask(bins: jax.Array, sentinel: int) -> jax.Array:
+    """(P,) bool: True where a peak is the last (highest-index) occurrence of
+    its bin within this member; sentinel-binned peaks are False.
+
+    This is the explicit form of numpy's buffered fancy-index ``+=``
+    (ref src/binning.py:197-199).  Stable sort by bin groups equal bins with
+    original order preserved, so the last element of each run is the last
+    occurrence in array order.
+    """
+    p = bins.shape[0]
+    order = jnp.argsort(bins, stable=True)
+    sorted_bins = bins[order]
+    is_last = jnp.concatenate(
+        [sorted_bins[:-1] != sorted_bins[1:], jnp.ones((1,), dtype=bool)]
+    )
+    keep_sorted = is_last & (sorted_bins < sentinel)
+    return jnp.zeros((p,), dtype=bool).at[order].set(keep_sorted)
+
+
+def _bin_mean_cluster(
+    mz: jax.Array,  # (M, P) f32
+    intensity: jax.Array,  # (M, P) f32
+    bins: jax.Array,  # (M, P) i32, sentinel = n_bins
+    member_mask: jax.Array,  # (M,) bool
+    n_members: jax.Array,  # () i32
+    precursor_mz: jax.Array,  # (M,) f32
+    config: BinMeanConfig,
+    out_size: int,
+):
+    n_bins = config.n_bins
+    m, p = mz.shape
+
+    keep = jax.vmap(lambda b: last_occurrence_mask(b, n_bins))(bins)
+    flat_bins = bins.reshape(m * p)
+    w = keep.reshape(m * p)
+
+    counts = jnp.zeros((n_bins,), jnp.float32).at[flat_bins].add(
+        w.astype(jnp.float32), mode="drop"
+    )
+    inten_sum = jnp.zeros((n_bins,), jnp.float32).at[flat_bins].add(
+        jnp.where(w, intensity.reshape(m * p), 0.0), mode="drop"
+    )
+    mz_sum = jnp.zeros((n_bins,), jnp.float32).at[flat_bins].add(
+        jnp.where(w, mz.reshape(m * p), 0.0), mode="drop"
+    )
+
+    if config.apply_peak_quorum:
+        # int(n * frac) + 1, truncation toward zero (ref src/binning.py:183)
+        quorum = jnp.floor(
+            n_members.astype(jnp.float32) * config.quorum_fraction
+        ) + 1.0
+    else:
+        quorum = jnp.float32(1.0)
+
+    keep_bin = counts >= quorum
+    safe_counts = jnp.where(counts > 0, counts, 1.0)
+    inten_mean = inten_sum / safe_counts
+    mz_mean = mz_sum / safe_counts
+
+    (idx,) = jnp.nonzero(keep_bin, size=out_size, fill_value=n_bins)
+    valid_out = idx < n_bins
+    out_mz = jnp.where(valid_out, mz_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0)
+    out_inten = jnp.where(
+        valid_out, inten_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0
+    )
+    n_out = jnp.sum(keep_bin).astype(jnp.int32)
+
+    denom = jnp.maximum(n_members.astype(jnp.float32), 1.0)
+    prec = jnp.sum(jnp.where(member_mask, precursor_mz, 0.0)) / denom
+    return out_mz, out_inten, n_out, prec
+
+
+@functools.partial(jax.jit, static_argnames=("config", "out_size"))
+def bin_mean_batch(
+    mz: jax.Array,  # (B, M, P) f32
+    intensity: jax.Array,  # (B, M, P) f32
+    bins: jax.Array,  # (B, M, P) i32
+    member_mask: jax.Array,  # (B, M) bool
+    n_members: jax.Array,  # (B,) i32
+    precursor_mz: jax.Array,  # (B, M) f32
+    config: BinMeanConfig,
+    out_size: int,
+):
+    """vmapped binned-mean consensus over a padded cluster batch.
+
+    Returns (out_mz (B, out_size), out_intensity (B, out_size),
+    n_out (B,), precursor_mz (B,)).  Valid output peaks are the first
+    ``n_out[b]`` entries of row b, in ascending-bin (ascending m/z) order —
+    the same order the reference emits (grid order, ref src/binning.py:220).
+    """
+    return jax.vmap(
+        lambda a, b, c, d, e, f: _bin_mean_cluster(
+            a, b, c, d, e, f, config, out_size
+        )
+    )(mz, intensity, bins, member_mask, n_members, precursor_mz)

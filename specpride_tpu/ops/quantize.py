@@ -1,0 +1,104 @@
+"""Host-side float64 m/z quantization → int32 bin indices.
+
+Design note (TPU-first split of responsibilities): TPU device arrays are
+float32, but every reference algorithm quantizes m/z on a float64 grid
+(``((mz - min)/binsize).astype(int)`` ref src/binning.py:195; ``mz/0.1``
+occupancy bins consumed via pyOpenMS at ref
+src/most_similar_representative.py:15; ~0.005 Da grid at ref
+src/benchmark.py:11-15).  Recomputing those bin indices in float32 on device
+would move ~0.5% of peaks across bin boundaries — a silent parity break.
+
+So the f64-sensitive *quantization* happens here on the host (cheap, O(peaks)
+numpy), and the device kernels receive int32 bin indices and do all the heavy
+reduction work (scatter-add, matmuls, sorts).  Invalid/padded peaks get the
+``sentinel`` index (= number of bins), which device scatters drop via
+``mode='drop'`` and sorts push past every real bin.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from specpride_tpu.config import BinMeanConfig, CosineConfig, MedoidConfig
+from specpride_tpu.data.ragged import ClusterBatch
+
+
+def bin_mean_bins(batch: ClusterBatch, config: BinMeanConfig) -> np.ndarray:
+    """(B, M, P) int32 grid-bin indices for the binned-mean consensus.
+
+    Reproduces ref src/binning.py:191-195 in float64: peaks outside
+    [min_mz, max_mz) — and padded peaks — map to the sentinel ``n_bins``.
+    """
+    mz = batch.mz64
+    n_bins = config.n_bins
+    in_range = (
+        (mz >= config.min_mz)
+        & (mz < config.max_mz)
+        & batch.peak_mask
+        & batch.member_mask[:, :, None]
+    )
+    bins = ((mz - config.min_mz) / config.bin_size).astype(np.int64)
+    bins = np.clip(bins, 0, n_bins - 1)
+    return np.where(in_range, bins, n_bins).astype(np.int32)
+
+
+def medoid_bins(
+    batch: ClusterBatch, config: MedoidConfig
+) -> tuple[np.ndarray, int]:
+    """Per-cluster-relative occupancy-bin indices for the medoid kernel.
+
+    Global bin = ``int(mz / bin_size)`` (the xcorr-prescore grid, ref
+    src/most_similar_representative.py:15 / numpy oracle
+    ``backends.numpy_backend.xcorr_prescore``).  Bins are shifted by each
+    cluster's minimum occupied bin so the dense occupancy matrix only spans
+    the cluster's m/z range; returns (bins_rel, grid_size) where grid_size is
+    the batch-wide max span rounded up to a multiple of 128 (lane-friendly).
+    """
+    mz = batch.mz64
+    valid = batch.peak_mask & batch.member_mask[:, :, None]
+    bins = (mz / config.bin_size).astype(np.int64)
+    big = np.iinfo(np.int64).max
+    per_cluster_min = np.where(valid, bins, big).min(axis=(1, 2))
+    per_cluster_min = np.where(
+        per_cluster_min == big, 0, per_cluster_min
+    )  # all-empty cluster
+    rel = bins - per_cluster_min[:, None, None]
+    span = int(np.where(valid, rel, -1).max(initial=0)) + 1
+    grid = max(128, ((span + 127) // 128) * 128)
+    return np.where(valid, rel, grid).astype(np.int32), grid
+
+
+def cosine_bins(
+    mz: np.ndarray, valid: np.ndarray, config: CosineConfig
+) -> tuple[np.ndarray, np.ndarray]:
+    """Cosine-grid bin indices + per-spectrum edge counts, float64.
+
+    The metric grid (ref src/benchmark.py:11-15) has edges
+    ``arange(-mz_space/2, max_mz, mz_space)`` — bin index is therefore
+    ``floor((mz + space/2) / space)``, *independent* of the pair's max m/z;
+    only the number of edges depends on it.  Returns:
+
+    * ``bins``: same shape as ``mz``, int32, sentinel = INT32_MAX/2 for
+      invalid peaks (so they sort last);
+    * ``n_edges``: per-spectrum edge count ``len(arange(-s/2, last_mz, s))``
+      computed in f64.  A pair's edge count is the max of its two spectra
+      (edge count is monotone in last m/z), and peaks in bins
+      ``>= n_edges - 1`` fall beyond the pair's last edge and are excluded
+      (ref src/benchmark.py:20-22 via scipy binned_statistic range).
+    """
+    space = config.mz_space
+    mzf = mz.astype(np.float64)
+    bins = np.floor((mzf + space / 2.0) / space).astype(np.int64)
+    sentinel = np.int32(2**30)
+    bins = np.where(valid, np.clip(bins, 0, sentinel - 1), sentinel)
+    # the reference (and oracle) take the LAST peak's m/z, not the max
+    # (``max(a.mz[-1], b.mz[-1])`` ref src/benchmark.py:20 assumes sorted
+    # spectra) — reproduce exactly: value at the last valid index
+    n_valid = valid.sum(axis=-1)
+    last_idx = np.maximum(n_valid - 1, 0)
+    last_mz = np.take_along_axis(mzf, last_idx[..., None], axis=-1)[..., 0]
+    last_mz = np.where(n_valid > 0, last_mz, -np.inf)
+    # numpy arange length: ceil((stop - start) / step)
+    n_edges = np.ceil((last_mz + space / 2.0) / space)
+    n_edges = np.where(np.isfinite(n_edges), np.maximum(n_edges, 0), 0)
+    return bins.astype(np.int32), n_edges.astype(np.int32)

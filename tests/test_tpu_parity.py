@@ -1,0 +1,331 @@
+"""NumPy-oracle vs JAX-device parity tests (survey §4b).
+
+The numpy backend is the behavioural oracle (direct reimplementation of the
+reference algorithms); the TPU backend must reproduce it within float32
+tolerance on randomized clusters.  Runs on the virtual 8-device CPU mesh set
+up in conftest.py — the same jitted programs run unchanged on real TPU.
+"""
+
+import numpy as np
+import pytest
+
+from specpride_tpu.backends import numpy_backend as nb
+from specpride_tpu.backends.tpu_backend import TpuBackend
+from specpride_tpu.config import (
+    BatchConfig,
+    BinMeanConfig,
+    CosineConfig,
+    GapAverageConfig,
+    MedoidConfig,
+)
+from specpride_tpu.data.peaks import Cluster, Spectrum
+
+from conftest import make_cluster
+
+
+def make_gap_safe_cluster(
+    rng, cluster_id="cluster-1", n_members=4, n_skeleton=40, charge=2
+):
+    """Cluster whose inter-peak gaps stay far from the 0.01 Da gap threshold
+    under both f32 and f64 arithmetic: skeleton spacing >= 0.05, member
+    jitter <= 0.003, so intra-group diffs <= 0.006 and inter-group gaps
+    >= 0.044."""
+    base = np.sort(rng.uniform(150.0, 1500.0, size=n_skeleton))
+    keep = np.concatenate([[True], np.diff(base) >= 0.05])
+    base = base[keep]
+    members = []
+    for m in range(n_members):
+        mz = np.sort(base + rng.uniform(-0.003, 0.003, size=base.size))
+        members.append(
+            Spectrum(
+                mz=mz,
+                intensity=rng.uniform(10.0, 1e4, size=base.size),
+                precursor_mz=500.0 + rng.normal(0, 0.01),
+                precursor_charge=charge,
+                rt=100.0 + m,
+                title=f"{cluster_id};mzspec:PXD1:r:scan:{m}",
+            )
+        )
+    return Cluster(cluster_id, members)
+
+
+@pytest.fixture
+def backend():
+    return TpuBackend()
+
+
+def random_clusters(rng, n=12):
+    clusters = []
+    for i in range(n):
+        clusters.append(
+            make_cluster(
+                rng,
+                cluster_id=f"cluster-{i}",
+                n_members=int(rng.integers(1, 9)),
+                n_peaks=int(rng.integers(5, 120)),
+                jitter=float(rng.uniform(0.001, 0.02)),
+                base_scan=1000 * i,
+            )
+        )
+    return clusters
+
+
+def assert_spectra_close(a: Spectrum, b: Spectrum, rtol=1e-5, atol=1e-4):
+    assert a.n_peaks == b.n_peaks, f"{a.title}: {a.n_peaks} vs {b.n_peaks} peaks"
+    np.testing.assert_allclose(a.mz, b.mz, rtol=rtol, atol=atol)
+    np.testing.assert_allclose(a.intensity, b.intensity, rtol=rtol, atol=atol)
+    np.testing.assert_allclose(
+        a.precursor_mz, b.precursor_mz, rtol=1e-6, atol=1e-4
+    )
+    assert a.precursor_charge == b.precursor_charge
+
+
+# ---------------------------------------------------------------------------
+# K1: binned-mean consensus
+# ---------------------------------------------------------------------------
+
+class TestBinMeanParity:
+    def test_random_clusters(self, rng, backend):
+        clusters = random_clusters(rng)
+        oracle = nb.run_bin_mean(clusters)
+        device = backend.run_bin_mean(clusters)
+        assert len(oracle) == len(device)
+        for o, d in zip(oracle, device):
+            assert_spectra_close(o, d)
+
+    def test_duplicate_bin_last_occurrence(self, backend):
+        """Several peaks of one member in the same 0.02 Da bin: only the last
+        contributes (numpy buffered += semantics, ref src/binning.py:197-199)."""
+        s1 = Spectrum(
+            mz=[200.001, 200.002, 200.003, 500.0],
+            intensity=[10.0, 20.0, 30.0, 40.0],
+            precursor_mz=400.0,
+            precursor_charge=2,
+            title="c1;u1",
+        )
+        s2 = Spectrum(
+            mz=[200.004, 500.001],
+            intensity=[100.0, 50.0],
+            precursor_mz=400.0,
+            precursor_charge=2,
+            title="c1;u2",
+        )
+        clusters = [Cluster("c1", [s1, s2])]
+        oracle = nb.run_bin_mean(clusters)
+        device = backend.run_bin_mean(clusters)
+        assert_spectra_close(oracle[0], device[0])
+        # bin at 200: member 1 contributes its LAST peak (30), member 2 its
+        # only peak (100) → mean 65
+        assert pytest.approx(65.0, rel=1e-5) == device[0].intensity[0]
+
+    def test_quorum(self, rng, backend):
+        cfg = BinMeanConfig(quorum_fraction=0.5)
+        clusters = random_clusters(rng, n=6)
+        oracle = nb.run_bin_mean(clusters, cfg)
+        device = backend.run_bin_mean(clusters, cfg)
+        for o, d in zip(oracle, device):
+            assert_spectra_close(o, d)
+
+    def test_no_quorum(self, rng, backend):
+        cfg = BinMeanConfig(apply_peak_quorum=False)
+        clusters = random_clusters(rng, n=6)
+        oracle = nb.run_bin_mean(clusters, cfg)
+        device = backend.run_bin_mean(clusters, cfg)
+        for o, d in zip(oracle, device):
+            assert_spectra_close(o, d)
+
+    def test_mixed_charge_raises(self, rng, backend):
+        c = make_cluster(rng, n_members=3)
+        c.members[1].precursor_charge = 3
+        with pytest.raises(ValueError, match="charges"):
+            backend.run_bin_mean([c])
+
+    def test_out_of_range_peaks_dropped(self, backend):
+        s = Spectrum(
+            mz=[50.0, 150.0, 2500.0],
+            intensity=[1.0, 2.0, 3.0],
+            precursor_mz=300.0,
+            precursor_charge=2,
+            title="c1;u1",
+        )
+        out = backend.run_bin_mean([Cluster("c1", [s, s])])
+        assert out[0].n_peaks == 1
+        assert 149.9 < out[0].mz[0] < 150.1
+
+
+# ---------------------------------------------------------------------------
+# K3: gap-average consensus
+# ---------------------------------------------------------------------------
+
+class TestGapAverageParity:
+    @pytest.mark.parametrize("tail_mode", ["reference", "split"])
+    def test_random_clusters(self, rng, backend, tail_mode):
+        cfg = GapAverageConfig(tail_mode=tail_mode)
+        clusters = [
+            make_gap_safe_cluster(
+                rng,
+                f"cluster-{i}",
+                n_members=int(rng.integers(1, 7)),
+                n_skeleton=int(rng.integers(5, 80)),
+            )
+            for i in range(10)
+        ]
+        oracle = nb.run_gap_average(clusters, cfg)
+        device = backend.run_gap_average(clusters, cfg)
+        assert len(oracle) == len(device)
+        for o, d in zip(oracle, device):
+            assert o.n_peaks == d.n_peaks
+            np.testing.assert_allclose(o.mz, d.mz, rtol=1e-5, atol=1e-3)
+            np.testing.assert_allclose(
+                o.intensity, d.intensity, rtol=1e-5, atol=1e-2
+            )
+            np.testing.assert_allclose(o.precursor_mz, d.precursor_mz)
+            assert o.precursor_charge == d.precursor_charge
+            np.testing.assert_allclose(o.rt, d.rt)
+
+    def test_singleton_passthrough(self, rng, backend):
+        c = make_gap_safe_cluster(rng, n_members=1)
+        device = backend.run_gap_average([c])
+        # singleton: peaks pass through untouched (ref :88-90) modulo
+        # dyn-range floor; our test intensities are within dyn range
+        np.testing.assert_allclose(
+            device[0].mz, c.members[0].mz, rtol=1e-6, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            device[0].intensity, c.members[0].intensity, rtol=1e-6, atol=1e-2
+        )
+
+    def test_dyn_range_filter(self, backend):
+        cfg = GapAverageConfig(dyn_range=10.0, min_fraction=0.4, tail_mode="split")
+        s1 = Spectrum(
+            mz=[100.0, 300.0, 600.0],
+            intensity=[1.0, 500.0, 1000.0],
+            precursor_mz=400.0,
+            precursor_charge=2,
+            title="c1;u1",
+        )
+        s2 = Spectrum(
+            mz=[100.001, 300.001, 600.001],
+            intensity=[1.0, 500.0, 1000.0],
+            precursor_mz=400.0,
+            precursor_charge=2,
+            title="c1;u2",
+        )
+        clusters = [Cluster("c1", [s1, s2])]
+        oracle = nb.run_gap_average(clusters, cfg)
+        device = backend.run_gap_average(clusters, cfg)
+        assert oracle[0].n_peaks == device[0].n_peaks == 2  # 1.0 < max/10
+        np.testing.assert_allclose(
+            oracle[0].intensity, device[0].intensity, rtol=1e-6
+        )
+
+    @pytest.mark.parametrize(
+        "pepmass", ["naive_average", "neutral_average", "lower_median"]
+    )
+    def test_pepmass_modes(self, rng, backend, pepmass):
+        cfg = GapAverageConfig(pepmass=pepmass)
+        clusters = [make_gap_safe_cluster(rng, n_members=5)]
+        oracle = nb.run_gap_average(clusters, cfg)
+        device = backend.run_gap_average(clusters, cfg)
+        np.testing.assert_allclose(
+            oracle[0].precursor_mz, device[0].precursor_mz
+        )
+        np.testing.assert_allclose(oracle[0].rt, device[0].rt)
+
+
+# ---------------------------------------------------------------------------
+# K2: medoid representative
+# ---------------------------------------------------------------------------
+
+class TestMedoidParity:
+    def test_random_clusters(self, rng, backend):
+        clusters = random_clusters(rng)
+        oracle_idx = [nb.medoid_index(c.members) for c in clusters]
+        device_idx = backend.medoid_indices(clusters)
+        assert oracle_idx == device_idx
+
+    def test_identical_members_lowest_index(self, rng, backend):
+        s = make_cluster(rng, n_members=1).members[0]
+        members = [
+            Spectrum(
+                mz=s.mz,
+                intensity=s.intensity,
+                precursor_mz=s.precursor_mz,
+                precursor_charge=s.precursor_charge,
+                title=f"c1;scan{i}",
+            )
+            for i in range(4)
+        ]
+        assert backend.medoid_indices([Cluster("c1", members)]) == [0]
+
+    def test_singleton(self, rng, backend):
+        c = make_cluster(rng, n_members=1)
+        assert backend.medoid_indices([c]) == [0]
+
+    def test_run_medoid_returns_member(self, rng, backend):
+        clusters = random_clusters(rng, n=5)
+        reps = backend.run_medoid(clusters)
+        for rep, c in zip(reps, clusters):
+            assert any(rep is m for m in c.members)
+
+
+# ---------------------------------------------------------------------------
+# K2b: binned cosine metric
+# ---------------------------------------------------------------------------
+
+class TestCosineParity:
+    def test_rep_vs_members(self, rng, backend):
+        clusters = random_clusters(rng, n=8)
+        reps = nb.run_bin_mean(clusters)
+        oracle = np.array(
+            [nb.average_cosine(r, c.members) for r, c in zip(reps, clusters)]
+        )
+        device = backend.average_cosines(reps, clusters)
+        np.testing.assert_allclose(oracle, device, rtol=5e-5, atol=1e-5)
+
+    def test_unsorted_spectrum_uses_last_peak_grid(self, backend):
+        """The reference grid stops at the pair's LAST peak m/z, not the max
+        (ref src/benchmark.py:20 assumes sorted spectra) — parity must hold
+        even for unsorted inputs."""
+        rep = Spectrum(
+            mz=[200.0, 300.0], intensity=[10.0, 20.0],
+            precursor_mz=400.0, precursor_charge=2, title="c1",
+        )
+        member = Spectrum(
+            mz=[200.0, 900.0, 950.0, 300.0],  # unsorted: last peak 300 < max
+            intensity=[10.0, 300.0, 1.0, 20.0],
+            precursor_mz=400.0, precursor_charge=2, title="c1;u1",
+        )
+        oracle = nb.average_cosine(rep, [member])
+        device = backend.average_cosines([rep], [Cluster("c1", [member])])
+        np.testing.assert_allclose(device, [oracle], rtol=1e-5)
+
+    def test_self_similarity_is_one(self, rng, backend):
+        """average_cos_dist(s, [s]) == 1 (ref src/benchmark.py:80)."""
+        c = make_cluster(rng, n_members=1)
+        s = c.members[0]
+        device = backend.average_cosines([s], [Cluster("c1", [s])])
+        np.testing.assert_allclose(device, [1.0], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# bucketing / ordering invariants
+# ---------------------------------------------------------------------------
+
+class TestOrdering:
+    def test_outputs_follow_input_order(self, rng, backend):
+        """Bucketing shuffles compute order; outputs must not be shuffled."""
+        clusters = random_clusters(rng, n=16)
+        device = backend.run_bin_mean(clusters)
+        assert [s.title for s in device] == [c.cluster_id for c in clusters]
+
+    def test_small_batch_chunking(self, rng):
+        backend = TpuBackend(
+            batch_config=BatchConfig(clusters_per_batch=3),
+            max_grid_elements=2 * BinMeanConfig().n_bins,  # forces chunk = 2
+        )
+        clusters = random_clusters(rng, n=9)
+        oracle = nb.run_bin_mean(clusters)
+        device = backend.run_bin_mean(clusters)
+        for o, d in zip(oracle, device):
+            assert_spectra_close(o, d)
