@@ -1,17 +1,20 @@
-"""TPU execution backend: drives the device kernels over bucketed batches.
+"""TPU execution backend: drives the device kernels over packed batches.
 
 Mirrors the numpy-oracle driver API (``backends.numpy_backend.run_*``) with
-the same semantics, but executes each padded ``ClusterBatch`` as one jitted
-XLA program on the default JAX backend (TPU on real hardware; CPU — incl. a
-forced multi-device CPU mesh — in tests).  Host responsibilities: float64
-m/z quantization (``ops.quantize``), precursor/RT estimators, unpadding, and
-reassembly into the caller's original cluster order.
+the same semantics, but executes each packed batch (``data.packed``) as one
+jitted XLA program on the default JAX backend (TPU on real hardware; CPU —
+incl. a forced multi-device CPU mesh — in tests).  Host responsibilities:
+float64 m/z quantization (``ops.quantize`` / pack-time dedup), precursor/RT
+estimators and medoid finalize (tiny, f64-exact), unpadding, and reassembly
+into the caller's original cluster order.
 
-Memory is bounded by chunking each batch along the cluster axis so that the
-largest on-device intermediate (the (B, n_bins) consensus grids or the
-(B, M, grid) occupancy tensors) stays under ``max_grid_elements``; the final
-chunk is zero-padded to the chunk shape so every chunk of a batch reuses one
-compiled program.
+Dispatch discipline (host link is latency- and bandwidth-bound): all chunks
+are dispatched asynchronously before any result is collected, each kernel
+returns ONE fused array per dispatch, and output buffers are sized by exact
+host-computed bounds so the device→host transfer carries only real bytes.
+Memory is bounded by chunking each batch along the cluster axis under
+``max_grid_elements``; phantom rows from chunk padding are masked out and
+never read back.
 """
 
 from __future__ import annotations
@@ -29,7 +32,6 @@ from specpride_tpu.config import (
     MedoidConfig,
 )
 from specpride_tpu.data.peaks import Cluster, Spectrum
-from specpride_tpu.data.ragged import ClusterBatch, bucketize_clusters
 from specpride_tpu.ops import quantize
 from specpride_tpu.backends import numpy_backend
 
@@ -50,11 +52,11 @@ def _check_no_empty(clusters: list[Cluster]) -> None:
             raise ValueError(f"empty cluster {c.cluster_id!r}")
 
 
-def _pad_axis0(arr: np.ndarray, size: int) -> np.ndarray:
+def _pad_axis0(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
     if arr.shape[0] == size:
         return arr
     pad = [(0, size - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
-    return np.pad(arr, pad)
+    return np.pad(arr, pad, constant_values=fill)
 
 
 @dataclasses.dataclass
@@ -63,64 +65,103 @@ class TpuBackend:
 
     ``batch_config`` controls bucketing; ``max_grid_elements`` bounds the
     largest device intermediate per dispatch (default ~64M f32 = 256 MB).
+    ``mesh`` (optional): a 1-D ``jax.sharding.Mesh`` (``parallel.cluster_mesh``)
+    — every dispatch is then padded to a multiple of the mesh size and its
+    inputs sharded along the cluster axis, so XLA SPMD-partitions the kernels
+    across all devices with no hot-loop collectives.
     """
 
     batch_config: BatchConfig = dataclasses.field(default_factory=BatchConfig)
     max_grid_elements: int = 64 * 1024 * 1024
+    mesh: object | None = None  # jax.sharding.Mesh
+
+    def _dispatch_size(self, chunk: int, b: int) -> int:
+        """Dispatch (padded) cluster count: the chunk size, rounded up to a
+        multiple of the mesh size when sharding."""
+        size = min(chunk, b)
+        if self.mesh is not None:
+            n = self.mesh.size
+            size = ((size + n - 1) // n) * n
+        return size
+
+    def _ship(self, *arrays: np.ndarray):
+        """Shard inputs over the mesh (if any) along the cluster axis."""
+        if self.mesh is None:
+            return arrays
+        from specpride_tpu.parallel.mesh import shard_batch_arrays
+
+        return shard_batch_arrays(self.mesh, *arrays)
 
     # -- binned-mean consensus (K1) -------------------------------------
 
     def run_bin_mean(
         self, clusters: list[Cluster], config: BinMeanConfig = BinMeanConfig()
     ) -> list[Spectrum]:
-        """Batched equivalent of ref src/binning.py:291-297."""
-        from specpride_tpu.ops.binning import bin_mean_batch
+        """Batched equivalent of ref src/binning.py:291-297 on the packed
+        ragged layout; dispatches all chunks asynchronously, then collects
+        (overlapping H2D/compute/D2H)."""
+        from specpride_tpu.data.packed import pack_bucketize_bin_mean
+        from specpride_tpu.ops.binning import bin_mean_deduped_compact
 
         _check_no_empty(clusters)
         for c in clusters:
             numpy_backend.check_uniform_charge(c.members)
 
         out: list[Spectrum | None] = [None] * len(clusters)
-        for batch in bucketize_clusters(clusters, self.batch_config):
-            bins = quantize.bin_mean_bins(batch, config)
-            b, m, p = batch.shape
-            out_size = min(m * p, config.n_bins)
-            # largest per-cluster intermediate: the (n_bins,) grids or the
-            # flattened (m*p,) sort/mask arrays, whichever is bigger
-            chunk = max(
-                1, self.max_grid_elements // max(config.n_bins, m * p, 1)
-            )
+        pending = []
+        for batch in pack_bucketize_bin_mean(
+            clusters,
+            config.min_mz,
+            config.max_mz,
+            config.bin_size,
+            config.n_bins,
+            self.batch_config,
+        ):
+            b, k = batch.mz.shape
+            chunk = max(1, self.max_grid_elements // max(k * 4, 1))
+            size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                size = min(chunk, b)
-                mzs, intens, n_out, prec = bin_mean_batch(
-                    _pad_axis0(batch.mz[lo:hi], size),
-                    _pad_axis0(batch.intensity[lo:hi], size),
-                    _pad_axis0(bins[lo:hi], size),
-                    _pad_axis0(batch.member_mask[lo:hi], size),
-                    _pad_axis0(batch.n_members[lo:hi], size),
-                    _pad_axis0(batch.precursor_mz[lo:hi], size),
-                    config,
-                    out_size,
+                # exact total surviving-bin bound for this chunk -> the
+                # compacted D2H buffer carries only real output bytes
+                dist = quantize.distinct_bins_per_row(
+                    batch.bins[lo:hi], config.n_bins
                 )
-                mzs = np.asarray(mzs)
-                intens = np.asarray(intens)
-                n_out = np.asarray(n_out)
-                prec = np.asarray(prec)
-                for ci in range(hi - lo):
-                    k = int(n_out[ci])
-                    gi = batch.source_indices[lo + ci]
-                    charge = int(
-                        batch.precursor_charge[lo + ci][
-                            batch.member_mask[lo + ci]
-                        ][0]
-                    )
-                    out[gi] = Spectrum(
-                        mz=mzs[ci, :k].astype(np.float64),
-                        intensity=intens[ci, :k].astype(np.float64),
-                        precursor_mz=float(prec[ci]),
-                        precursor_charge=charge,
-                        title=batch.cluster_ids[lo + ci],
-                    )
+                total = int(dist.sum())
+                cap = max(1024, ((total + 1023) // 1024) * 1024)
+                fused = bin_mean_deduped_compact(
+                    *self._ship(
+                        _pad_axis0(batch.mz[lo:hi], size),
+                        _pad_axis0(batch.intensity[lo:hi], size),
+                        # pad phantom rows with the sentinel so they emit
+                        # no output bins
+                        _pad_axis0(batch.bins[lo:hi], size, fill=config.n_bins),
+                        _pad_axis0(batch.n_members[lo:hi], size),
+                    ),
+                    config=config,
+                    total_cap=cap,
+                )
+                pending.append((batch, lo, hi, cap, fused))
+
+        for batch, lo, hi, cap, fused in pending:
+            fused = np.asarray(fused)
+            flat_mz = fused[:cap]
+            flat_int = fused[cap : 2 * cap]
+            n_out = fused[2 * cap :].astype(np.int64)
+            offsets = np.concatenate([[0], np.cumsum(n_out)])
+            for ci in range(hi - lo):
+                o0, o1 = int(offsets[ci]), int(offsets[ci + 1])
+                gi = batch.source_indices[lo + ci]
+                members = clusters[gi].members
+                out[gi] = Spectrum(
+                    mz=flat_mz[o0:o1].astype(np.float64),
+                    intensity=flat_int[o0:o1].astype(np.float64),
+                    # exact f64 mean, as the oracle (ref src/binning.py:224)
+                    precursor_mz=float(
+                        np.mean([s.precursor_mz for s in members])
+                    ),
+                    precursor_charge=members[0].precursor_charge,
+                    title=batch.cluster_ids[lo + ci],
+                )
         return [s for s in out if s is not None]
 
     # -- gap-average consensus (K3) -------------------------------------
@@ -130,43 +171,75 @@ class TpuBackend:
         clusters: list[Cluster],
         config: GapAverageConfig = GapAverageConfig(),
     ) -> list[Spectrum]:
-        """Batched equivalent of ref src/average_spectrum_clustering.py:158-164;
-        precursor/RT estimators run host-side (tiny, O(members))."""
-        from specpride_tpu.ops.gap_average import gap_average_batch
+        """Batched equivalent of ref src/average_spectrum_clustering.py:158-164
+        on the packed layout; precursor/RT estimators run host-side (tiny,
+        O(members)) while the device works."""
+        from specpride_tpu.data.packed import pack_bucketize
+        from specpride_tpu.ops.gap_average import gap_average_packed
 
         _check_no_empty(clusters)
         get_pepmass, get_rt = numpy_backend.resolve_gap_estimators(config)
 
         out: list[Spectrum | None] = [None] * len(clusters)
-        for batch in bucketize_clusters(clusters, self.batch_config):
-            b, m, p = batch.shape
-            chunk = max(1, self.max_grid_elements // max(m * p * 4, 1))
+        pending = []
+        for batch in pack_bucketize(clusters, self.batch_config):
+            b, k = batch.mz.shape
+            # peak-group count is data-dependent (can reach k); cap the
+            # output buffer optimistically and redispatch on overflow —
+            # D2H bytes dominate on tunneled hosts
+            out_size = min(k, max(512, k // 4))
+            chunk = max(1, self.max_grid_elements // max(k * 4, 1))
+            size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                size = min(chunk, b)
-                mzs, intens, n_out = gap_average_batch(
-                    _pad_axis0(batch.mz[lo:hi], size),
-                    _pad_axis0(batch.intensity[lo:hi], size),
-                    _pad_axis0(batch.peak_mask[lo:hi], size),
-                    _pad_axis0(batch.member_mask[lo:hi], size),
-                    _pad_axis0(batch.n_members[lo:hi], size),
-                    config,
+                fused = gap_average_packed(
+                    *self._ship(
+                        _pad_axis0(batch.mz[lo:hi], size),
+                        _pad_axis0(batch.intensity[lo:hi], size),
+                        _pad_axis0(batch.n_peaks_total[lo:hi], size),
+                        _pad_axis0(batch.n_members[lo:hi], size),
+                    ),
+                    config=config,
+                    out_size=out_size,
                 )
-                mzs = np.asarray(mzs)
-                intens = np.asarray(intens)
-                n_out = np.asarray(n_out)
-                for ci in range(hi - lo):
-                    k = int(n_out[ci])
-                    gi = batch.source_indices[lo + ci]
-                    members = clusters[gi].members
-                    pep_mz, pep_z = get_pepmass(members)
-                    out[gi] = Spectrum(
-                        mz=mzs[ci, :k].astype(np.float64),
-                        intensity=intens[ci, :k].astype(np.float64),
-                        precursor_mz=pep_mz,
-                        precursor_charge=pep_z,
-                        rt=get_rt(members),
-                        title=batch.cluster_ids[lo + ci],
+                pending.append((batch, lo, hi, out_size, fused))
+
+        for batch, lo, hi, out_size, fused in pending:
+            fused = np.asarray(fused)
+            n_out = fused[:, 2 * out_size].astype(np.int64)
+            if n_out.max(initial=0) > out_size:
+                # overflow: rerun this slice with the full-size buffer,
+                # through the same pad/shard path as the primary dispatch
+                k = batch.mz.shape[1]
+                size = self._dispatch_size(hi - lo, hi - lo)
+                fused = np.asarray(
+                    gap_average_packed(
+                        *self._ship(
+                            _pad_axis0(batch.mz[lo:hi], size),
+                            _pad_axis0(batch.intensity[lo:hi], size),
+                            _pad_axis0(batch.n_peaks_total[lo:hi], size),
+                            _pad_axis0(batch.n_members[lo:hi], size),
+                        ),
+                        config=config,
+                        out_size=k,
                     )
+                )
+                out_size = k
+                n_out = fused[: hi - lo, 2 * out_size].astype(np.int64)
+            mzs = fused[:, :out_size]
+            intens = fused[:, out_size : 2 * out_size]
+            for ci in range(hi - lo):
+                kk = int(n_out[ci])
+                gi = batch.source_indices[lo + ci]
+                members = clusters[gi].members
+                pep_mz, pep_z = get_pepmass(members)
+                out[gi] = Spectrum(
+                    mz=mzs[ci, :kk].astype(np.float64),
+                    intensity=intens[ci, :kk].astype(np.float64),
+                    precursor_mz=pep_mz,
+                    precursor_charge=pep_z,
+                    rt=get_rt(members),
+                    title=batch.cluster_ids[lo + ci],
+                )
         return [s for s in out if s is not None]
 
     # -- medoid representative (K2) -------------------------------------
@@ -175,28 +248,44 @@ class TpuBackend:
         self, clusters: list[Cluster], config: MedoidConfig = MedoidConfig()
     ) -> list[int]:
         """Per-cluster medoid member index (ref
-        src/most_similar_representative.py:87-110 semantics)."""
-        from specpride_tpu.ops.similarity import medoid_finalize, shared_bins_batch
+        src/most_similar_representative.py:87-110 semantics): packed
+        occupancy scatter + batched gram matmul on device, exact float64
+        finalize on host."""
+        from specpride_tpu.data.packed import pack_bucketize
+        from specpride_tpu.ops.similarity import medoid_finalize, shared_bins_packed
 
         _check_no_empty(clusters)
         out: list[int] = [0] * len(clusters)
-        for batch in bucketize_clusters(clusters, self.batch_config):
-            bins, grid = quantize.medoid_bins(batch, config)
-            b, m, p = batch.shape
+        pending = []
+        for batch in pack_bucketize(
+            clusters, self.batch_config, bucket_members=True
+        ):
+            bins, grid = quantize.medoid_bins_packed(batch, config)
+            b, k = batch.mz.shape
+            m = batch.m
             chunk = max(1, self.max_grid_elements // max(m * grid, 1))
+            size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                size = min(chunk, b)
-                shared = np.asarray(
-                    shared_bins_batch(_pad_axis0(bins[lo:hi], size), grid)
-                )[: hi - lo]
-                idx = medoid_finalize(
-                    shared,
-                    batch.n_peaks[lo:hi],
-                    batch.member_mask[lo:hi],
-                    batch.n_members[lo:hi],
+                res = shared_bins_packed(
+                    *self._ship(
+                        _pad_axis0(bins[lo:hi], size),
+                        _pad_axis0(batch.member_id[lo:hi], size),
+                    ),
+                    grid=grid,
+                    m=m,
                 )
-                for ci in range(hi - lo):
-                    out[batch.source_indices[lo + ci]] = int(idx[ci])
+                pending.append((batch, lo, hi, res))
+
+        for batch, lo, hi, res in pending:
+            shared = np.asarray(res)[: hi - lo]
+            idx = medoid_finalize(
+                shared,
+                batch.n_peaks[lo:hi],
+                batch.member_mask[lo:hi],
+                batch.n_members[lo:hi],
+            )
+            for ci in range(hi - lo):
+                out[batch.source_indices[lo + ci]] = int(idx[ci])
         return out
 
     def run_medoid(
@@ -226,55 +315,68 @@ class TpuBackend:
         config: CosineConfig = CosineConfig(),
     ) -> np.ndarray:
         """Mean binned cosine of each representative to its cluster's members
-        (ref src/benchmark.py:31-38), one device pass per bucket shape."""
-        from specpride_tpu.ops.similarity import cosine_rep_vs_members
+        (ref src/benchmark.py:31-38) on the packed layout: device receives
+        packed peaks + f64-quantized grid bins, returns only the per-member
+        cosines (``ops.similarity.cosine_packed``)."""
+        from specpride_tpu.data.packed import pack_bucketize
+        from specpride_tpu.ops.similarity import cosine_packed
 
         if len(representatives) != len(clusters):
             raise ValueError("representatives and clusters must align")
         _check_no_empty(clusters)
+        space = config.mz_space
         out = np.zeros((len(clusters),), dtype=np.float64)
-        for batch in bucketize_clusters(clusters, self.batch_config):
+        pending = []
+        for batch in pack_bucketize(clusters, self.batch_config):
             idxs = batch.source_indices
-            b, m, p = batch.shape
+            b, k = batch.mz.shape
+            m = batch.m
             pr_raw = max(
                 max((representatives[i].n_peaks for i in idxs), default=1), 1
             )
-            # bucket the rep-peak axis (multiple of 128) so the jitted pair
-            # kernel compiles once per bucket shape, not once per batch
             pr = ((pr_raw + 127) // 128) * 128
             rep_mz = np.zeros((b, pr), np.float64)
             rep_int = np.zeros((b, pr), np.float32)
             rep_valid = np.zeros((b, pr), bool)
+            mem_edges = np.zeros((b, m), np.int32)
             for ci, gi in enumerate(idxs):
                 r = representatives[gi]
-                k = r.n_peaks
-                rep_mz[ci, :k] = r.mz
-                rep_int[ci, :k] = r.intensity
-                rep_valid[ci, :k] = True
+                rep_mz[ci, : r.n_peaks] = r.mz
+                rep_int[ci, : r.n_peaks] = r.intensity
+                rep_valid[ci, : r.n_peaks] = True
+                for mi, mem in enumerate(clusters[gi].members):
+                    if mem.n_peaks:
+                        # per-member edge count off the LAST peak
+                        # (ref src/benchmark.py:20, assumes sorted)
+                        mem_edges[ci, mi] = quantize.cosine_edge_count(
+                            mem.mz[-1], space
+                        )
             rep_bins, rep_edges = quantize.cosine_bins(rep_mz, rep_valid, config)
-            mem_valid = batch.peak_mask & batch.member_mask[:, :, None]
-            mem_bins, mem_edges = quantize.cosine_bins(
-                batch.mz64, mem_valid, config
+            mem_bins, _ = quantize.cosine_bins(
+                batch.mz64, batch.member_id >= 0, config
             )
-            mem_int = batch.intensity  # already float32
 
-            # per-cluster pair workspace: ~m concatenated (pr+p) key/value
-            # arrays plus sort scratch
-            per_cluster = m * (pr + p) * 8
-            chunk = max(1, self.max_grid_elements // max(per_cluster, 1))
+            chunk = max(1, self.max_grid_elements // max((k + pr) * 6, 1))
+            size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                size = min(chunk, b)
-                mean, _ = cosine_rep_vs_members(
-                    _pad_axis0(rep_bins[lo:hi], size),
-                    _pad_axis0(rep_int[lo:hi], size),
-                    _pad_axis0(rep_edges[lo:hi], size),
-                    _pad_axis0(mem_bins[lo:hi], size),
-                    _pad_axis0(mem_int[lo:hi], size),
-                    _pad_axis0(mem_edges[lo:hi], size),
-                    _pad_axis0(batch.member_mask[lo:hi], size),
-                    _pad_axis0(batch.n_members[lo:hi], size),
+                mean, _ = cosine_packed(
+                    *self._ship(
+                        _pad_axis0(rep_bins[lo:hi], size, fill=2**30),
+                        _pad_axis0(rep_int[lo:hi], size),
+                        _pad_axis0(rep_edges[lo:hi], size),
+                        _pad_axis0(mem_bins[lo:hi], size, fill=2**30),
+                        _pad_axis0(batch.intensity[lo:hi], size),
+                        _pad_axis0(batch.member_id[lo:hi], size, fill=-1),
+                        _pad_axis0(mem_edges[lo:hi], size),
+                        _pad_axis0(batch.member_mask[lo:hi], size),
+                        _pad_axis0(batch.n_members[lo:hi], size),
+                    ),
+                    m=m,
                 )
-                mean = np.asarray(mean)
-                for ci in range(hi - lo):
-                    out[idxs[lo + ci]] = float(mean[ci])
+                pending.append((idxs, lo, hi, mean))
+
+        for idxs, lo, hi, mean in pending:
+            mean = np.asarray(mean)
+            for ci in range(hi - lo):
+                out[idxs[lo + ci]] = float(mean[ci])
         return out

@@ -119,13 +119,18 @@ class FragmentConfig:
 
 @dataclasses.dataclass(frozen=True)
 class BatchConfig:
-    """Bucketed padding of ragged clusters into device tensors.
+    """Bucketing of ragged clusters into packed device batches
+    (``data.packed``).
 
-    ``member_buckets``/``peak_buckets`` are the allowed padded sizes; each
-    cluster is padded up to the smallest bucket that fits.  Fewer buckets
-    means fewer XLA recompiles but more padding waste (survey §7 hard part a).
+    Each distinct bucket shape is one XLA compilation and one dispatch
+    round-trip; fewer buckets mean fewer recompiles/dispatches but more
+    padding waste (survey §7 hard part a).
     """
 
     member_buckets: tuple[int, ...] = (2, 4, 8, 16, 32, 64, 128)
-    peak_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048)
+    # total peaks per cluster (packed layout, data.packed) — one axis of
+    # bucket waste instead of two.  Few coarse buckets: on tunneled hosts
+    # each extra batch shape costs a full dispatch round-trip, which beats
+    # the padding bytes it saves.
+    total_peak_buckets: tuple[int, ...] = (512, 2048, 8192, 32768)
     clusters_per_batch: int = 256

@@ -1,11 +1,18 @@
-from specpride_tpu.data.peaks import Spectrum, Cluster, parse_title, build_title
-from specpride_tpu.data.ragged import ClusterBatch, bucketize_clusters
+"""Host data model: spectra/clusters + packed device batches."""
+from specpride_tpu.data.peaks import Cluster, Spectrum, group_into_clusters
+from specpride_tpu.data.packed import (
+    BinPackedBatch,
+    PackedBatch,
+    pack_bucketize,
+    pack_bucketize_bin_mean,
+)
 
 __all__ = [
-    "Spectrum",
     "Cluster",
-    "parse_title",
-    "build_title",
-    "ClusterBatch",
-    "bucketize_clusters",
+    "Spectrum",
+    "group_into_clusters",
+    "PackedBatch",
+    "BinPackedBatch",
+    "pack_bucketize",
+    "pack_bucketize_bin_mean",
 ]

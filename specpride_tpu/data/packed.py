@@ -1,0 +1,303 @@
+"""Packed ragged batches: peaks stored contiguously per cluster.
+
+The padded ``(cluster, member, peak)`` layout (``data.ragged``) wastes most
+of its bytes on mask padding — with realistic clusters (e.g. 5×250 peaks in
+a 32×512 bucket) >90% of host↔device traffic is padding.  The packed layout
+stores each cluster's peaks contiguously along one axis with a parallel
+``member_id`` channel:
+
+    mz, intensity : (B, K) float32   — all member peaks, concatenated
+    member_id     : (B, K) int32     — which member each peak belongs to;
+                                        -1 marks padding slots
+    (B, M) per-member metadata (precursor, rt, raw peak counts) kept dense.
+
+K is the bucketed *total* peak count per cluster, so padding waste is
+bounded by bucket granularity on one axis instead of two.  The consensus
+kernels never needed the (member, peak) rectangle — binning flattens it
+(ref src/binning.py:185-199), gap-averaging concatenates it (ref
+src/average_spectrum_clustering.py:56-57), and the medoid occupancy scatter
+indexes (member, bin) directly — so packing loses nothing and turns every
+kernel into dense sort/segment work on K elements.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from specpride_tpu.config import BatchConfig
+from specpride_tpu.data.peaks import Cluster
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """B clusters, each with up to K packed peaks and up to M members."""
+
+    mz: np.ndarray  # (B, K) float32
+    mz64: np.ndarray  # (B, K) float64 — HOST-ONLY exact m/z for quantization
+    intensity: np.ndarray  # (B, K) float32
+    member_id: np.ndarray  # (B, K) int32, -1 = padding
+    n_peaks_total: np.ndarray  # (B,) int32 valid peaks per cluster
+    n_members: np.ndarray  # (B,) int32
+    member_mask: np.ndarray  # (B, M) bool
+    precursor_mz: np.ndarray  # (B, M) float32
+    precursor_charge: np.ndarray  # (B, M) int32
+    rt: np.ndarray  # (B, M) float32
+    n_peaks: np.ndarray  # (B, M) int32 raw per-member peak counts
+    cluster_ids: list[str]
+    source_indices: list[int]
+
+    @property
+    def n_clusters(self) -> int:
+        return self.mz.shape[0]
+
+    @property
+    def k(self) -> int:
+        return self.mz.shape[1]
+
+    @property
+    def m(self) -> int:
+        return self.member_mask.shape[1]
+
+
+def pack_clusters(
+    clusters: Sequence[Cluster],
+    k: int,
+    m: int,
+    source_indices: Sequence[int] | None = None,
+) -> PackedBatch:
+    """Pack a homogeneous group of clusters into one PackedBatch."""
+    b = len(clusters)
+    mz = np.zeros((b, k), dtype=np.float32)
+    mz64 = np.zeros((b, k), dtype=np.float64)
+    intensity = np.zeros((b, k), dtype=np.float32)
+    member_id = np.full((b, k), -1, dtype=np.int32)
+    n_peaks_total = np.zeros((b,), dtype=np.int32)
+    n_members = np.zeros((b,), dtype=np.int32)
+    member_mask = np.zeros((b, m), dtype=bool)
+    precursor_mz = np.zeros((b, m), dtype=np.float32)
+    precursor_charge = np.zeros((b, m), dtype=np.int32)
+    rt = np.zeros((b, m), dtype=np.float32)
+    n_peaks = np.zeros((b, m), dtype=np.int32)
+
+    for ci, cluster in enumerate(clusters):
+        if cluster.n_members > m:
+            raise ValueError(
+                f"cluster {cluster.cluster_id}: {cluster.n_members} members "
+                f"> member bucket {m}"
+            )
+        if cluster.total_peaks > k:
+            raise ValueError(
+                f"cluster {cluster.cluster_id}: {cluster.total_peaks} peaks "
+                f"> peak bucket {k}"
+            )
+        n_members[ci] = cluster.n_members
+        pos = 0
+        for mi, s in enumerate(cluster.members):
+            np_ = s.n_peaks
+            mz[ci, pos : pos + np_] = s.mz
+            mz64[ci, pos : pos + np_] = s.mz
+            intensity[ci, pos : pos + np_] = s.intensity
+            member_id[ci, pos : pos + np_] = mi
+            pos += np_
+            member_mask[ci, mi] = True
+            precursor_mz[ci, mi] = s.precursor_mz
+            precursor_charge[ci, mi] = s.precursor_charge
+            rt[ci, mi] = s.rt
+            n_peaks[ci, mi] = np_
+        n_peaks_total[ci] = pos
+
+    return PackedBatch(
+        mz=mz,
+        mz64=mz64,
+        intensity=intensity,
+        member_id=member_id,
+        n_peaks_total=n_peaks_total,
+        n_members=n_members,
+        member_mask=member_mask,
+        precursor_mz=precursor_mz,
+        precursor_charge=precursor_charge,
+        rt=rt,
+        n_peaks=n_peaks,
+        cluster_ids=[c.cluster_id for c in clusters],
+        source_indices=(
+            list(source_indices) if source_indices is not None else list(range(b))
+        ),
+    )
+
+
+@dataclasses.dataclass
+class BinPackedBatch:
+    """Packed batch specialised for binned-mean consensus: bin indices are
+    quantized (float64) and duplicate-(member, bin) peaks dropped at pack
+    time, so the device kernel needs no member channel at all.
+
+    Dropping duplicates host-side is exact: a peak that is not the last
+    occurrence of its (member, bin) pair contributes nothing under the
+    reference's buffered ``+=`` semantics (ref src/binning.py:197-199), and
+    after dedup every surviving peak adds exactly 1 to its bin's member
+    count.  H2D traffic: 12 B/peak (mz, intensity, bin) and the peaks
+    shrink by the duplicate fraction.
+    """
+
+    mz: np.ndarray  # (B, K) float32
+    intensity: np.ndarray  # (B, K) float32
+    bins: np.ndarray  # (B, K) int32, sentinel = n_bins for padding
+    n_valid: np.ndarray  # (B,) int32
+    n_members: np.ndarray  # (B,) int32
+    cluster_ids: list[str]
+    source_indices: list[int]
+
+
+def _dedup_last_per_bin(bins: np.ndarray) -> np.ndarray:
+    """Boolean keep-mask: last occurrence of each bin value within one
+    member's peak array (array order = reference scatter order)."""
+    if bins.size == 0:
+        return np.zeros((0,), dtype=bool)
+    if bins.size > 1 and np.all(np.diff(bins) >= 0):
+        # sorted-m/z fast path: runs are contiguous
+        return np.concatenate([bins[1:] != bins[:-1], [True]])
+    # general: np.unique on the reversed array marks last occurrences
+    _, first_of_reversed = np.unique(bins[::-1], return_index=True)
+    keep = np.zeros(bins.shape, dtype=bool)
+    keep[bins.size - 1 - first_of_reversed] = True
+    return keep
+
+
+def pack_bin_mean(
+    clusters: Sequence[Cluster],
+    bins_per_member: Sequence[Sequence[np.ndarray]],
+    keep_per_member: Sequence[Sequence[np.ndarray]],
+    k: int,
+    source_indices: Sequence[int],
+    sentinel: int,
+) -> BinPackedBatch:
+    """Assemble a BinPackedBatch from per-member quantized bins + keep masks
+    (see ``pack_bucketize_bin_mean``)."""
+    b = len(clusters)
+    mz = np.zeros((b, k), dtype=np.float32)
+    intensity = np.zeros((b, k), dtype=np.float32)
+    bins = np.full((b, k), sentinel, dtype=np.int32)
+    n_valid = np.zeros((b,), dtype=np.int32)
+    n_members = np.zeros((b,), dtype=np.int32)
+    for ci, cluster in enumerate(clusters):
+        pos = 0
+        for s, mb, kp in zip(
+            cluster.members, bins_per_member[ci], keep_per_member[ci]
+        ):
+            kept = int(kp.sum())
+            mz[ci, pos : pos + kept] = s.mz[kp]
+            intensity[ci, pos : pos + kept] = s.intensity[kp]
+            bins[ci, pos : pos + kept] = mb[kp]
+            pos += kept
+        n_valid[ci] = pos
+        n_members[ci] = cluster.n_members
+    return BinPackedBatch(
+        mz=mz,
+        intensity=intensity,
+        bins=bins,
+        n_valid=n_valid,
+        n_members=n_members,
+        cluster_ids=[c.cluster_id for c in clusters],
+        source_indices=list(source_indices),
+    )
+
+
+def pack_bucketize_bin_mean(
+    clusters: Iterable[Cluster],
+    min_mz: float,
+    max_mz: float,
+    bin_size: float,
+    n_bins: int,
+    config: BatchConfig = BatchConfig(),
+) -> list[BinPackedBatch]:
+    """Quantize (float64), dedup, and bucket clusters for the binned-mean
+    kernel.  K buckets are chosen on the DEDUPED, range-filtered peak
+    counts."""
+    prepared = []  # (i, cluster, bins_per_member, keep_per_member, total)
+    for i, c in enumerate(clusters):
+        if c.n_members == 0:
+            continue
+        mbs, kps, total = [], [], 0
+        for s in c.members:
+            mz64 = s.mz.astype(np.float64, copy=False)
+            in_range = (mz64 >= min_mz) & (mz64 < max_mz)
+            b = ((mz64 - min_mz) / bin_size).astype(np.int64)
+            b = np.where(in_range, np.clip(b, 0, n_bins - 1), -1)
+            keep = _dedup_last_per_bin(b) & in_range
+            mbs.append(b.astype(np.int32))
+            kps.append(keep)
+            total += int(keep.sum())
+        prepared.append((i, c, mbs, kps, total))
+
+    buckets: dict[int, list] = {}
+    for item in prepared:
+        kkey = _bucket_for(max(item[4], 1), config.total_peak_buckets)
+        buckets.setdefault(kkey, []).append(item)
+
+    batches: list[BinPackedBatch] = []
+    for kkey, group in buckets.items():
+        for start in range(0, len(group), config.clusters_per_batch):
+            chunk = group[start : start + config.clusters_per_batch]
+            batches.append(
+                pack_bin_mean(
+                    [c for _, c, _, _, _ in chunk],
+                    [m for _, _, m, _, _ in chunk],
+                    [k2 for _, _, _, k2, _ in chunk],
+                    kkey,
+                    [i for i, _, _, _, _ in chunk],
+                    n_bins,
+                )
+            )
+    return batches
+
+
+def _bucket_for(value: int, buckets: Sequence[int]) -> int:
+    i = bisect.bisect_left(buckets, value)
+    if i < len(buckets):
+        return buckets[i]
+    return 1 << (max(value, 1) - 1).bit_length()  # grow past the last bucket
+
+
+def pack_bucketize(
+    clusters: Iterable[Cluster],
+    config: BatchConfig = BatchConfig(),
+    bucket_members: bool = False,
+) -> list[PackedBatch]:
+    """Group clusters into PackedBatches of homogeneous K bucket shape,
+    recording original positions in ``source_indices``.
+
+    With ``bucket_members=False`` (default) the member axis M is sized to
+    the largest cluster in each batch — right for kernels that never ship
+    the (B, M) metadata to the device (bin-mean, gap-average), since every
+    distinct batch shape is one XLA compile and one set of transfers.
+    ``bucket_members=True`` additionally buckets M (medoid occupancy needs
+    a device (B, M, grid) tensor)."""
+    buckets: dict[tuple[int, int], list[tuple[int, Cluster]]] = {}
+    for i, c in enumerate(clusters):
+        if c.n_members == 0:
+            continue
+        kkey = _bucket_for(max(c.total_peaks, 1), config.total_peak_buckets)
+        mkey = _bucket_for(c.n_members, config.member_buckets) if bucket_members else 0
+        buckets.setdefault((kkey, mkey), []).append((i, c))
+
+    batches: list[PackedBatch] = []
+    for (kkey, mkey), group in buckets.items():
+        for start in range(0, len(group), config.clusters_per_batch):
+            chunk = group[start : start + config.clusters_per_batch]
+            if bucket_members:
+                m = mkey
+            else:
+                # round to a power of two so the (B, M) metadata shape — and
+                # the kernels' static m — stay stable across similar runs
+                mx = max(c.n_members for _, c in chunk)
+                m = 1 << (max(mx, 1) - 1).bit_length()
+            batches.append(
+                pack_clusters(
+                    [c for _, c in chunk], kkey, m, [i for i, _ in chunk]
+                )
+            )
+    return batches

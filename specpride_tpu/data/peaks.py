@@ -5,7 +5,7 @@ The reference passes spectra around as pyteomics-style dicts of numpy arrays
 src/binning.py:98-103, src/average_spectrum_clustering.py:100-103).  Here the
 unit is an immutable ``Spectrum`` with contiguous float32/float64 arrays, and
 a ``Cluster`` groups members; both are host-side staging types — device
-compute happens on ``specpride_tpu.data.ragged.ClusterBatch`` tensors.
+compute happens on ``specpride_tpu.data.packed`` batch tensors.
 
 Title convention for the clustered-MGF interchange format
 (ref file_formats.md:5-9): ``TITLE=<cluster_id>;<usi>`` where the USI is

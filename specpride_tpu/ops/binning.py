@@ -1,27 +1,15 @@
 """K1: binned-mean consensus device kernel (JAX/XLA).
 
 TPU-native replacement for the per-cluster Python loop + numpy scatter-add of
-ref src/binning.py:170-231 (``combine_bin_mean``): the whole (cluster,
-member, peak) batch is one jitted program — per-member duplicate-bin
-resolution via a stable sort, a flat scatter-add onto the per-cluster grid,
-quorum/NaN/mean finalize, and on-device compaction of surviving bins so only
-(B, K) arrays travel device→host instead of (B, n_bins) grids.
-
-Semantics reproduced from the reference (and the numpy oracle
-``backends.numpy_backend.bin_mean_consensus``):
-
-* numpy fancy-index ``+=`` buffering — within one member, several peaks in
-  the same bin collapse to the LAST occurrence (ref src/binning.py:197-199);
-  here an explicit last-occurrence-per-bin mask (sort by (bin, position)).
-* quorum ``int(n_members * quorum_fraction) + 1`` (ref src/binning.py:181-183)
-  with n_members dynamic per cluster.
-* per-bin mean m/z and mean intensity over contributing members, sub-quorum
-  bins dropped (ref src/binning.py:209-222).
-* mean precursor m/z over members (ref src/binning.py:224).
-
-Bin indices arrive precomputed host-side in float64
-(``ops.quantize.bin_mean_bins``) with sentinel = n_bins for out-of-range /
-padded peaks; scatters use ``mode='drop'`` so sentinels vanish.
+ref src/binning.py:170-231 (``combine_bin_mean``).  Pipeline (see
+``data.packed.BinPackedBatch``): the host quantizes m/z to grid bins in
+float64 and drops duplicate-(member, bin) peaks (the numpy buffered ``+=``
+semantics, ref src/binning.py:197-199), so the device kernel is pure dense
+work on K packed peaks per cluster — one stable sort by bin, segmented
+reductions for per-bin member counts / intensity / m/z sums, the dynamic
+quorum ``int(n_members * fraction) + 1`` (ref src/binning.py:181-183), and a
+global compaction so the device→host transfer carries only real output
+bytes.  The (n_bins,)-sized dense grid of the reference never materialises.
 """
 
 from __future__ import annotations
@@ -34,54 +22,37 @@ import jax.numpy as jnp
 from specpride_tpu.config import BinMeanConfig
 
 
-def last_occurrence_mask(bins: jax.Array, sentinel: int) -> jax.Array:
-    """(P,) bool: True where a peak is the last (highest-index) occurrence of
-    its bin within this member; sentinel-binned peaks are False.
-
-    This is the explicit form of numpy's buffered fancy-index ``+=``
-    (ref src/binning.py:197-199).  Stable sort by bin groups equal bins with
-    original order preserved, so the last element of each run is the last
-    occurrence in array order.
-    """
-    p = bins.shape[0]
-    order = jnp.argsort(bins, stable=True)
-    sorted_bins = bins[order]
-    is_last = jnp.concatenate(
-        [sorted_bins[:-1] != sorted_bins[1:], jnp.ones((1,), dtype=bool)]
-    )
-    keep_sorted = is_last & (sorted_bins < sentinel)
-    return jnp.zeros((p,), dtype=bool).at[order].set(keep_sorted)
-
-
-def _bin_mean_cluster(
-    mz: jax.Array,  # (M, P) f32
-    intensity: jax.Array,  # (M, P) f32
-    bins: jax.Array,  # (M, P) i32, sentinel = n_bins
-    member_mask: jax.Array,  # (M,) bool
+def _bin_mean_deduped_stats(
+    mz: jax.Array,  # (K,) f32
+    intensity: jax.Array,  # (K,) f32
+    bins: jax.Array,  # (K,) i32, sentinel = n_bins (padding)
     n_members: jax.Array,  # () i32
-    precursor_mz: jax.Array,  # (M,) f32
     config: BinMeanConfig,
-    out_size: int,
 ):
+    """Per-cluster per-bin stats (mz mean, intensity mean, keep mask) in
+    segment-id positions — the vmappable core of ``bin_mean_deduped``."""
+    k = bins.shape[0]
     n_bins = config.n_bins
-    m, p = mz.shape
 
-    keep = jax.vmap(lambda b: last_occurrence_mask(b, n_bins))(bins)
-    flat_bins = bins.reshape(m * p)
-    w = keep.reshape(m * p)
+    order = jnp.argsort(bins, stable=True)
+    sb = bins[order]
+    valid = sb < n_bins
 
-    counts = jnp.zeros((n_bins,), jnp.float32).at[flat_bins].add(
-        w.astype(jnp.float32), mode="drop"
+    new_bin = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), (sb[1:] != sb[:-1]).astype(jnp.int32)]
     )
-    inten_sum = jnp.zeros((n_bins,), jnp.float32).at[flat_bins].add(
-        jnp.where(w, intensity.reshape(m * p), 0.0), mode="drop"
+    seg = jnp.cumsum(new_bin)
+
+    w = jnp.where(valid, 1.0, 0.0)
+    counts = jax.ops.segment_sum(w, seg, num_segments=k, indices_are_sorted=True)
+    inten_sum = jax.ops.segment_sum(
+        intensity[order] * w, seg, num_segments=k, indices_are_sorted=True
     )
-    mz_sum = jnp.zeros((n_bins,), jnp.float32).at[flat_bins].add(
-        jnp.where(w, mz.reshape(m * p), 0.0), mode="drop"
+    mz_sum = jax.ops.segment_sum(
+        mz[order] * w, seg, num_segments=k, indices_are_sorted=True
     )
 
     if config.apply_peak_quorum:
-        # int(n * frac) + 1, truncation toward zero (ref src/binning.py:183)
         quorum = jnp.floor(
             n_members.astype(jnp.float32) * config.quorum_fraction
         ) + 1.0
@@ -89,43 +60,46 @@ def _bin_mean_cluster(
         quorum = jnp.float32(1.0)
 
     keep_bin = counts >= quorum
-    safe_counts = jnp.where(counts > 0, counts, 1.0)
-    inten_mean = inten_sum / safe_counts
-    mz_mean = mz_sum / safe_counts
-
-    (idx,) = jnp.nonzero(keep_bin, size=out_size, fill_value=n_bins)
-    valid_out = idx < n_bins
-    out_mz = jnp.where(valid_out, mz_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0)
-    out_inten = jnp.where(
-        valid_out, inten_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0
-    )
-    n_out = jnp.sum(keep_bin).astype(jnp.int32)
-
-    denom = jnp.maximum(n_members.astype(jnp.float32), 1.0)
-    prec = jnp.sum(jnp.where(member_mask, precursor_mz, 0.0)) / denom
-    return out_mz, out_inten, n_out, prec
+    safe = jnp.maximum(counts, 1.0)
+    return mz_sum / safe, inten_sum / safe, keep_bin
 
 
-@functools.partial(jax.jit, static_argnames=("config", "out_size"))
-def bin_mean_batch(
-    mz: jax.Array,  # (B, M, P) f32
-    intensity: jax.Array,  # (B, M, P) f32
-    bins: jax.Array,  # (B, M, P) i32
-    member_mask: jax.Array,  # (B, M) bool
+@functools.partial(jax.jit, static_argnames=("config", "total_cap"))
+def bin_mean_deduped_compact(
+    mz: jax.Array,  # (B, K) f32
+    intensity: jax.Array,  # (B, K) f32
+    bins: jax.Array,  # (B, K) i32
     n_members: jax.Array,  # (B,) i32
-    precursor_mz: jax.Array,  # (B, M) f32
     config: BinMeanConfig,
-    out_size: int,
+    total_cap: int,
 ):
-    """vmapped binned-mean consensus over a padded cluster batch.
+    """Globally-compacted deduped binned-mean: one fused 1-D output
+    ``[flat_mz (total_cap) | flat_intensity (total_cap) | n_out (B)]``.
 
-    Returns (out_mz (B, out_size), out_intensity (B, out_size),
-    n_out (B,), precursor_mz (B,)).  Valid output peaks are the first
-    ``n_out[b]`` entries of row b, in ascending-bin (ascending m/z) order —
-    the same order the reference emits (grid order, ref src/binning.py:220).
+    ``total_cap`` must be >= the batch's total surviving-bin count; the host
+    computes the exact total distinct-bin bound (``quantize
+    .distinct_bins_per_row``) so the D2H transfer carries only real output
+    bytes — on tunneled hosts the device→host link is the pipeline
+    bottleneck.  Outputs are row-major: cluster order preserved, ascending
+    m/z within a cluster (the reference's grid order, ref src/binning.py:220).
     """
-    return jax.vmap(
-        lambda a, b, c, d, e, f: _bin_mean_cluster(
-            a, b, c, d, e, f, config, out_size
-        )
-    )(mz, intensity, bins, member_mask, n_members, precursor_mz)
+    b, k = mz.shape
+    mz_mean, inten_mean, keep = jax.vmap(
+        lambda a, c, d, e: _bin_mean_deduped_stats(a, c, d, e, config)
+    )(mz, intensity, bins, n_members)
+
+    n_out = jnp.sum(keep, axis=1).astype(jnp.float32)
+    flat_keep = keep.reshape(b * k)
+    (idx,) = jnp.nonzero(flat_keep, size=total_cap, fill_value=b * k)
+    ok = idx < b * k
+    flat_mz = jnp.where(
+        ok, mz_mean.reshape(b * k).at[idx].get(mode="fill", fill_value=0.0), 0.0
+    )
+    flat_int = jnp.where(
+        ok,
+        inten_mean.reshape(b * k).at[idx].get(mode="fill", fill_value=0.0),
+        0.0,
+    )
+    return jnp.concatenate([flat_mz, flat_int, n_out])
+
+

@@ -36,29 +36,31 @@ import jax.numpy as jnp
 from specpride_tpu.config import GapAverageConfig
 
 
-def _gap_average_cluster(
-    mz: jax.Array,  # (M, P) f32
-    intensity: jax.Array,  # (M, P) f32
-    peak_mask: jax.Array,  # (M, P) bool
-    member_mask: jax.Array,  # (M,) bool
+def _gap_average_packed_cluster(
+    mz: jax.Array,  # (K,) f32
+    intensity: jax.Array,  # (K,) f32
+    n_valid: jax.Array,  # () i32 — packed peaks are contiguous
     n_members: jax.Array,  # () i32
     config: GapAverageConfig,
+    out_size: int,
 ):
-    m, p = mz.shape
-    mp = m * p
-    valid = (peak_mask & member_mask[:, None]).reshape(mp)
-    mz_flat = jnp.where(valid, mz.reshape(mp), jnp.inf)
-    int_flat = jnp.where(valid, intensity.reshape(mp), 0.0)
+    """Packed-layout gap average: identical math to ``_gap_average_cluster``
+    but over K packed peaks (the reference concatenates members anyway, ref
+    src/average_spectrum_clustering.py:56-57 — the packed layout IS that
+    concatenation, so no flatten step, no (member, peak) padding, and no
+    member channel: validity is just position < n_valid)."""
+    k = mz.shape[0]
+    valid = jnp.arange(k) < n_valid
+    mz_flat = jnp.where(valid, mz, jnp.inf)
+    int_flat = jnp.where(valid, intensity, 0.0)
 
     order = jnp.argsort(mz_flat, stable=True)
     mz_s = mz_flat[order]
     int_s = int_flat[order]
-    n_valid = jnp.sum(valid).astype(jnp.int32)
 
-    pos = jnp.arange(mp - 1, dtype=jnp.int32)
-    in_valid = pos + 1 < n_valid  # boundary between two valid peaks
+    pos = jnp.arange(k - 1, dtype=jnp.int32)
+    in_valid = pos + 1 < n_valid
     gap = (mz_s[1:] - mz_s[:-1] >= config.mz_accuracy) & in_valid
-    # singleton passthrough: every peak its own group (ref :88-90)
     gap = jnp.where(n_members == 1, in_valid, gap)
 
     if config.tail_mode == "reference":
@@ -70,14 +72,14 @@ def _gap_average_cluster(
     seg = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(gap).astype(jnp.int32)]
     )
-    in_range = jnp.arange(mp) < n_valid
+    in_range = jnp.arange(k) < n_valid
     ones = jnp.where(in_range, 1.0, 0.0)
-    sizes = jax.ops.segment_sum(ones, seg, num_segments=mp, indices_are_sorted=True)
+    sizes = jax.ops.segment_sum(ones, seg, num_segments=k, indices_are_sorted=True)
     mz_sums = jax.ops.segment_sum(
-        jnp.where(in_range, mz_s, 0.0), seg, num_segments=mp, indices_are_sorted=True
+        jnp.where(in_range, mz_s, 0.0), seg, num_segments=k, indices_are_sorted=True
     )
     int_sums = jax.ops.segment_sum(
-        int_s, seg, num_segments=mp, indices_are_sorted=True
+        int_s, seg, num_segments=k, indices_are_sorted=True
     )
 
     nm = n_members.astype(jnp.float32)
@@ -89,33 +91,35 @@ def _gap_average_cluster(
     floor = kept_max / config.dyn_range
     keep &= group_int >= floor
 
-    (idx,) = jnp.nonzero(keep, size=mp, fill_value=mp)
-    valid_out = idx < mp
+    (idx,) = jnp.nonzero(keep, size=out_size, fill_value=k)
+    valid_out = idx < k
     out_mz = jnp.where(valid_out, group_mz.at[idx].get(mode="fill", fill_value=0.0), 0.0)
     out_int = jnp.where(
         valid_out, group_int.at[idx].get(mode="fill", fill_value=0.0), 0.0
     )
-    n_out = jnp.sum(keep).astype(jnp.int32)
-    return out_mz, out_int, n_out
+    # n_out reports the TRUE group count; if it exceeds out_size the caller
+    # must redispatch with a bigger buffer (the first out_size groups are
+    # valid either way — nonzero fills in ascending index order)
+    n_out = jnp.sum(keep).astype(jnp.float32)
+    return jnp.concatenate([out_mz, out_int, n_out[None]])
 
 
-@functools.partial(jax.jit, static_argnames=("config",))
-def gap_average_batch(
-    mz: jax.Array,  # (B, M, P) f32
-    intensity: jax.Array,  # (B, M, P) f32
-    peak_mask: jax.Array,  # (B, M, P) bool
-    member_mask: jax.Array,  # (B, M) bool
+@functools.partial(jax.jit, static_argnames=("config", "out_size"))
+def gap_average_packed(
+    mz: jax.Array,  # (B, K) f32
+    intensity: jax.Array,  # (B, K) f32
+    n_valid: jax.Array,  # (B,) i32
     n_members: jax.Array,  # (B,) i32
     config: GapAverageConfig,
+    out_size: int | None = None,
 ):
-    """vmapped gap-average consensus over a padded cluster batch.
-
-    Returns (out_mz (B, M*P), out_intensity (B, M*P), n_out (B,)); valid
-    output peaks are the first n_out[b] entries of row b in ascending m/z.
-    Precursor m/z / charge / RT estimators are host-side
-    (``backends.numpy_backend.PEPMASS_ESTIMATORS``) — they are O(members)
-    scalar work (ref src/average_spectrum_clustering.py:106-148).
-    """
+    """vmapped packed gap-average.  Returns (B, 2*out_size + 1) fused rows
+    [mz | intensity | n_out] — one device→host transfer per batch.  n_out
+    may exceed out_size (overflow): caller redispatches with out_size=K."""
+    if out_size is None:
+        out_size = mz.shape[1]
     return jax.vmap(
-        lambda a, b, c, d, e: _gap_average_cluster(a, b, c, d, e, config)
-    )(mz, intensity, peak_mask, member_mask, n_members)
+        lambda a, b, c, d: _gap_average_packed_cluster(
+            a, b, c, d, config, out_size
+        )
+    )(mz, intensity, n_valid, n_members)

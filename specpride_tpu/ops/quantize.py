@@ -20,52 +20,42 @@ from __future__ import annotations
 import numpy as np
 
 from specpride_tpu.config import BinMeanConfig, CosineConfig, MedoidConfig
-from specpride_tpu.data.ragged import ClusterBatch
 
 
-def bin_mean_bins(batch: ClusterBatch, config: BinMeanConfig) -> np.ndarray:
-    """(B, M, P) int32 grid-bin indices for the binned-mean consensus.
+def distinct_bins_per_row(bins: np.ndarray, sentinel: int) -> np.ndarray:
+    """(B,) number of distinct non-sentinel bin values per row — the exact
+    per-cluster consensus output bound, used to size the globally-compacted
+    device output buffer (D2H bytes are the bottleneck on tunneled hosts)."""
+    if bins.size == 0:
+        return np.zeros((bins.shape[0],), dtype=np.int64)
+    s = np.sort(bins, axis=1)
+    first = (s[:, :1] < sentinel).astype(np.int64)[:, 0]
+    changes = ((s[:, 1:] != s[:, :-1]) & (s[:, 1:] < sentinel)).sum(axis=1)
+    return first + changes
 
-    Reproduces ref src/binning.py:191-195 in float64: peaks outside
-    [min_mz, max_mz) — and padded peaks — map to the sentinel ``n_bins``.
-    """
+
+def medoid_bins_packed(batch, config: MedoidConfig) -> tuple[np.ndarray, int]:
+    """Packed-layout variant of ``medoid_bins``: (B, K) cluster-relative
+    occupancy bins, sentinel = grid for padding slots."""
     mz = batch.mz64
-    n_bins = config.n_bins
-    in_range = (
-        (mz >= config.min_mz)
-        & (mz < config.max_mz)
-        & batch.peak_mask
-        & batch.member_mask[:, :, None]
-    )
-    bins = ((mz - config.min_mz) / config.bin_size).astype(np.int64)
-    bins = np.clip(bins, 0, n_bins - 1)
-    return np.where(in_range, bins, n_bins).astype(np.int32)
-
-
-def medoid_bins(
-    batch: ClusterBatch, config: MedoidConfig
-) -> tuple[np.ndarray, int]:
-    """Per-cluster-relative occupancy-bin indices for the medoid kernel.
-
-    Global bin = ``int(mz / bin_size)`` (the xcorr-prescore grid, ref
-    src/most_similar_representative.py:15 / numpy oracle
-    ``backends.numpy_backend.xcorr_prescore``).  Bins are shifted by each
-    cluster's minimum occupied bin so the dense occupancy matrix only spans
-    the cluster's m/z range; returns (bins_rel, grid_size) where grid_size is
-    the batch-wide max span rounded up to a multiple of 128 (lane-friendly).
-    """
-    mz = batch.mz64
-    valid = batch.peak_mask & batch.member_mask[:, :, None]
+    valid = batch.member_id >= 0
     bins = (mz / config.bin_size).astype(np.int64)
     big = np.iinfo(np.int64).max
-    per_cluster_min = np.where(valid, bins, big).min(axis=(1, 2))
-    per_cluster_min = np.where(
-        per_cluster_min == big, 0, per_cluster_min
-    )  # all-empty cluster
-    rel = bins - per_cluster_min[:, None, None]
+    per_cluster_min = np.where(valid, bins, big).min(axis=1)
+    per_cluster_min = np.where(per_cluster_min == big, 0, per_cluster_min)
+    rel = bins - per_cluster_min[:, None]
     span = int(np.where(valid, rel, -1).max(initial=0)) + 1
     grid = max(128, ((span + 127) // 128) * 128)
     return np.where(valid, rel, grid).astype(np.int32), grid
+
+
+def cosine_edge_count(last_mz, space):
+    """Edge count of the metric grid ``arange(-space/2, last_mz, space)``
+    (numpy arange length = ceil((stop - start)/step)), float64.  Shared by
+    rep-side quantization (``cosine_bins``) and the per-member pair cutoff
+    (``backends.tpu_backend``) so the grid definition lives in one place."""
+    n = np.ceil((np.asarray(last_mz, dtype=np.float64) + space / 2.0) / space)
+    return np.where(np.isfinite(n), np.maximum(n, 0), 0).astype(np.int32)
 
 
 def cosine_bins(
@@ -98,7 +88,4 @@ def cosine_bins(
     last_idx = np.maximum(n_valid - 1, 0)
     last_mz = np.take_along_axis(mzf, last_idx[..., None], axis=-1)[..., 0]
     last_mz = np.where(n_valid > 0, last_mz, -np.inf)
-    # numpy arange length: ceil((stop - start) / step)
-    n_edges = np.ceil((last_mz + space / 2.0) / space)
-    n_edges = np.where(np.isfinite(n_edges), np.maximum(n_edges, 0), 0)
-    return bins.astype(np.int32), n_edges.astype(np.int32)
+    return bins.astype(np.int32), cosine_edge_count(last_mz, space)

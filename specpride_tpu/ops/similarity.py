@@ -46,24 +46,25 @@ def _occupancy(bins: jax.Array, grid: int) -> jax.Array:
     return jax.vmap(one)(bins)
 
 
-@functools.partial(jax.jit, static_argnames=("grid",))
-def shared_bins_batch(bins: jax.Array, grid: int) -> jax.Array:
-    """(B, M, P) i32 bins (sentinel = grid) → (B, M, M) i32 shared
-    occupied-bin counts for every member pair, via one batched gram matmul.
+@functools.partial(jax.jit, static_argnames=("grid", "m"))
+def shared_bins_packed(
+    bins: jax.Array,  # (B, K) i32 cluster-relative, sentinel = grid
+    member_id: jax.Array,  # (B, K) i32, -1 = padding
+    grid: int,
+    m: int,
+) -> jax.Array:
+    """Packed-layout variant of ``shared_bins_batch``: the (M, grid)
+    occupancy matrix is built by one flat scatter of K packed peaks at
+    ``member_id * grid + bin``, then the same batched gram matmul."""
 
-    The counts are exact small integers; the final prescore division,
-    total-distance sum and lowest-index argmin (ref
-    src/most_similar_representative.py:95-110) happen host-side in float64
-    (``backends.tpu_backend.TpuBackend.medoid_indices``) — per-pair f32
-    division on device rounds differently from the reference's f64 and can
-    flip exact-tie medoid picks.  Device does the O(M²·G) work, host the
-    O(M²) finalize.
-    """
-    def one(b):
-        occ = _occupancy(b, grid)
+    def one(b, mid):
+        valid = (mid >= 0) & (b < grid)
+        flat = jnp.where(valid, mid * grid + b, m * grid)
+        occ = jnp.zeros((m * grid,), jnp.float32).at[flat].add(1.0, mode="drop")
+        occ = jnp.minimum(occ, 1.0).reshape(m, grid)
         return (occ @ occ.T).astype(jnp.int32)  # MXU
 
-    return jax.vmap(one)(bins)
+    return jax.vmap(one)(bins, member_id)
 
 
 def medoid_finalize(
@@ -96,71 +97,137 @@ def medoid_finalize(
 
 
 # ---------------------------------------------------------------------------
-# Binned cosine
+# Binned cosine — packed layout
 # ---------------------------------------------------------------------------
 
-def _pair_cosine(
-    bins_a: jax.Array,  # (Pa,) i32, sentinel = huge
-    int_a: jax.Array,  # (Pa,) f32, 0 where invalid
-    bins_b: jax.Array,  # (Pb,) i32
-    int_b: jax.Array,  # (Pb,) f32
-    n_edges: jax.Array,  # () i32: pair edge count (max of the two spectra)
+def _cosine_packed_cluster(
+    rep_bins: jax.Array,  # (Pr,) i32, sentinel = SENT for padding
+    rep_int: jax.Array,  # (Pr,) f32, 0 where invalid
+    rep_edges: jax.Array,  # () i32
+    mem_bins: jax.Array,  # (K,) i32, sentinel = SENT
+    mem_int: jax.Array,  # (K,) f32
+    mem_member: jax.Array,  # (K,) i32, -1 = padding
+    mem_edges: jax.Array,  # (M,) i32 per-member edge counts
+    member_mask: jax.Array,  # (M,) bool
+    n_members: jax.Array,  # () i32
+    m: int,
 ):
-    # peaks beyond the pair's last grid edge are excluded
-    # (ref src/benchmark.py:20-22); bins are f64-exact from the host
+    """All (representative, member) cosines of one cluster from packed peaks.
+
+    Per-bin algebra instead of per-pair grids: sort member peaks by
+    (member, bin) → per-(member, bin) intensity sums; sort rep peaks by bin
+    → per-bin rep sums with a prefix of squared run totals; then each
+    member's dot/norms are segment reductions with an O(log Pr)
+    searchsorted lookup of the rep per-bin sum.  The pair's grid-edge cut
+    (ref src/benchmark.py:20-22: bins beyond the pair's last edge are
+    excluded) becomes a per-member cutoff ``max(rep_edges, mem_edges[m])-2``
+    applied to member contributions directly and to the rep norm via the
+    prefix array.  Device output is just the (M,) cosines.
+    """
     sent = jnp.int32(2**30)
-    last_bin = n_edges - 2  # edges-1 bins; exact-equality edge case measure-zero
-    ba = jnp.where(bins_a <= last_bin, bins_a, sent)
-    bb = jnp.where(bins_b <= last_bin, bins_b, sent)
+    pr = rep_bins.shape[0]
+    k = mem_bins.shape[0]
 
-    keys = jnp.concatenate([ba, bb])
-    va = jnp.concatenate([jnp.where(ba < sent, int_a, 0.0), jnp.zeros_like(int_b)])
-    vb = jnp.concatenate([jnp.zeros_like(int_a), jnp.where(bb < sent, int_b, 0.0)])
-
-    order = jnp.argsort(keys, stable=True)
-    k = keys[order]
-    sa = va[order]
-    sb = vb[order]
-
-    total = keys.shape[0]
-    new_seg = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), (k[1:] != k[:-1]).astype(jnp.int32)]
+    # --- rep side: per-bin sums + prefix of squared run totals
+    r_order = jnp.argsort(rep_bins, stable=True)
+    rb = rep_bins[r_order]
+    ri = rep_int[r_order]
+    r_new = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), (rb[1:] != rb[:-1]).astype(jnp.int32)]
     )
-    seg = jnp.cumsum(new_seg)
-    seg_a = jax.ops.segment_sum(sa, seg, num_segments=total, indices_are_sorted=True)
-    seg_b = jax.ops.segment_sum(sb, seg, num_segments=total, indices_are_sorted=True)
+    r_seg = jnp.cumsum(r_new)
+    r_sum_per_seg = jax.ops.segment_sum(
+        jnp.where(rb < sent, ri, 0.0), r_seg, num_segments=pr,
+        indices_are_sorted=True,
+    )
+    r_sum_at = r_sum_per_seg[r_seg]  # run total broadcast to every element
+    r_last = jnp.concatenate([rb[:-1] != rb[1:], jnp.ones((1,), bool)])
+    r_sq_contrib = jnp.where(r_last & (rb < sent), r_sum_at * r_sum_at, 0.0)
+    r_sq_prefix = jnp.cumsum(r_sq_contrib)  # inclusive, in sorted-bin order
 
-    dot = jnp.sum(seg_a * seg_b)
-    na = jnp.sum(seg_a * seg_a)
-    nb = jnp.sum(seg_b * seg_b)
-    ok = (na > 0) & (nb > 0)
-    return jnp.where(ok, dot / jnp.sqrt(jnp.maximum(na * nb, 1e-30)), 0.0)
+    # --- member side: sort by (member, bin) via two stable argsorts
+    mm = jnp.where(mem_member >= 0, mem_member, m)  # padding sorts last
+    o1 = jnp.argsort(mem_bins, stable=True)
+    o2 = jnp.argsort(mm[o1], stable=True)
+    perm = o1[o2]
+    sb = mem_bins[perm]
+    si = mem_int[perm]
+    sm = mm[perm]
+
+    cutoff = jnp.maximum(rep_edges, mem_edges) - 2  # (M,) last includable bin
+    cut_at = cutoff[jnp.clip(sm, 0, m - 1)]
+    ok = (sm < m) & (sb < sent) & (sb <= cut_at)
+
+    run_new = jnp.concatenate(
+        [
+            jnp.zeros((1,), jnp.int32),
+            ((sb[1:] != sb[:-1]) | (sm[1:] != sm[:-1])).astype(jnp.int32),
+        ]
+    )
+    run_seg = jnp.cumsum(run_new)
+    run_sum = jax.ops.segment_sum(
+        jnp.where(ok, si, 0.0), run_seg, num_segments=k, indices_are_sorted=True
+    )
+    run_sum_at = run_sum[run_seg]
+    is_last = jnp.concatenate(
+        [(sb[:-1] != sb[1:]) | (sm[:-1] != sm[1:]), jnp.ones((1,), bool)]
+    )
+
+    # rep per-bin sum lookup for each member run
+    pos = jnp.searchsorted(rb, sb, side="left")
+    pos_c = jnp.clip(pos, 0, pr - 1)
+    rep_hit = (rb[pos_c] == sb) & (sb < sent)
+    rep_val = jnp.where(rep_hit, r_sum_per_seg[r_seg[pos_c]], 0.0)
+
+    contrib_ok = is_last & ok
+    dots = jax.ops.segment_sum(
+        jnp.where(contrib_ok, run_sum_at * rep_val, 0.0),
+        sm,
+        num_segments=m + 1,
+        indices_are_sorted=True,
+    )[:m]
+    norms = jax.ops.segment_sum(
+        jnp.where(contrib_ok, run_sum_at * run_sum_at, 0.0),
+        sm,
+        num_segments=m + 1,
+        indices_are_sorted=True,
+    )[:m]
+
+    # rep norm per member: prefix of squared run totals up to the cutoff
+    npos = jnp.searchsorted(rb, cutoff + 1, side="left")  # first bin > cutoff
+    rep_norm = jnp.where(
+        npos > 0, r_sq_prefix[jnp.clip(npos - 1, 0, pr - 1)], 0.0
+    )
+
+    okc = (norms > 0) & (rep_norm > 0)
+    cos = jnp.where(
+        okc, dots / jnp.sqrt(jnp.maximum(norms * rep_norm, 1e-30)), 0.0
+    )
+    cos = jnp.where(member_mask, cos, 0.0)
+    mean = jnp.sum(cos) / jnp.maximum(n_members.astype(jnp.float32), 1.0)
+    return mean, cos
 
 
-@jax.jit
-def cosine_rep_vs_members(
+@functools.partial(jax.jit, static_argnames=("m",))
+def cosine_packed(
     rep_bins: jax.Array,  # (B, Pr) i32
     rep_int: jax.Array,  # (B, Pr) f32
     rep_edges: jax.Array,  # (B,) i32
-    mem_bins: jax.Array,  # (B, M, P) i32
-    mem_int: jax.Array,  # (B, M, P) f32
+    mem_bins: jax.Array,  # (B, K) i32
+    mem_int: jax.Array,  # (B, K) f32
+    mem_member: jax.Array,  # (B, K) i32
     mem_edges: jax.Array,  # (B, M) i32
     member_mask: jax.Array,  # (B, M) bool
     n_members: jax.Array,  # (B,) i32
+    m: int,
 ):
-    """Average binned cosine of each cluster's representative to its members
-    (ref src/benchmark.py:31-38).  Returns ((B,) mean cosine, (B, M) pair
-    cosines)."""
-
-    def per_cluster(rb, ri, re, mb, mi, me, mask, n):
-        pair = jax.vmap(
-            lambda b, i, e: _pair_cosine(rb, ri, b, i, jnp.maximum(re, e))
-        )(mb, mi, me)
-        pair = jnp.where(mask, pair, 0.0)
-        mean = jnp.sum(pair) / jnp.maximum(n.astype(jnp.float32), 1.0)
-        return mean, pair
-
-    return jax.vmap(per_cluster)(
-        rep_bins, rep_int, rep_edges, mem_bins, mem_int, mem_edges,
-        member_mask, n_members,
+    """Packed rep-vs-members binned cosine (ref src/benchmark.py:31-38).
+    Returns ((B,) mean cosine, (B, M) pair cosines) — the only D2H bytes."""
+    return jax.vmap(
+        lambda a, b, c, d, e, f, g, h, i: _cosine_packed_cluster(
+            a, b, c, d, e, f, g, h, i, m
+        )
+    )(
+        rep_bins, rep_int, rep_edges, mem_bins, mem_int, mem_member,
+        mem_edges, member_mask, n_members,
     )

@@ -1,0 +1,26 @@
+"""Device-mesh parallelism: shard the cluster axis across TPU chips/hosts.
+
+The reference is single-threaded Python (survey §2 "Parallelism: none");
+the workload is embarrassingly parallel across clusters, so the scale-out
+design is: one 1-D ``jax.sharding.Mesh`` over all devices, every batched
+kernel input sharded along its leading (cluster) axis, XLA SPMD-partitions
+the vmapped programs with zero cross-device communication in the hot loop,
+and the only collectives are the output all-gather and a final metrics
+all-reduce (survey §2 / BASELINE.json config 5).
+"""
+
+from specpride_tpu.parallel.mesh import (
+    CLUSTER_AXIS,
+    cluster_mesh,
+    cluster_sharding,
+    initialize_distributed,
+    shard_batch_arrays,
+)
+
+__all__ = [
+    "CLUSTER_AXIS",
+    "cluster_mesh",
+    "cluster_sharding",
+    "initialize_distributed",
+    "shard_batch_arrays",
+]

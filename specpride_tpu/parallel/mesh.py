@@ -1,0 +1,80 @@
+"""Cluster-axis device mesh and sharding helpers.
+
+Design (TPU-first, survey §2): per-cluster kernels are independent, so the
+entire framework parallelises over ONE mesh axis — ``"clusters"`` — laid out
+over all local+remote devices.  Inputs are sharded along their leading axis
+with ``NamedSharding(mesh, P("clusters", None, ...))``; the jitted vmapped
+kernels then SPMD-partition with no collectives in the hot loop (XLA inserts
+only the final all-gather when the host fetches results).
+
+Multi-host: ``initialize_distributed`` wraps ``jax.distributed.initialize``;
+after it, ``cluster_mesh()`` spans the full pod (ICI within a slice, DCN
+across slices) and each host feeds its own file shard (BASELINE.json
+config 5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLUSTER_AXIS = "clusters"
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the multi-host runtime (JAX's coordination service over
+    ICI/DCN — the capability slot NCCL/MPI fills in torch frameworks; the
+    reference has no equivalent).  No-op if already initialized or
+    single-process with no coordinator configured."""
+    if jax.process_count() > 1:
+        return
+    if coordinator_address is None:
+        return  # single-process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def cluster_mesh(devices: list | None = None) -> Mesh:
+    """1-D mesh over all (or the given) devices, axis name "clusters"."""
+    devs = np.array(devices if devices is not None else jax.devices())
+    return Mesh(devs, (CLUSTER_AXIS,))
+
+
+def cluster_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Sharding that splits the leading (cluster) axis and replicates the
+    rest: P("clusters", None, ...)."""
+    return NamedSharding(mesh, P(CLUSTER_AXIS, *([None] * (ndim - 1))))
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int) -> np.ndarray:
+    """Zero-pad the leading axis up to a multiple of ``multiple`` (sharding
+    requires the cluster axis divisible by the mesh size; padded clusters
+    have all-False masks and are discarded on unpad)."""
+    b = arr.shape[0]
+    rem = (-b) % multiple
+    if rem == 0:
+        return arr
+    pad = [(0, rem)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+def shard_batch_arrays(mesh: Mesh, *arrays: np.ndarray) -> tuple[jax.Array, ...]:
+    """device_put each array with its leading axis split over the mesh.
+
+    Leading axes must already be divisible by the mesh size (use
+    ``pad_to_multiple``).  Returns committed sharded jax.Arrays; passing
+    them into a jitted kernel makes XLA partition the whole program.
+    """
+    out = []
+    for a in arrays:
+        out.append(jax.device_put(a, cluster_sharding(mesh, a.ndim)))
+    return tuple(out)

@@ -7,12 +7,19 @@ Must run before jax initialises, hence the env mutation at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# force CPU even when the shell points JAX at a real accelerator
+# (JAX_PLATFORMS=axon/tpu): unit tests must see 8 virtual devices, and
+# per-shape TPU compiles would dominate suite runtime.  Real-hardware runs
+# happen via bench.py.  A TPU plugin may already be registered by a
+# sitecustomize hook before this file runs, so the env vars alone are not
+# enough — the jax.config updates below override it.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest

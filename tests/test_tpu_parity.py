@@ -219,6 +219,26 @@ class TestGapAverageParity:
             oracle[0].intensity, device[0].intensity, rtol=1e-6
         )
 
+    def test_output_buffer_overflow_redispatch(self, rng, backend):
+        """A cluster whose group count exceeds the capped device output
+        buffer must be redispatched transparently (singleton with many
+        peaks: every peak its own group)."""
+        n = 3000  # > max(512, bucket/4) for the 8192 total-peak bucket
+        mz = np.sort(rng.uniform(100.0, 1900.0, size=n))
+        keep = np.concatenate([[True], np.diff(mz) >= 0.02])
+        mz = mz[keep]
+        s = Spectrum(
+            mz=mz,
+            intensity=rng.uniform(10.0, 1e4, size=mz.size),
+            precursor_mz=500.0,
+            precursor_charge=2,
+            title="c1;u1",
+        )
+        oracle = nb.run_gap_average([Cluster("c1", [s])])
+        device = backend.run_gap_average([Cluster("c1", [s])])
+        assert oracle[0].n_peaks == device[0].n_peaks == mz.size
+        np.testing.assert_allclose(oracle[0].mz, device[0].mz, rtol=1e-6, atol=1e-3)
+
     @pytest.mark.parametrize(
         "pepmass", ["naive_average", "neutral_average", "lower_median"]
     )
