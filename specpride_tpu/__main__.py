@@ -1,0 +1,4 @@
+"""``python -m specpride_tpu`` entry point."""
+from specpride_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,304 @@
+"""One coherent CLI over every capability (C1-C8).
+
+The reference spreads four inconsistent CLI styles across its scripts
+(survey §5 "Config / flag system"); here a single argparse command tree:
+
+    specpride convert    raw spectra + msms.txt + MaRaCluster TSV → clustered MGF
+    specpride consensus  clustered MGF → representatives (bin-mean / gap-average)
+    specpride select     clustered MGF → representatives (best-score / medoid)
+    specpride evaluate   representatives + clustered MGF → quality report
+    specpride plot       mirror plots (vs consensus / vs theoretical peptide)
+
+Every compute command takes ``--backend {numpy,tpu}`` (BASELINE.json north
+star) — 'numpy' is the oracle path, 'tpu' the batched device path (which
+also runs on CPU when no accelerator is present).  Checkpoint/resume: with
+``--checkpoint FILE`` the consensus/select commands append output per chunk
+and record completed cluster ids, so an interrupted run resumes where it
+stopped (survey §5 "Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from specpride_tpu.config import (
+    BestSpectrumConfig,
+    BinMeanConfig,
+    CosineConfig,
+    GapAverageConfig,
+    MedoidConfig,
+)
+from specpride_tpu.data.peaks import Cluster, group_into_clusters
+from specpride_tpu.io.mgf import read_mgf, write_mgf
+from specpride_tpu.utils.observe import RunStats, configure_logging, logger
+
+
+def _add_backend(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--backend", choices=["numpy", "tpu"], default="tpu",
+        help="numpy oracle or batched device execution (default tpu)",
+    )
+
+
+def _get_backend(name: str):
+    if name == "numpy":
+        from specpride_tpu.backends import numpy_backend
+
+        return numpy_backend
+    from specpride_tpu.backends.tpu_backend import TpuBackend
+
+    return TpuBackend()
+
+
+def _run_method(backend, method: str, clusters, args):
+    if method == "bin-mean":
+        config = BinMeanConfig(
+            min_mz=args.min_mz, max_mz=args.max_mz, bin_size=args.bin_size,
+            apply_peak_quorum=not args.no_quorum,
+            quorum_fraction=args.quorum_fraction,
+        )
+        return backend.run_bin_mean(clusters, config)
+    if method == "gap-average":
+        config = GapAverageConfig(
+            mz_accuracy=args.mz_accuracy, dyn_range=args.dyn_range,
+            min_fraction=args.min_fraction, tail_mode=args.tail_mode,
+            pepmass=args.pepmass, rt=args.rt,
+        )
+        return backend.run_gap_average(clusters, config)
+    if method == "medoid":
+        return backend.run_medoid(clusters, MedoidConfig(bin_size=args.xcorr_bin))
+    if method == "best":
+        from specpride_tpu.io.maxquant import read_msms_scores
+
+        scores = read_msms_scores(args.msms, args.px_accession)
+        return backend.run_best_spectrum(
+            clusters, scores, BestSpectrumConfig(px_accession=args.px_accession)
+        )
+    raise ValueError(method)
+
+
+def _checkpointed_run(backend, method, clusters, args, stats: RunStats):
+    """Chunked execution with a resume manifest (survey §5)."""
+    done: set[str] = set()
+    if args.checkpoint and os.path.exists(args.checkpoint):
+        with open(args.checkpoint) as fh:
+            done = set(json.load(fh).get("done", []))
+        logger.info("resuming: %d clusters already done", len(done))
+
+    todo = [c for c in clusters if c.cluster_id not in done]
+    stats.count("clusters_skipped_done", len(clusters) - len(todo))
+    first_write = not (args.checkpoint and done)
+    chunk = args.checkpoint_every if args.checkpoint else len(todo) or 1
+
+    for start in range(0, len(todo), chunk):
+        part = todo[start : start + chunk]
+        with stats.phase("compute"):
+            reps = _run_method(backend, method, part, args)
+        with stats.phase("write"):
+            write_mgf(reps, args.output, append=not first_write)
+        first_write = False
+        stats.count("clusters", len(part))
+        stats.count("representatives", len(reps))
+        done.update(c.cluster_id for c in part)
+        if args.checkpoint:
+            tmp = args.checkpoint + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump({"done": sorted(done)}, fh)
+            os.replace(tmp, args.checkpoint)
+
+
+def _load_clusters(path: str, stats: RunStats) -> list[Cluster]:
+    with stats.phase("parse"):
+        spectra = read_mgf(path)
+        clusters = group_into_clusters(spectra)
+    stats.count("spectra_in", len(spectra))
+    stats.count("peaks_in", sum(s.n_peaks for s in spectra))
+    return clusters
+
+
+def cmd_consensus(args) -> int:
+    stats = RunStats()
+    clusters = _load_clusters(args.input, stats)
+    backend = _get_backend(args.backend)
+    _checkpointed_run(backend, args.method, clusters, args, stats)
+    logger.info(
+        "consensus done: %.1f clusters/sec", stats.throughput("clusters")
+    )
+    print(json.dumps(stats.summary()), file=sys.stderr)
+    return 0
+
+
+def cmd_select(args) -> int:
+    stats = RunStats()
+    clusters = _load_clusters(args.input, stats)
+    backend = _get_backend(args.backend)
+    _checkpointed_run(backend, args.method, clusters, args, stats)
+    print(json.dumps(stats.summary()), file=sys.stderr)
+    return 0
+
+
+def cmd_convert(args) -> int:
+    from specpride_tpu import convert
+
+    stats = RunStats()
+    src = args.input
+    with stats.phase("convert"):
+        if src.endswith((".mzml", ".mzML", ".mzml.gz", ".mzML.gz")):
+            n = convert.convert_mzml(
+                src, args.msms, args.clusters, args.output, args.raw_name,
+                BestSpectrumConfig(px_accession=args.px_accession),
+            )
+        else:
+            n = convert.convert_mgf(
+                src, args.msms, args.clusters, args.output,
+                args.raw_name or os.path.basename(src).rsplit(".", 1)[0],
+                BestSpectrumConfig(px_accession=args.px_accession),
+            )
+    stats.count("spectra_out", n)
+    print(json.dumps(stats.summary()), file=sys.stderr)
+    return 0
+
+
+def cmd_evaluate(args) -> int:
+    from specpride_tpu import metrics
+
+    stats = RunStats()
+    reps = {s.cluster_id: s for s in read_mgf(args.representatives)}
+    clusters = _load_clusters(args.clustered, stats)
+    pairs = [(reps[c.cluster_id], c) for c in clusters if c.cluster_id in reps]
+    stats.count("clusters_missing_rep", len(clusters) - len(pairs))
+    with stats.phase("evaluate"):
+        results = metrics.evaluate(
+            [p[0] for p in pairs],
+            [p[1] for p in pairs],
+            backend=args.backend,
+            cosine_config=CosineConfig(),
+        )
+    summary = metrics.summarize(results)
+    if args.report:
+        metrics.write_report(results, args.report, args.format)
+    print(json.dumps(summary))
+    return 0
+
+
+def cmd_plot(args) -> int:
+    from specpride_tpu import viz
+    from specpride_tpu.data.peaks import peptide_from_usi
+
+    clusters = {
+        c.cluster_id: c for c in group_into_clusters(read_mgf(args.clustered))
+    }
+    if args.cluster_id not in clusters:
+        print(f"cluster {args.cluster_id!r} not found", file=sys.stderr)
+        return 1
+    cluster = clusters[args.cluster_id]
+    if args.consensus:
+        reps = {s.cluster_id: s for s in read_mgf(args.consensus)}
+        paths = viz.plot_cluster_vs_consensus(
+            cluster.members, reps[args.cluster_id], args.out_prefix
+        )
+    else:
+        peptide = args.peptide
+        charge = cluster.members[0].precursor_charge
+        if not peptide:
+            for s in cluster.members:
+                pep, z = peptide_from_usi(s.usi)
+                if pep:
+                    peptide, charge = pep, z or charge
+                    break
+        if not peptide:
+            print("no peptide known for cluster; pass --peptide", file=sys.stderr)
+            return 1
+        paths = viz.plot_cluster_vs_theoretical(
+            cluster.members, peptide, charge, args.out_prefix
+        )
+    print("\n".join(paths))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="specpride",
+        description="TPU-native representative-spectrum framework",
+    )
+    ap.add_argument("-v", "--verbose", action="count", default=0)
+    ap.add_argument("--log-json", action="store_true",
+                    help="structured JSON logs on stderr")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    pc = sub.add_parser("consensus", help="merge clusters into consensus spectra")
+    pc.add_argument("input")
+    pc.add_argument("output")
+    pc.add_argument("--method", choices=["bin-mean", "gap-average"],
+                    default="bin-mean")
+    _add_backend(pc)
+    pc.add_argument("--min-mz", type=float, default=100.0)
+    pc.add_argument("--max-mz", type=float, default=2000.0)
+    pc.add_argument("--bin-size", type=float, default=0.02)
+    pc.add_argument("--no-quorum", action="store_true")
+    pc.add_argument("--quorum-fraction", type=float, default=0.25)
+    pc.add_argument("--mz-accuracy", type=float, default=0.01)
+    pc.add_argument("--dyn-range", type=float, default=1000.0)
+    pc.add_argument("--min-fraction", type=float, default=0.5)
+    pc.add_argument("--tail-mode", choices=["reference", "split"],
+                    default="reference")
+    pc.add_argument("--pepmass", choices=["naive_average", "neutral_average",
+                                          "lower_median"],
+                    default="lower_median")
+    pc.add_argument("--rt", choices=["median", "mass_lower_median"],
+                    default="median")
+    pc.add_argument("--checkpoint", help="resume manifest path")
+    pc.add_argument("--checkpoint-every", type=int, default=512)
+    pc.set_defaults(fn=cmd_consensus)
+
+    ps = sub.add_parser("select", help="pick an existing member per cluster")
+    ps.add_argument("input")
+    ps.add_argument("output")
+    ps.add_argument("--method", choices=["best", "medoid"], default="medoid")
+    _add_backend(ps)
+    ps.add_argument("--msms", help="MaxQuant msms.txt (for --method best)")
+    ps.add_argument("--px-accession", default="PXD004732")
+    ps.add_argument("--xcorr-bin", type=float, default=0.1)
+    ps.add_argument("--checkpoint", help="resume manifest path")
+    ps.add_argument("--checkpoint-every", type=int, default=512)
+    ps.set_defaults(fn=cmd_select)
+
+    pv = sub.add_parser("convert", help="build the clustered-MGF interchange file")
+    pv.add_argument("input", help="raw spectra (.mgf or .mzML)")
+    pv.add_argument("output")
+    pv.add_argument("--msms", required=True, help="MaxQuant msms.txt")
+    pv.add_argument("--clusters", required=True, help="MaRaCluster TSV")
+    pv.add_argument("--raw-name", help="raw file name for USIs")
+    pv.add_argument("--px-accession", default="PXD004732")
+    pv.set_defaults(fn=cmd_convert)
+
+    pe = sub.add_parser("evaluate", help="quality metrics for representatives")
+    pe.add_argument("representatives")
+    pe.add_argument("clustered")
+    _add_backend(pe)
+    pe.add_argument("--report", help="write per-cluster report to this path")
+    pe.add_argument("--format", choices=["json", "csv"], default="json")
+    pe.set_defaults(fn=cmd_evaluate)
+
+    pp = sub.add_parser("plot", help="mirror plots for one cluster")
+    pp.add_argument("clustered")
+    pp.add_argument("cluster_id")
+    pp.add_argument("out_prefix")
+    pp.add_argument("--consensus", help="representatives MGF (vs-consensus mode)")
+    pp.add_argument("--peptide", help="peptide for the theoretical mirror")
+    pp.set_defaults(fn=cmd_plot)
+
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    configure_logging(args.verbose, args.log_json)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

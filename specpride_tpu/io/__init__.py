@@ -1,6 +1,7 @@
 from specpride_tpu.io.mgf import read_mgf, write_mgf, IndexedMGF
 from specpride_tpu.io.maracluster import read_maracluster_clusters, scan_to_cluster
 from specpride_tpu.io.maxquant import read_msms_scores, read_msms_peptides
+from specpride_tpu.io.mzml import iter_mzml, read_mzml_scans, write_mzml
 
 __all__ = [
     "read_mgf",
@@ -10,4 +11,7 @@ __all__ = [
     "scan_to_cluster",
     "read_msms_scores",
     "read_msms_peptides",
+    "iter_mzml",
+    "read_mzml_scans",
+    "write_mzml",
 ]

@@ -1,0 +1,139 @@
+"""Quality evaluation of representative spectra (C5, ref src/benchmark.py).
+
+Two metrics, per cluster:
+
+* mean binned cosine of the representative to the cluster members
+  (ref src/benchmark.py:31-38) — numpy oracle or batched device kernel;
+* fraction of the representative's ion current explained by b/y fragments
+  of the identified peptide (ref src/benchmark.py:40-61) — host-side
+  (fragment theory is tiny; ref's version contains an undefined-variable
+  bug we do not reproduce, see ops.fragments.fraction_of_by).
+
+The peptide is taken from the representative's USI interpretation suffix
+(``...:PEPTIDE/z``) when present.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Sequence
+
+import numpy as np
+
+from specpride_tpu.config import CosineConfig, FragmentConfig
+from specpride_tpu.data.peaks import Cluster, Spectrum, peptide_from_usi
+from specpride_tpu.ops.fragments import fraction_of_by
+
+
+@dataclasses.dataclass
+class ClusterQuality:
+    cluster_id: str
+    n_members: int
+    n_peaks: int
+    avg_cosine: float
+    by_fraction: float | None  # None when no peptide is known
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def evaluate(
+    representatives: Sequence[Spectrum],
+    clusters: Sequence[Cluster],
+    backend: str = "tpu",
+    cosine_config: CosineConfig = CosineConfig(),
+    fragment_config: FragmentConfig = FragmentConfig(),
+) -> list[ClusterQuality]:
+    """Score each representative against its cluster."""
+    if len(representatives) != len(clusters):
+        raise ValueError("representatives and clusters must align")
+
+    if backend == "numpy":
+        from specpride_tpu.backends import numpy_backend as nb
+
+        cosines = np.array(
+            [
+                nb.average_cosine(r, c.members, cosine_config)
+                for r, c in zip(representatives, clusters)
+            ]
+        )
+    else:
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+
+        cosines = TpuBackend().average_cosines(
+            list(representatives), list(clusters), cosine_config
+        )
+
+    out: list[ClusterQuality] = []
+    for rep, cluster, cos in zip(representatives, clusters, cosines):
+        peptide = None
+        for s in [rep, *cluster.members]:
+            pep, _ = peptide_from_usi(s.usi)
+            if pep:
+                peptide = pep
+                break
+        frac = None
+        if peptide is not None:
+            frac = fraction_of_by(
+                peptide,
+                rep.precursor_mz,
+                rep.precursor_charge,
+                rep.mz,
+                rep.intensity,
+                tol=fragment_config.tol,
+                tol_mode=fragment_config.tol_mode,
+                min_mz=fragment_config.min_mz,
+                max_mz=fragment_config.max_mz,
+            )
+        out.append(
+            ClusterQuality(
+                cluster_id=cluster.cluster_id,
+                n_members=cluster.n_members,
+                n_peaks=rep.n_peaks,
+                avg_cosine=float(cos),
+                by_fraction=frac,
+            )
+        )
+    return out
+
+
+def summarize(results: Sequence[ClusterQuality]) -> dict:
+    """Aggregate metrics across clusters (the numbers the reference prints
+    one at a time in its __main__ self-test, ref src/benchmark.py:63-80)."""
+    cosines = [r.avg_cosine for r in results]
+    fracs = [r.by_fraction for r in results if r.by_fraction is not None]
+    return {
+        "n_clusters": len(results),
+        "mean_cosine": float(np.mean(cosines)) if cosines else 0.0,
+        "median_cosine": float(np.median(cosines)) if cosines else 0.0,
+        "mean_by_fraction": float(np.mean(fracs)) if fracs else None,
+        "n_with_peptide": len(fracs),
+    }
+
+
+def write_report(
+    results: Sequence[ClusterQuality], path: str, fmt: str = "json"
+) -> None:
+    """JSON or CSV report."""
+    if fmt == "json":
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(
+                {
+                    "summary": summarize(results),
+                    "clusters": [r.to_dict() for r in results],
+                },
+                fh,
+                indent=1,
+            )
+    elif fmt == "csv":
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write("cluster_id,n_members,n_peaks,avg_cosine,by_fraction\n")
+            for r in results:
+                frac = "" if r.by_fraction is None else f"{r.by_fraction:.6f}"
+                fh.write(
+                    f"{r.cluster_id},{r.n_members},{r.n_peaks},"
+                    f"{r.avg_cosine:.6f},{frac}\n"
+                )
+    else:
+        raise ValueError(f"unknown report format {fmt!r}")

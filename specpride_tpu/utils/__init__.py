@@ -1,0 +1,4 @@
+"""Utilities: observability (logging, counters, timers, profiler hooks)."""
+from specpride_tpu.utils.observe import RunStats, configure_logging, device_trace
+
+__all__ = ["RunStats", "configure_logging", "device_trace"]

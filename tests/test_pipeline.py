@@ -1,0 +1,255 @@
+"""End-to-end pipeline tests: mzML I/O, converter, metrics, viz, CLI."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.convert import convert_mgf, convert_mzml
+from specpride_tpu.data.peaks import Cluster, Spectrum, group_into_clusters
+from specpride_tpu.io.mgf import read_mgf, write_mgf
+from specpride_tpu.io.mzml import iter_mzml, read_mzml_scans, write_mzml
+from specpride_tpu import metrics
+
+from conftest import make_cluster, make_spectrum
+
+
+@pytest.fixture
+def raw_spectra(rng):
+    """Raw (unclustered) spectra with scan-style titles."""
+    out = []
+    for scan in range(100, 110):
+        s = make_spectrum(rng, n_peaks=30, scan=scan)
+        s.title = f"run1.{scan}.{scan}.2 File:run1.raw scan={scan}"
+        out.append((scan, s))
+    return out
+
+
+def write_inputs(tmp_path, raw_spectra):
+    mgf = tmp_path / "raw.mgf"
+    write_mgf([s for _, s in raw_spectra], mgf)
+    # msms.txt: MaxQuant columns; col 1 = scan, col 7 = _PEPTIDE_
+    msms = tmp_path / "msms.txt"
+    header = [
+        "Raw file", "Scan number", "c2", "c3", "c4", "c5", "c6",
+        "Modified sequence", "Score",
+    ]
+    lines = ["\t".join(header)]
+    for scan, _ in raw_spectra[:8]:  # last two scans have no ID
+        lines.append(
+            "\t".join(
+                ["run1", str(scan), "x", "x", "x", "x", "x",
+                 "_PEPTIDEK_", str(100.0 + scan)]
+            )
+        )
+    msms.write_text("\n".join(lines) + "\n")
+    # MaRaCluster TSV: two clusters of four scans each
+    tsv = tmp_path / "clusters.tsv"
+    rows = []
+    for scan, _ in raw_spectra[:4]:
+        rows.append(f"run1.raw\t{scan}\t0.9")
+    rows.append("")
+    for scan, _ in raw_spectra[4:8]:
+        rows.append(f"run1.raw\t{scan}\t0.9")
+    rows.append("")
+    tsv.write_text("\n".join(rows))
+    return mgf, msms, tsv
+
+
+class TestMzml:
+    def test_round_trip(self, tmp_path, rng):
+        specs = [
+            (100 + i, make_spectrum(rng, n_peaks=25, scan=100 + i), {})
+            for i in range(5)
+        ]
+        path = tmp_path / "t.mzML"
+        write_mzml(specs, path)
+        back = read_mzml_scans(path)
+        assert set(back) == {100, 101, 102, 103, 104}
+        for scan, orig, _ in specs:
+            got = back[scan]
+            np.testing.assert_allclose(got.mz, orig.mz)
+            np.testing.assert_allclose(got.intensity, orig.intensity)
+            assert got.precursor_charge == orig.precursor_charge
+            np.testing.assert_allclose(got.precursor_mz, orig.precursor_mz)
+            np.testing.assert_allclose(got.rt, orig.rt)
+
+    def test_scan_filter(self, tmp_path, rng):
+        specs = [
+            (200 + i, make_spectrum(rng, n_peaks=10, scan=200 + i), {})
+            for i in range(4)
+        ]
+        path = tmp_path / "t.mzML"
+        write_mzml(specs, path)
+        got = read_mzml_scans(path, scans={201, 203})
+        assert set(got) == {201, 203}
+
+    def test_iter_yields_all(self, tmp_path, rng):
+        specs = [(i, make_spectrum(rng, n_peaks=5, scan=i), {}) for i in (1, 2)]
+        path = tmp_path / "t.mzML"
+        write_mzml(specs, path)
+        assert len(list(iter_mzml(path))) == 2
+
+
+class TestConvert:
+    def test_convert_mgf(self, tmp_path, rng, raw_spectra):
+        mgf, msms, tsv = write_inputs(tmp_path, raw_spectra)
+        out = tmp_path / "clustered.mgf"
+        n = convert_mgf(mgf, msms, tsv, out, "run1.raw")
+        assert n == 8  # scans without peptide or cluster are dropped
+        clusters = group_into_clusters(read_mgf(out))
+        assert sorted(c.cluster_id for c in clusters) == ["cluster-1", "cluster-2"]
+        assert all(c.n_members == 4 for c in clusters)
+        # titles carry the USI with peptide interpretation
+        s = clusters[0].members[0]
+        assert s.usi.startswith("mzspec:PXD004732:run1.raw:scan:")
+        assert s.usi.endswith("PEPTIDEK/2")
+
+    def test_convert_mzml(self, tmp_path, rng, raw_spectra):
+        _, msms, tsv = write_inputs(tmp_path, raw_spectra)
+        mzml = tmp_path / "raw.mzML"
+        write_mzml([(scan, s, {}) for scan, s in raw_spectra], mzml)
+        out = tmp_path / "clustered.mgf"
+        n = convert_mzml(mzml, msms, tsv, out, "run1.raw")
+        assert n == 8
+        clusters = group_into_clusters(read_mgf(out))
+        assert len(clusters) == 2
+
+
+class TestMetrics:
+    def test_evaluate_and_report(self, tmp_path, rng):
+        from specpride_tpu.backends import numpy_backend as nb
+
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=40)
+            for i in range(3)
+        ]
+        reps = nb.run_bin_mean(clusters)
+        for backend in ("numpy", "tpu"):
+            results = metrics.evaluate(reps, clusters, backend=backend)
+            assert len(results) == 3
+            assert all(0.0 <= r.avg_cosine <= 1.0 for r in results)
+        summary = metrics.summarize(results)
+        assert summary["n_clusters"] == 3
+        report = tmp_path / "report.json"
+        metrics.write_report(results, str(report))
+        data = json.loads(report.read_text())
+        assert len(data["clusters"]) == 3
+
+    def test_by_fraction_with_peptide(self, rng):
+        c = make_cluster(rng, n_members=2, n_peaks=30)
+        for s in c.members:
+            s.title = s.title + ":PEPTIDEK/2"
+        from specpride_tpu.backends import numpy_backend as nb
+
+        reps = nb.run_medoid([c])
+        results = metrics.evaluate(reps, [c], backend="numpy")
+        assert results[0].by_fraction is not None
+        assert 0.0 <= results[0].by_fraction <= 1.0
+
+
+class TestViz:
+    def test_mirror_plots(self, tmp_path, rng):
+        from specpride_tpu.backends import numpy_backend as nb
+        from specpride_tpu import viz
+
+        c = make_cluster(rng, n_members=2, n_peaks=40)
+        rep = nb.run_bin_mean([c])[0]
+        paths = viz.plot_cluster_vs_consensus(
+            c.members, rep, str(tmp_path / "mirror")
+        )
+        assert len(paths) == 2
+        assert all(os.path.getsize(p) > 1000 for p in paths)
+        paths = viz.plot_cluster_vs_theoretical(
+            c.members[:1], "PEPTIDEK", 2, str(tmp_path / "theo")
+        )
+        assert os.path.getsize(paths[0]) > 1000
+
+
+class TestCli:
+    def test_full_pipeline(self, tmp_path, rng, raw_spectra):
+        mgf, msms, tsv = write_inputs(tmp_path, raw_spectra)
+        clustered = tmp_path / "clustered.mgf"
+        assert cli_main([
+            "convert", str(mgf), str(clustered),
+            "--msms", str(msms), "--clusters", str(tsv), "--raw-name", "run1.raw",
+        ]) == 0
+
+        for method in ("bin-mean", "gap-average"):
+            out = tmp_path / f"consensus_{method}.mgf"
+            assert cli_main([
+                "consensus", str(clustered), str(out), "--method", method,
+                "--backend", "tpu",
+            ]) == 0
+            reps = read_mgf(out)
+            assert len(reps) == 2
+
+        out = tmp_path / "medoid.mgf"
+        assert cli_main(["select", str(clustered), str(out),
+                         "--method", "medoid"]) == 0
+        assert len(read_mgf(out)) == 2
+
+        out = tmp_path / "best.mgf"
+        assert cli_main(["select", str(clustered), str(out), "--method", "best",
+                         "--msms", str(msms)]) == 0
+        assert len(read_mgf(out)) == 2
+
+        report = tmp_path / "report.json"
+        assert cli_main([
+            "evaluate", str(tmp_path / "consensus_bin-mean.mgf"),
+            str(clustered), "--report", str(report),
+        ]) == 0
+        assert json.loads(report.read_text())["summary"]["n_clusters"] == 2
+
+        assert cli_main([
+            "plot", str(clustered), "cluster-1", str(tmp_path / "p"),
+            "--consensus", str(tmp_path / "consensus_bin-mean.mgf"),
+        ]) == 0
+
+    def test_checkpoint_resume(self, tmp_path, rng):
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=30)
+            for i in range(6)
+        ]
+        spectra = [s for c in clusters for s in c.members]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf(spectra, clustered)
+        out = tmp_path / "out.mgf"
+        ckpt = tmp_path / "ckpt.json"
+        assert cli_main([
+            "consensus", str(clustered), str(out),
+            "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+        ]) == 0
+        assert len(read_mgf(out)) == 6
+        done = json.loads(ckpt.read_text())["done"]
+        assert len(done) == 6
+        # resume: nothing new is appended
+        assert cli_main([
+            "consensus", str(clustered), str(out),
+            "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+        ]) == 0
+        assert len(read_mgf(out)) == 6
+
+    def test_partial_checkpoint_resumes(self, tmp_path, rng):
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=20)
+            for i in range(4)
+        ]
+        spectra = [s for c in clusters for s in c.members]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf(spectra, clustered)
+        out = tmp_path / "out.mgf"
+        ckpt = tmp_path / "ckpt.json"
+        # simulate an interrupted run: two clusters already done
+        ckpt.write_text(json.dumps({"done": ["cluster-0", "cluster-1"]}))
+        from specpride_tpu.backends import numpy_backend as nb
+
+        write_mgf(nb.run_bin_mean(clusters[:2]), out)
+        assert cli_main([
+            "consensus", str(clustered), str(out),
+            "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+        ]) == 0
+        reps = read_mgf(out)
+        assert [s.title for s in reps] == [c.cluster_id for c in clusters]
