@@ -18,6 +18,7 @@ from specpride_tpu.config import (
     MedoidConfig,
 )
 from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.ops import quantize
 from specpride_tpu.ops.fragments import PROTON_MASS
 
 
@@ -125,22 +126,15 @@ def gap_average_consensus(
         new_mz = members[0].mz.copy()
         new_inten = members[0].intensity.copy()
     else:
-        mz_all = np.concatenate([s.mz for s in members])
-        inten_all = np.concatenate([s.intensity for s in members])
-        order = np.argsort(mz_all, kind="stable")
-        mz_all = mz_all[order]
-        inten_all = inten_all[order]
-
-        gaps = np.where(np.diff(mz_all) >= config.mz_accuracy)[0] + 1
-        if config.tail_mode == "reference" and gaps.size >= 2:
-            gaps = gaps[:-1]  # final gap ignored (ref :79 iterates [1:-1])
-
-        bounds = np.concatenate(([0], gaps, [mz_all.size]))
-        sizes = np.diff(bounds)
-        mz_csum = np.concatenate(([0.0], np.cumsum(mz_all)))
-        inten_csum = np.concatenate(([0.0], np.cumsum(inten_all)))
-        group_mz = (mz_csum[bounds[1:]] - mz_csum[bounds[:-1]]) / sizes
-        group_inten = (inten_csum[bounds[1:]] - inten_csum[bounds[:-1]]) / len(members)
+        # grouping (sort + f64 gap detection + tail-mode) lives in ONE place,
+        # shared with the device pack path — ops.quantize.gap_segments
+        mz_all, inten_all, seg = quantize.gap_segments(members, config)
+        n_groups = int(seg[-1]) + 1 if mz_all.size else 0
+        sizes = np.bincount(seg, minlength=n_groups)
+        group_mz = np.bincount(seg, weights=mz_all, minlength=n_groups) / sizes
+        group_inten = np.bincount(
+            seg, weights=inten_all, minlength=n_groups
+        ) / len(members)
 
         min_l = config.min_fraction * len(members)
         quorum_ok = sizes >= min_l

@@ -52,6 +52,25 @@ def _check_no_empty(clusters: list[Cluster]) -> None:
             raise ValueError(f"empty cluster {c.cluster_id!r}")
 
 
+def _iter_compacted(fused, cap: int, n_rows: int):
+    """Split a fused ``[flat_mz (cap) | flat_intensity (cap) | n_out (B)]``
+    device buffer (the globally-compacted layout of
+    ``ops.binning.bin_mean_deduped_compact`` /
+    ``ops.gap_average.gap_average_compact``) into per-row f64 (mz, intensity)
+    slices.  Rows are row-major in dispatch order; padded phantom rows emit
+    ``n_out == 0`` and sit past ``n_rows``, so they are never yielded."""
+    fused = np.asarray(fused)
+    flat_mz = fused[:cap]
+    flat_int = fused[cap : 2 * cap]
+    n_out = fused[2 * cap :].astype(np.int64)
+    offsets = np.concatenate([[0], np.cumsum(n_out)])
+    for ci in range(n_rows):
+        o0, o1 = int(offsets[ci]), int(offsets[ci + 1])
+        yield ci, flat_mz[o0:o1].astype(np.float64), flat_int[o0:o1].astype(
+            np.float64
+        )
+
+
 def _pad_axis0(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
     if arr.shape[0] == size:
         return arr
@@ -143,18 +162,12 @@ class TpuBackend:
                 pending.append((batch, lo, hi, cap, fused))
 
         for batch, lo, hi, cap, fused in pending:
-            fused = np.asarray(fused)
-            flat_mz = fused[:cap]
-            flat_int = fused[cap : 2 * cap]
-            n_out = fused[2 * cap :].astype(np.int64)
-            offsets = np.concatenate([[0], np.cumsum(n_out)])
-            for ci in range(hi - lo):
-                o0, o1 = int(offsets[ci]), int(offsets[ci + 1])
+            for ci, r_mz, r_int in _iter_compacted(fused, cap, hi - lo):
                 gi = batch.source_indices[lo + ci]
                 members = clusters[gi].members
                 out[gi] = Spectrum(
-                    mz=flat_mz[o0:o1].astype(np.float64),
-                    intensity=flat_int[o0:o1].astype(np.float64),
+                    mz=r_mz,
+                    intensity=r_int,
                     # exact f64 mean, as the oracle (ref src/binning.py:224)
                     precursor_mz=float(
                         np.mean([s.precursor_mz for s in members])
@@ -172,69 +185,51 @@ class TpuBackend:
         config: GapAverageConfig = GapAverageConfig(),
     ) -> list[Spectrum]:
         """Batched equivalent of ref src/average_spectrum_clustering.py:158-164
-        on the packed layout; precursor/RT estimators run host-side (tiny,
-        O(members)) while the device works."""
-        from specpride_tpu.data.packed import pack_bucketize
-        from specpride_tpu.ops.gap_average import gap_average_packed
+        on the packed layout.  Grouping (sort + f64 gap detection) happens at
+        pack time on the host (``data.packed.pack_bucketize_gap`` — the same
+        f64-parity split K1 uses, see ``ops.gap_average``); the device runs
+        segment reductions + global compaction sized by the host's exact
+        group-count bound, so there is no overflow/redispatch.  Precursor/RT
+        estimators run host-side (tiny, O(members)) while the device works."""
+        from specpride_tpu.data.packed import pack_bucketize_gap
+        from specpride_tpu.ops.gap_average import gap_average_compact
 
         _check_no_empty(clusters)
         get_pepmass, get_rt = numpy_backend.resolve_gap_estimators(config)
 
         out: list[Spectrum | None] = [None] * len(clusters)
         pending = []
-        for batch in pack_bucketize(clusters, self.batch_config):
+        for batch in pack_bucketize_gap(clusters, config, self.batch_config):
             b, k = batch.mz.shape
-            # peak-group count is data-dependent (can reach k); cap the
-            # output buffer optimistically and redispatch on overflow —
-            # D2H bytes dominate on tunneled hosts
-            out_size = min(k, max(512, k // 4))
             chunk = max(1, self.max_grid_elements // max(k * 4, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                fused = gap_average_packed(
+                # exact total group-count bound for this chunk -> the
+                # compacted D2H buffer carries only real output bytes
+                total = int(batch.n_groups[lo:hi].sum())
+                cap = max(1024, ((total + 1023) // 1024) * 1024)
+                fused = gap_average_compact(
                     *self._ship(
                         _pad_axis0(batch.mz[lo:hi], size),
                         _pad_axis0(batch.intensity[lo:hi], size),
-                        _pad_axis0(batch.n_peaks_total[lo:hi], size),
+                        _pad_axis0(batch.seg[lo:hi], size),
+                        _pad_axis0(batch.n_valid[lo:hi], size),
+                        _pad_axis0(batch.quorum[lo:hi], size),
                         _pad_axis0(batch.n_members[lo:hi], size),
                     ),
                     config=config,
-                    out_size=out_size,
+                    total_cap=cap,
                 )
-                pending.append((batch, lo, hi, out_size, fused))
+                pending.append((batch, lo, hi, cap, fused))
 
-        for batch, lo, hi, out_size, fused in pending:
-            fused = np.asarray(fused)
-            n_out = fused[:, 2 * out_size].astype(np.int64)
-            if n_out.max(initial=0) > out_size:
-                # overflow: rerun this slice with the full-size buffer,
-                # through the same pad/shard path as the primary dispatch
-                k = batch.mz.shape[1]
-                size = self._dispatch_size(hi - lo, hi - lo)
-                fused = np.asarray(
-                    gap_average_packed(
-                        *self._ship(
-                            _pad_axis0(batch.mz[lo:hi], size),
-                            _pad_axis0(batch.intensity[lo:hi], size),
-                            _pad_axis0(batch.n_peaks_total[lo:hi], size),
-                            _pad_axis0(batch.n_members[lo:hi], size),
-                        ),
-                        config=config,
-                        out_size=k,
-                    )
-                )
-                out_size = k
-                n_out = fused[: hi - lo, 2 * out_size].astype(np.int64)
-            mzs = fused[:, :out_size]
-            intens = fused[:, out_size : 2 * out_size]
-            for ci in range(hi - lo):
-                kk = int(n_out[ci])
+        for batch, lo, hi, cap, fused in pending:
+            for ci, r_mz, r_int in _iter_compacted(fused, cap, hi - lo):
                 gi = batch.source_indices[lo + ci]
                 members = clusters[gi].members
                 pep_mz, pep_z = get_pepmass(members)
                 out[gi] = Spectrum(
-                    mz=mzs[ci, :kk].astype(np.float64),
-                    intensity=intens[ci, :kk].astype(np.float64),
+                    mz=r_mz,
+                    intensity=r_int,
                     precursor_mz=pep_mz,
                     precursor_charge=pep_z,
                     rt=get_rt(members),

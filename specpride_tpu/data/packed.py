@@ -206,6 +206,91 @@ def pack_bin_mean(
     )
 
 
+@dataclasses.dataclass
+class GapPackedBatch:
+    """Packed batch specialised for gap-average consensus: member peaks are
+    concatenated, sorted, and split into gap segments in FLOAT64 on the host
+    at pack time (the f64-sensitive step — comparing m/z diffs against
+    ``mz_accuracy``, ref src/average_spectrum_clustering.py:62-67 — cannot
+    run in device f32 without silently regrouping peaks; see
+    ``ops.gap_average`` module docstring).  The device receives only sorted
+    f32 peaks + int32 segment ids and does the heavy segment reductions.
+
+    ``n_groups`` is the exact per-cluster group count (known host-side), so
+    device output buffers are sized exactly — no overflow/redispatch."""
+
+    mz: np.ndarray  # (B, K) f32, sorted ascending (singletons: input order)
+    intensity: np.ndarray  # (B, K) f32, in the same order
+    seg: np.ndarray  # (B, K) i32 segment ids, non-decreasing; padding = 0
+    n_valid: np.ndarray  # (B,) i32
+    quorum: np.ndarray  # (B,) i32 f64-exact ceil(min_fraction * n_members)
+    n_members: np.ndarray  # (B,) i32
+    n_groups: np.ndarray  # (B,) i64 exact group count (output bound)
+    cluster_ids: list[str]
+    source_indices: list[int]
+
+
+def pack_bucketize_gap(
+    clusters: Iterable[Cluster],
+    config,
+    batch_config: BatchConfig = BatchConfig(),
+) -> list[GapPackedBatch]:
+    """Sort + f64 gap-segment each cluster (``ops.quantize.gap_segments`` —
+    the same grouping code the numpy oracle runs), then bucket by total peak
+    count for the gap-average kernel
+    (``ops.gap_average.gap_average_compact``)."""
+    from specpride_tpu.ops.quantize import gap_segments
+
+    prepared = []  # (i, cluster, mz, inten, seg)
+    for i, c in enumerate(clusters):
+        if c.n_members == 0:
+            continue
+        prepared.append((i, c, *gap_segments(c.members, config)))
+
+    buckets: dict[int, list] = {}
+    for item in prepared:
+        kkey = _bucket_for(max(item[2].size, 1), batch_config.total_peak_buckets)
+        buckets.setdefault(kkey, []).append(item)
+
+    batches: list[GapPackedBatch] = []
+    for kkey, group in buckets.items():
+        for start in range(0, len(group), batch_config.clusters_per_batch):
+            chunk = group[start : start + batch_config.clusters_per_batch]
+            b = len(chunk)
+            mz = np.zeros((b, kkey), dtype=np.float32)
+            inten = np.zeros((b, kkey), dtype=np.float32)
+            seg = np.zeros((b, kkey), dtype=np.int32)
+            n_valid = np.zeros((b,), dtype=np.int32)
+            quorum = np.zeros((b,), dtype=np.int32)
+            n_members = np.zeros((b,), dtype=np.int32)
+            n_groups = np.zeros((b,), dtype=np.int64)
+            for ci, (_, c, cmz, cint, cseg) in enumerate(chunk):
+                n = cmz.size
+                mz[ci, :n] = cmz
+                inten[ci, :n] = cint
+                seg[ci, :n] = cseg
+                n_valid[ci] = n
+                # integer quorum, exact in f64: for integer group sizes s,
+                # s >= min_fraction*n  <=>  s >= ceil(min_fraction*n)
+                quorum[ci] = int(np.ceil(config.min_fraction * c.n_members))
+                n_members[ci] = c.n_members
+                n_groups[ci] = int(cseg[-1]) + 1 if n else 0
+            batches.append(
+                GapPackedBatch(
+                    mz=mz,
+                    intensity=inten,
+                    seg=seg,
+                    n_valid=n_valid,
+                    quorum=quorum,
+                    n_members=n_members,
+                    n_groups=n_groups,
+                    cluster_ids=[c.cluster_id for _, c, _, _, _ in chunk],
+                    source_indices=[i for i, _, _, _, _ in chunk],
+                )
+            )
+    return batches
+
+
 def pack_bucketize_bin_mean(
     clusters: Iterable[Cluster],
     min_mz: float,

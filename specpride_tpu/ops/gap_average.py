@@ -4,26 +4,40 @@ TPU-native replacement for ref src/average_spectrum_clustering.py:26-103
 (``average_spectrum``): the reference concatenates member peaks, sorts,
 splits at m/z gaps, then walks the gap list in a sequential Python loop with
 cumsum prefix sums.  Here the whole batch is one jitted program — the
-sequential group walk becomes ``segment_sum`` over segment ids derived from a
-cumulative gap count, which XLA executes as parallel segmented reductions.
+sequential group walk becomes ``segment_sum`` over pre-computed segment ids,
+which XLA executes as parallel segmented reductions.
+
+Float64 split of responsibilities (same pattern as K1, see
+``ops.quantize``): gap detection compares m/z differences against
+``mz_accuracy`` (0.01 Da) — at m/z ~1700 the float32 ulp (~1.2e-4) is an
+order of magnitude wider than realistic jitter around that threshold, so
+deciding gaps in f32 on device silently regroups peaks vs the reference's
+float64 ``np.diff`` (ref :62-67).  The host therefore sorts each cluster's
+concatenated peaks and derives gap/segment ids in float64 at pack time
+(``data.packed.pack_bucketize_gap``), including the reference's
+final-gap-merge (``tail_mode="reference"``, ref :79-87) and the integer
+quorum threshold; the device receives sorted peaks + int32 segment ids and
+does only the heavy parallel work.
 
 Semantics reproduced (see the numpy oracle
 ``backends.numpy_backend.gap_average_consensus`` for the cited mapping):
 
-* gap where ``diff(sorted mz) >= mz_accuracy`` (ref :62-67)
-* ``tail_mode="reference"``: with >= 2 gaps the final gap is ignored, merging
-  the last two groups (the ``ind_list[1:-1]`` loop, ref :79-87)
 * group mean m/z = group_sum / group_size; group intensity =
   group_sum / n_members (ref :76-77,81-82,86-87)
-* quorum: group_size >= min_fraction * n_members (ref :74,80,85)
+* quorum: group_size >= min_fraction * n_members (ref :74,80,85) — shipped
+  as a per-cluster integer threshold (exact for integer group sizes)
 * dynamic-range floor max/dyn_range applied after grouping (ref :95-98)
-* singleton clusters pass through ungrouped (ref :88-90) — realised by
-  forcing every inter-peak boundary to be a gap when n_members == 1, which
-  makes each peak its own group (quorum 1 >= 0.5 always passes)
+* singleton clusters pass through ungrouped in INPUT order (ref :88-90) —
+  the host assigns each peak its own segment without sorting
 
-Divergence (documented): device output is in ascending-m/z order; for
-singleton clusters with unsorted input peaks the reference preserves input
-order.  Both paths emit identical multisets.
+Remaining documented divergence: group sums/means run in float32 on device
+(vs float64 in the oracle).  The *segmentation* (which peaks group together)
+is exact — it is decided host-side in f64 — but downstream of it the
+dynamic-range keep decision (``group_int >= kept_max / dyn_range``) compares
+f32 intensities, so a group whose f64 intensity sits within one f32 ulp of
+the floor can be kept/dropped differently from the oracle.  Unlike the gap
+threshold (a fixed grid that real data clusters around), this boundary is
+data-dependent and measure-zero for measured intensities.
 """
 
 from __future__ import annotations
@@ -36,90 +50,77 @@ import jax.numpy as jnp
 from specpride_tpu.config import GapAverageConfig
 
 
-def _gap_average_packed_cluster(
-    mz: jax.Array,  # (K,) f32
+def _gap_average_segment_stats(
+    mz: jax.Array,  # (K,) f32, sorted ascending (singletons: input order)
     intensity: jax.Array,  # (K,) f32
+    seg: jax.Array,  # (K,) i32 host-computed segment ids, non-decreasing
     n_valid: jax.Array,  # () i32 — packed peaks are contiguous
+    quorum: jax.Array,  # () i32 — host-f64 ceil(min_fraction * n_members)
     n_members: jax.Array,  # () i32
     config: GapAverageConfig,
-    out_size: int,
 ):
-    """Packed-layout gap average: identical math to ``_gap_average_cluster``
-    but over K packed peaks (the reference concatenates members anyway, ref
-    src/average_spectrum_clustering.py:56-57 — the packed layout IS that
-    concatenation, so no flatten step, no (member, peak) padding, and no
-    member channel: validity is just position < n_valid)."""
+    """Per-cluster per-group stats (mz mean, intensity, keep mask) in
+    segment-id positions — the vmappable core of ``gap_average_compact``."""
     k = mz.shape[0]
     valid = jnp.arange(k) < n_valid
-    mz_flat = jnp.where(valid, mz, jnp.inf)
-    int_flat = jnp.where(valid, intensity, 0.0)
+    w = jnp.where(valid, 1.0, 0.0)
 
-    order = jnp.argsort(mz_flat, stable=True)
-    mz_s = mz_flat[order]
-    int_s = int_flat[order]
-
-    pos = jnp.arange(k - 1, dtype=jnp.int32)
-    in_valid = pos + 1 < n_valid
-    gap = (mz_s[1:] - mz_s[:-1] >= config.mz_accuracy) & in_valid
-    gap = jnp.where(n_members == 1, in_valid, gap)
-
-    if config.tail_mode == "reference":
-        n_gaps = jnp.sum(gap)
-        last_gap = jnp.max(jnp.where(gap, pos, -1))
-        drop_last = (n_gaps >= 2) & (n_members > 1)
-        gap = gap & ~(drop_last & (pos == last_gap))
-
-    seg = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(gap).astype(jnp.int32)]
-    )
-    in_range = jnp.arange(k) < n_valid
-    ones = jnp.where(in_range, 1.0, 0.0)
-    sizes = jax.ops.segment_sum(ones, seg, num_segments=k, indices_are_sorted=True)
+    sizes = jax.ops.segment_sum(w, seg, num_segments=k, indices_are_sorted=True)
     mz_sums = jax.ops.segment_sum(
-        jnp.where(in_range, mz_s, 0.0), seg, num_segments=k, indices_are_sorted=True
+        mz * w, seg, num_segments=k, indices_are_sorted=True
     )
     int_sums = jax.ops.segment_sum(
-        int_s, seg, num_segments=k, indices_are_sorted=True
+        intensity * w, seg, num_segments=k, indices_are_sorted=True
     )
 
     nm = n_members.astype(jnp.float32)
     group_mz = mz_sums / jnp.maximum(sizes, 1.0)
     group_int = int_sums / jnp.maximum(nm, 1.0)
 
-    keep = (sizes > 0) & (sizes >= config.min_fraction * nm)
+    keep = (sizes > 0) & (sizes >= quorum.astype(jnp.float32))
     kept_max = jnp.max(jnp.where(keep, group_int, -jnp.inf))
     floor = kept_max / config.dyn_range
     keep &= group_int >= floor
-
-    (idx,) = jnp.nonzero(keep, size=out_size, fill_value=k)
-    valid_out = idx < k
-    out_mz = jnp.where(valid_out, group_mz.at[idx].get(mode="fill", fill_value=0.0), 0.0)
-    out_int = jnp.where(
-        valid_out, group_int.at[idx].get(mode="fill", fill_value=0.0), 0.0
-    )
-    # n_out reports the TRUE group count; if it exceeds out_size the caller
-    # must redispatch with a bigger buffer (the first out_size groups are
-    # valid either way — nonzero fills in ascending index order)
-    n_out = jnp.sum(keep).astype(jnp.float32)
-    return jnp.concatenate([out_mz, out_int, n_out[None]])
+    return group_mz, group_int, keep
 
 
-@functools.partial(jax.jit, static_argnames=("config", "out_size"))
-def gap_average_packed(
+@functools.partial(jax.jit, static_argnames=("config", "total_cap"))
+def gap_average_compact(
     mz: jax.Array,  # (B, K) f32
     intensity: jax.Array,  # (B, K) f32
+    seg: jax.Array,  # (B, K) i32
     n_valid: jax.Array,  # (B,) i32
+    quorum: jax.Array,  # (B,) i32
     n_members: jax.Array,  # (B,) i32
     config: GapAverageConfig,
-    out_size: int | None = None,
+    total_cap: int,
 ):
-    """vmapped packed gap-average.  Returns (B, 2*out_size + 1) fused rows
-    [mz | intensity | n_out] — one device→host transfer per batch.  n_out
-    may exceed out_size (overflow): caller redispatches with out_size=K."""
-    if out_size is None:
-        out_size = mz.shape[1]
-    return jax.vmap(
-        lambda a, b, c, d: _gap_average_packed_cluster(
-            a, b, c, d, config, out_size
+    """Globally-compacted gap-average: one fused 1-D output
+    ``[flat_mz (total_cap) | flat_intensity (total_cap) | n_out (B)]``.
+
+    ``total_cap`` must be >= the batch's total group count — the host knows
+    each cluster's exact group count (``GapPackedBatch.n_groups``, a by-
+    product of the f64 gap precompute), so unlike the earlier f32 kernel
+    there is no data-dependent overflow and no redispatch path.  Outputs are
+    row-major: cluster order preserved, ascending m/z within a cluster
+    (input order for singletons, matching ref :88-90)."""
+    b, k = mz.shape
+    group_mz, group_int, keep = jax.vmap(
+        lambda a, c, d, e, f, g: _gap_average_segment_stats(
+            a, c, d, e, f, g, config
         )
-    )(mz, intensity, n_valid, n_members)
+    )(mz, intensity, seg, n_valid, quorum, n_members)
+
+    n_out = jnp.sum(keep, axis=1).astype(jnp.float32)
+    flat_keep = keep.reshape(b * k)
+    (idx,) = jnp.nonzero(flat_keep, size=total_cap, fill_value=b * k)
+    ok = idx < b * k
+    flat_mz = jnp.where(
+        ok, group_mz.reshape(b * k).at[idx].get(mode="fill", fill_value=0.0), 0.0
+    )
+    flat_int = jnp.where(
+        ok,
+        group_int.reshape(b * k).at[idx].get(mode="fill", fill_value=0.0),
+        0.0,
+    )
+    return jnp.concatenate([flat_mz, flat_int, n_out])

@@ -19,7 +19,53 @@ from __future__ import annotations
 
 import numpy as np
 
-from specpride_tpu.config import BinMeanConfig, CosineConfig, MedoidConfig
+from specpride_tpu.config import (
+    BinMeanConfig,
+    CosineConfig,
+    GapAverageConfig,
+    MedoidConfig,
+)
+
+
+def gap_segments(
+    members, config: GapAverageConfig
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sorted f64 (mz, intensity, segment-id) arrays for one cluster — the
+    SINGLE implementation of the reference's gap-grouping semantics, shared
+    by the numpy oracle (``backends.numpy_backend.gap_average_consensus``)
+    and the device pack path (``data.packed.pack_bucketize_gap``) so the two
+    cannot drift:
+
+    * multi-member: concat, stable argsort, gap where ``diff >= mz_accuracy``
+      — all float64 (ref src/average_spectrum_clustering.py:56-67);
+      ``tail_mode == "reference"`` drops the final gap when there are >= 2
+      gaps (ref :79-87, the ``ind_list[1:-1]`` loop)
+    * singleton: peaks pass through in INPUT order, each its own segment
+      (ref :88-90 — no sort, no grouping)
+    """
+    if len(members) == 1:
+        s = members[0]
+        mz = s.mz.astype(np.float64, copy=False)
+        inten = s.intensity.astype(np.float64, copy=False)
+        return mz, inten, np.arange(mz.size, dtype=np.int32)
+    mz = np.concatenate([s.mz for s in members]).astype(
+        np.float64, copy=False
+    )
+    inten = np.concatenate([s.intensity for s in members]).astype(
+        np.float64, copy=False
+    )
+    order = np.argsort(mz, kind="stable")
+    mz = mz[order]
+    inten = inten[order]
+    gap = np.diff(mz) >= config.mz_accuracy
+    if config.tail_mode == "reference":
+        idx = np.flatnonzero(gap)
+        if idx.size >= 2:
+            gap[idx[-1]] = False
+    seg = np.zeros(mz.size, dtype=np.int32)
+    if mz.size:
+        seg[1:] = np.cumsum(gap)
+    return mz, inten, seg
 
 
 def distinct_bins_per_row(bins: np.ndarray, sentinel: int) -> np.ndarray:
