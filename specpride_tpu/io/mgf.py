@@ -111,7 +111,10 @@ def read_mgf(path: str | os.PathLike, use_native: bool | None = None) -> list[Sp
         try:
             from specpride_tpu.io import native
 
-            if native.available():
+            # lazy in-tree build, attempted at most once per process — the
+            # cost lands exactly where the fast path pays off, not on CLI
+            # commands that never read an MGF
+            if native.ensure_built():
                 return native.read_mgf_native(os.fspath(path))
             if use_native:
                 raise RuntimeError("native MGF parser requested but not built")

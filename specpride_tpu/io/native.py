@@ -1,0 +1,199 @@
+"""ctypes bindings for the C++ MGF fast parser (native/mgf_parser.cpp).
+
+The C++ library parses an MGF file into flat column arrays in one pass —
+replacing the reference's CPU-bound float()-per-line Python loop (ref
+src/binning.py:122-167) on the hot ingest path (SURVEY.md §7 hard part d).
+This module loads it over a plain C ABI (ctypes; pybind11 is deliberately
+not a dependency), copies the columns into numpy arrays, and materialises
+the same ``Spectrum`` objects the pure-Python parser
+(``specpride_tpu.io.mgf.parse_mgf_stream``) produces — byte-for-byte
+identical semantics, validated by ``tests/test_native_mgf.py``.
+
+Loading is lazy and failure is soft: ``available()`` is False when the
+shared library has not been built (``make -C native``) and every caller
+falls back to the Python parser.  ``ensure_built()`` attempts the build
+in-tree when a toolchain is present (used by the CLI and bench harness).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from specpride_tpu.data.peaks import Spectrum
+
+_LIB_NAME = "libmgf_parser.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+_build_attempted = False
+
+
+def _candidate_paths() -> list[str]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(os.path.dirname(here))
+    paths = []
+    env = os.environ.get("SPECPRIDE_NATIVE_LIB")
+    if env:
+        paths.append(env)
+    paths.append(os.path.join(repo_root, "native", _LIB_NAME))
+    paths.append(os.path.join(here, _LIB_NAME))
+    return paths
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    p = ctypes.POINTER
+    lib.mgf_parse.restype = ctypes.c_void_p
+    lib.mgf_parse.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    for name, restype in [
+        ("mgf_n_spectra", ctypes.c_int64),
+        ("mgf_n_peaks", ctypes.c_int64),
+        ("mgf_mz", p(ctypes.c_double)),
+        ("mgf_intensity", p(ctypes.c_double)),
+        ("mgf_peak_offsets", p(ctypes.c_int64)),
+        ("mgf_precursor_mz", p(ctypes.c_double)),
+        ("mgf_charge", p(ctypes.c_int32)),
+        ("mgf_rt", p(ctypes.c_double)),
+        # titles/extras are length-delimited concatenated buffers (offsets
+        # give the slices) — c_void_p, NOT c_char_p, which would truncate
+        # at the first NUL byte
+        ("mgf_titles", ctypes.c_void_p),
+        ("mgf_title_offsets", p(ctypes.c_int64)),
+        ("mgf_extras", ctypes.c_void_p),
+        ("mgf_extra_offsets", p(ctypes.c_int64)),
+    ]:
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = [ctypes.c_void_p]
+    lib.mgf_free.restype = None
+    lib.mgf_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        for path in _candidate_paths():
+            if os.path.exists(path):
+                try:
+                    _lib = _bind(ctypes.CDLL(path))
+                    return _lib
+                except OSError:
+                    continue
+        _load_failed = True
+        return None
+
+
+def available() -> bool:
+    """True when the C++ parser library is built and loadable."""
+    return _load() is not None
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Build the native library in-tree if missing and a toolchain exists.
+
+    Returns ``available()`` afterwards; never raises on build failure (the
+    Python parser remains the fallback).  A failed build is attempted only
+    once per process — repeated calls return False immediately."""
+    global _load_failed, _build_attempted
+    if available():
+        return True
+    if _build_attempted:
+        return False
+    _build_attempted = True
+    here = os.path.dirname(os.path.abspath(__file__))
+    native_dir = os.path.join(os.path.dirname(os.path.dirname(here)), "native")
+    if not os.path.exists(os.path.join(native_dir, "Makefile")):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", native_dir],
+            check=True,
+            capture_output=quiet,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return False
+    with _lock:
+        _load_failed = False  # retry the load now that the build ran
+    return available()
+
+
+def _as_array(ptr, n: int, dtype) -> np.ndarray:
+    if n == 0:
+        return np.zeros((0,), dtype=dtype)
+    return np.array(np.ctypeslib.as_array(ptr, shape=(n,)), dtype=dtype)
+
+
+def _split_concat(buf: bytes, offsets: np.ndarray) -> list[str]:
+    return [
+        buf[offsets[i] : offsets[i + 1]].decode("utf-8")
+        for i in range(len(offsets) - 1)
+    ]
+
+
+def read_mgf_native(path: str) -> list[Spectrum]:
+    """Parse an MGF file with the C++ library into ``Spectrum`` objects.
+
+    Raises ``RuntimeError`` if the library is unavailable or the file fails
+    to parse (same error class of failures the Python parser raises as
+    ``ValueError``/``OSError`` — callers treat both as fatal input errors).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native MGF parser not built (make -C native)")
+    errbuf = ctypes.create_string_buffer(256)
+    handle = lib.mgf_parse(os.fspath(path).encode(), errbuf, len(errbuf))
+    if not handle:
+        raise RuntimeError(
+            f"native MGF parse failed: {errbuf.value.decode(errors='replace')}"
+        )
+    try:
+        n = int(lib.mgf_n_spectra(handle))
+        n_peaks = int(lib.mgf_n_peaks(handle))
+        mz = _as_array(lib.mgf_mz(handle), n_peaks, np.float64)
+        intensity = _as_array(lib.mgf_intensity(handle), n_peaks, np.float64)
+        peak_off = _as_array(lib.mgf_peak_offsets(handle), n + 1, np.int64)
+        prec_mz = _as_array(lib.mgf_precursor_mz(handle), n, np.float64)
+        charge = _as_array(lib.mgf_charge(handle), n, np.int32)
+        rt = _as_array(lib.mgf_rt(handle), n, np.float64)
+        title_off = _as_array(lib.mgf_title_offsets(handle), n + 1, np.int64)
+        extra_off = _as_array(lib.mgf_extra_offsets(handle), n + 1, np.int64)
+        titles_buf = ctypes.string_at(
+            lib.mgf_titles(handle), int(title_off[-1]) if n else 0
+        )
+        extras_buf = ctypes.string_at(
+            lib.mgf_extras(handle), int(extra_off[-1]) if n else 0
+        )
+    finally:
+        lib.mgf_free(handle)
+
+    titles = _split_concat(titles_buf, title_off)
+    extras_raw = _split_concat(extras_buf, extra_off)
+
+    spectra: list[Spectrum] = []
+    for i in range(n):
+        lo, hi = int(peak_off[i]), int(peak_off[i + 1])
+        extra: dict[str, str] = {}
+        if extras_raw[i]:
+            for line in extras_raw[i].splitlines():
+                key, _, value = line.partition("=")
+                extra[key] = value
+        spectra.append(
+            Spectrum(
+                mz=mz[lo:hi],
+                intensity=intensity[lo:hi],
+                precursor_mz=float(prec_mz[i]),
+                precursor_charge=int(charge[i]),
+                rt=float(rt[i]),
+                title=titles[i],
+                extra=extra,
+            )
+        )
+    return spectra

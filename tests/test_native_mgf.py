@@ -1,0 +1,166 @@
+"""C++ fast-parser parity tests: native/mgf_parser.cpp vs the pure-Python
+oracle parser (``io.mgf.parse_mgf_stream``).
+
+The native path must be BYTE-EXACT: identical titles, extras, and
+bit-identical float64 m/z / intensity / precursor values (both sides are
+correctly-rounded decimal→double conversions).  Skipped wholesale when no
+toolchain is available to build the library.
+"""
+
+import gzip
+import shutil
+
+import numpy as np
+import pytest
+
+from specpride_tpu.data.peaks import Spectrum
+from specpride_tpu.io import native
+from specpride_tpu.io.mgf import read_mgf, write_mgf
+
+pytestmark = pytest.mark.skipif(
+    not native.ensure_built(), reason="native parser not built (no toolchain?)"
+)
+
+
+def make_spectra(rng, n=40):
+    spectra = []
+    for i in range(n):
+        k = int(rng.integers(1, 300))
+        spectra.append(
+            Spectrum(
+                mz=np.sort(rng.uniform(100, 2000, k)),
+                intensity=rng.uniform(0, 1e6, k),
+                precursor_mz=float(rng.uniform(300, 900)),
+                precursor_charge=int(rng.integers(-3, 4)),
+                rt=float(rng.uniform(0, 3600)) if i % 3 else 0.0,
+                title=f"cluster-{i};mzspec:PXD004732:run a;b=c:scan:{i}",
+                extra={"SEQUENCE": "PEPTIDE", "SCANS": str(i)} if i % 2 else {},
+            )
+        )
+    return spectra
+
+
+def assert_identical(py, nat):
+    assert len(py) == len(nat)
+    for a, b in zip(py, nat):
+        assert a.title == b.title
+        assert a.precursor_mz == b.precursor_mz
+        assert a.precursor_charge == b.precursor_charge
+        assert a.rt == b.rt
+        assert a.extra == b.extra
+        np.testing.assert_array_equal(a.mz, b.mz)
+        np.testing.assert_array_equal(a.intensity, b.intensity)
+
+
+def test_exact_parity(tmp_path):
+    rng = np.random.default_rng(11)
+    path = tmp_path / "t.mgf"
+    write_mgf(make_spectra(rng), path)
+    assert_identical(
+        read_mgf(path, use_native=False), native.read_mgf_native(path)
+    )
+
+
+def test_gzip_parity(tmp_path):
+    rng = np.random.default_rng(12)
+    plain = tmp_path / "t.mgf"
+    gz = tmp_path / "t.mgf.gz"
+    write_mgf(make_spectra(rng, 10), plain)
+    with open(plain, "rb") as fi, gzip.open(gz, "wb") as fo:
+        shutil.copyfileobj(fi, fo)
+    assert_identical(
+        read_mgf(plain, use_native=False), native.read_mgf_native(gz)
+    )
+
+
+def test_dialect_oddities(tmp_path):
+    """Hand-written MGF exercising parser edge cases: junk outside records,
+    blank lines inside records, single-field peak lines, PEPMASS with
+    intensity, charge forms, lowercase keys, missing RT."""
+    text = """# a comment outside any record
+random garbage
+BEGIN IONS
+TITLE=c1;mzspec:PXD1:r:scan:1
+
+pepmass=445.12 1000.5
+CHARGE=2+
+rtinseconds=12.5
+SEQUENCE=PEPTIDE
+100.5 200.25
+101.5
+.5 7
++2.5 8
+
+END IONS
+stray line between records
+BEGIN IONS
+TITLE=c2;u2
+PEPMASS=
+CHARGE=3-
+300.1 1.0
+END IONS
+"""
+    path = tmp_path / "odd.mgf"
+    path.write_text(text)
+    py = read_mgf(path, use_native=False)
+    nat = native.read_mgf_native(path)
+    assert_identical(py, nat)
+    assert py[0].precursor_mz == 445.12
+    assert py[0].precursor_charge == 2
+    assert py[0].rt == 12.5
+    assert py[0].extra == {"SEQUENCE": "PEPTIDE"}
+    np.testing.assert_array_equal(py[0].mz, [100.5, 101.5, 0.5, 2.5])
+    np.testing.assert_array_equal(py[0].intensity, [200.25, 0.0, 7.0, 8.0])
+    assert py[1].precursor_mz == 0.0
+    assert py[1].precursor_charge == -3
+
+
+def test_unterminated_record_dropped(tmp_path):
+    """A record with no END IONS yields nothing — both parsers."""
+    path = tmp_path / "u.mgf"
+    path.write_text("BEGIN IONS\nTITLE=c1;u\n100.0 1.0\n")
+    assert read_mgf(path, use_native=False) == []
+    assert native.read_mgf_native(path) == []
+
+
+@pytest.mark.parametrize(
+    "bad_line",
+    [
+        "100.5 12,3",  # junk intensity field
+        "1.5.5 7",  # junk m/z field
+        "RTINSECONDS=12.5 min",  # trailing junk after RT
+        "CHARGE=abc",  # non-numeric charge
+        "PEPMASS=abc 100",  # non-numeric pepmass first field
+    ],
+)
+def test_malformed_rejected_by_both(tmp_path, bad_line):
+    """Malformed numeric fields raise in the Python parser (float()/int()
+    semantics) — the native parser must reject them too, not silently
+    coerce, or corrupt files would parse differently depending on whether
+    the .so is built."""
+    path = tmp_path / "bad.mgf"
+    path.write_text(
+        f"BEGIN IONS\nTITLE=c1;u\n{bad_line}\n100.0 1.0\nEND IONS\n"
+    )
+    with pytest.raises(ValueError):
+        read_mgf(path, use_native=False)
+    with pytest.raises(RuntimeError):
+        native.read_mgf_native(path)
+
+
+def test_charge_leading_plus(tmp_path):
+    """CHARGE=+2 parses to 2 in both parsers (Python int() accepts '+')."""
+    path = tmp_path / "p.mgf"
+    path.write_text(
+        "BEGIN IONS\nTITLE=c1;u\nCHARGE=+2\n100.0 1.0\nEND IONS\n"
+    )
+    py = read_mgf(path, use_native=False)
+    nat = native.read_mgf_native(path)
+    assert py[0].precursor_charge == nat[0].precursor_charge == 2
+
+
+def test_read_mgf_dispatches_to_native(tmp_path):
+    rng = np.random.default_rng(13)
+    path = tmp_path / "d.mgf"
+    write_mgf(make_spectra(rng, 5), path)
+    assert_identical(read_mgf(path, use_native=False), read_mgf(path))
