@@ -41,6 +41,15 @@ def _chunk_ranges(b: int, chunk: int):
         yield start, min(start + chunk, b)
 
 
+def _pow2(n: int, floor: int = 1) -> int:
+    """Round up to a power of two (>= floor).  Every value that feeds a
+    static jit argument or a padded array shape goes through this: distinct
+    shapes cost one XLA compile each, so bounding them to powers of two
+    keeps the compile count logarithmic instead of per-batch (the round-1
+    bench spent 47 s compiling one-off shapes)."""
+    return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
 def _check_no_empty(clusters: list[Cluster]) -> None:
     """Zero-member clusters are rejected up front on every device driver so
     bucket-skipping can never silently misalign outputs against inputs (the
@@ -95,9 +104,10 @@ class TpuBackend:
     mesh: object | None = None  # jax.sharding.Mesh
 
     def _dispatch_size(self, chunk: int, b: int) -> int:
-        """Dispatch (padded) cluster count: the chunk size, rounded up to a
-        multiple of the mesh size when sharding."""
-        size = min(chunk, b)
+        """Dispatch (padded) cluster count: the chunk size rounded up to a
+        power of two (so odd-sized tail batches reuse compiled shapes), then
+        to a multiple of the mesh size when sharding."""
+        size = _pow2(min(chunk, b), floor=64)
         if self.mesh is not None:
             n = self.mesh.size
             size = ((size + n - 1) // n) * n
@@ -145,8 +155,8 @@ class TpuBackend:
                 dist = quantize.distinct_bins_per_row(
                     batch.bins[lo:hi], config.n_bins
                 )
-                total = int(dist.sum())
-                cap = max(1024, ((total + 1023) // 1024) * 1024)
+                # pow2: cap is a static jit arg — see _pow2
+                cap = _pow2(int(dist.sum()), floor=1024)
                 fused = bin_mean_deduped_compact(
                     *self._ship(
                         _pad_axis0(batch.mz[lo:hi], size),
@@ -206,8 +216,8 @@ class TpuBackend:
             for lo, hi in _chunk_ranges(b, chunk):
                 # exact total group-count bound for this chunk -> the
                 # compacted D2H buffer carries only real output bytes
-                total = int(batch.n_groups[lo:hi].sum())
-                cap = max(1024, ((total + 1023) // 1024) * 1024)
+                # pow2: cap is a static jit arg — see _pow2
+                cap = _pow2(int(batch.n_groups[lo:hi].sum()), floor=1024)
                 fused = gap_average_compact(
                     *self._ship(
                         _pad_axis0(batch.mz[lo:hi], size),
@@ -255,24 +265,32 @@ class TpuBackend:
         for batch in pack_bucketize(
             clusters, self.batch_config, bucket_members=True
         ):
-            bins, grid = quantize.medoid_bins_packed(batch, config)
+            # shared-bin counts travel as uint16 (D2H is the bottleneck)
+            if int(batch.n_peaks.max(initial=0)) >= 1 << 16:
+                raise ValueError(
+                    "medoid kernel: a member has >= 2**16 peaks; uint16 "
+                    "shared-bin counts would overflow"
+                )
+            bins = quantize.medoid_bins_packed(batch, config)
             b, k = batch.mz.shape
             m = batch.m
-            chunk = max(1, self.max_grid_elements // max(m * grid, 1))
+            # largest device intermediate is the (K*M,) run×member occupancy
+            chunk = max(1, self.max_grid_elements // max(k * m, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
                 res = shared_bins_packed(
                     *self._ship(
-                        _pad_axis0(bins[lo:hi], size),
-                        _pad_axis0(batch.member_id[lo:hi], size),
+                        _pad_axis0(bins[lo:hi], size, fill=2**30),
+                        _pad_axis0(batch.member_id[lo:hi], size, fill=-1),
                     ),
-                    grid=grid,
                     m=m,
                 )
                 pending.append((batch, lo, hi, res))
 
         for batch, lo, hi, res in pending:
-            shared = np.asarray(res)[: hi - lo]
+            # slice on device first: D2H carries only real rows (12 MB/s on
+            # tunneled hosts), then widen uint16 counts for the f64 finalize
+            shared = np.asarray(res[: hi - lo]).astype(np.int64)
             idx = medoid_finalize(
                 shared,
                 batch.n_peaks[lo:hi],
@@ -329,7 +347,7 @@ class TpuBackend:
             pr_raw = max(
                 max((representatives[i].n_peaks for i in idxs), default=1), 1
             )
-            pr = ((pr_raw + 127) // 128) * 128
+            pr = _pow2(pr_raw, floor=256)  # shape-stable (one compile per value)
             rep_mz = np.zeros((b, pr), np.float64)
             rep_int = np.zeros((b, pr), np.float32)
             rep_valid = np.zeros((b, pr), bool)

@@ -127,7 +127,12 @@ class BatchConfig:
     padding waste (survey §7 hard part a).
     """
 
-    member_buckets: tuple[int, ...] = (2, 4, 8, 16, 32, 64, 128)
+    # coarse: each distinct (k, m) bucket pair is a separate XLA compile of
+    # the medoid occupancy/gram kernel; fine m granularity (the old
+    # 2,4,8,...) multiplied compile count for negligible padding savings —
+    # the M axis only scales scatter/matmul FLOPs, which are nowhere near
+    # the bottleneck
+    member_buckets: tuple[int, ...] = (8, 32, 128)
     # total peaks per cluster (packed layout, data.packed) — one axis of
     # bucket waste instead of two.  Few coarse buckets: on tunneled hosts
     # each extra batch shape costs a full dispatch round-trip, which beats

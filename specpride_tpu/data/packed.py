@@ -1,9 +1,9 @@
 """Packed ragged batches: peaks stored contiguously per cluster.
 
-The padded ``(cluster, member, peak)`` layout (``data.ragged``) wastes most
-of its bytes on mask padding — with realistic clusters (e.g. 5×250 peaks in
-a 32×512 bucket) >90% of host↔device traffic is padding.  The packed layout
-stores each cluster's peaks contiguously along one axis with a parallel
+The padded ``(cluster, member, peak)`` layout wastes most of its bytes on
+mask padding — with realistic clusters (e.g. 5×250 peaks in a 32×512
+bucket) >90% of host↔device traffic is padding.  The packed layout stores
+each cluster's peaks contiguously along one axis with a parallel
 ``member_id`` channel:
 
     mz, intensity : (B, K) float32   — all member peaks, concatenated
@@ -15,21 +15,67 @@ K is the bucketed *total* peak count per cluster, so padding waste is
 bounded by bucket granularity on one axis instead of two.  The consensus
 kernels never needed the (member, peak) rectangle — binning flattens it
 (ref src/binning.py:185-199), gap-averaging concatenates it (ref
-src/average_spectrum_clustering.py:56-57), and the medoid occupancy scatter
-indexes (member, bin) directly — so packing loses nothing and turns every
-kernel into dense sort/segment work on K elements.
+src/average_spectrum_clustering.py:56-57), and the medoid sort/segment
+kernel indexes (bin, member) runs directly — so packing loses nothing and
+turns every kernel into dense sort/segment work on K elements.
+
+All packers are VECTORIZED over a columnar ``SpectraTable``
+(``data.table``): bucketing, quantization, and the peak scatter into (B, K)
+device buffers are flat numpy passes with no per-cluster Python loop — at
+device throughputs the old per-cluster pack loop was the end-to-end
+bottleneck.  ``list[Cluster]`` inputs are accepted everywhere and converted
+at the boundary.
 """
 
 from __future__ import annotations
 
 import bisect
 import dataclasses
-from typing import Iterable, Sequence
+from typing import Sequence
 
 import numpy as np
 
 from specpride_tpu.config import BatchConfig
 from specpride_tpu.data.peaks import Cluster
+from specpride_tpu.data.table import ClusterIndex, SpectraTable
+
+
+def _as_table(clusters_or_table) -> SpectraTable:
+    if isinstance(clusters_or_table, SpectraTable):
+        return clusters_or_table
+    return SpectraTable.from_clusters(clusters_or_table)
+
+
+def _grouped_arange(counts: np.ndarray) -> np.ndarray:
+    """[0..c0), [0..c1), ... concatenated (vectorized ragged arange)."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    ends = np.cumsum(counts)
+    starts = ends - counts
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, counts)
+
+
+def _bucket_for(value: int, buckets: Sequence[int]) -> int:
+    i = bisect.bisect_left(buckets, value)
+    if i < len(buckets):
+        return buckets[i]
+    return 1 << (max(value, 1) - 1).bit_length()  # grow past the last bucket
+
+
+def _bucket_keys(values: np.ndarray, buckets: Sequence[int]) -> np.ndarray:
+    """Vectorized ``_bucket_for``: bucket size per value."""
+    values = np.maximum(values, 1)
+    b = np.asarray(buckets, dtype=np.int64)
+    idx = np.searchsorted(b, values, side="left")
+    inside = idx < len(b)
+    keys = np.where(inside, b[np.minimum(idx, len(b) - 1)], 0)
+    if not inside.all():
+        over = values[~inside]
+        keys[~inside] = 1 << (
+            np.ceil(np.log2(np.maximum(over, 2))).astype(np.int64)
+        )
+    return keys
 
 
 @dataclasses.dataclass
@@ -47,6 +93,7 @@ class PackedBatch:
     precursor_charge: np.ndarray  # (B, M) int32
     rt: np.ndarray  # (B, M) float32
     n_peaks: np.ndarray  # (B, M) int32 raw per-member peak counts
+    member_spec: np.ndarray  # (B, M) int64 table spectrum id, -1 = padding
     cluster_ids: list[str]
     source_indices: list[int]
 
@@ -61,72 +108,6 @@ class PackedBatch:
     @property
     def m(self) -> int:
         return self.member_mask.shape[1]
-
-
-def pack_clusters(
-    clusters: Sequence[Cluster],
-    k: int,
-    m: int,
-    source_indices: Sequence[int] | None = None,
-) -> PackedBatch:
-    """Pack a homogeneous group of clusters into one PackedBatch."""
-    b = len(clusters)
-    mz = np.zeros((b, k), dtype=np.float32)
-    mz64 = np.zeros((b, k), dtype=np.float64)
-    intensity = np.zeros((b, k), dtype=np.float32)
-    member_id = np.full((b, k), -1, dtype=np.int32)
-    n_peaks_total = np.zeros((b,), dtype=np.int32)
-    n_members = np.zeros((b,), dtype=np.int32)
-    member_mask = np.zeros((b, m), dtype=bool)
-    precursor_mz = np.zeros((b, m), dtype=np.float32)
-    precursor_charge = np.zeros((b, m), dtype=np.int32)
-    rt = np.zeros((b, m), dtype=np.float32)
-    n_peaks = np.zeros((b, m), dtype=np.int32)
-
-    for ci, cluster in enumerate(clusters):
-        if cluster.n_members > m:
-            raise ValueError(
-                f"cluster {cluster.cluster_id}: {cluster.n_members} members "
-                f"> member bucket {m}"
-            )
-        if cluster.total_peaks > k:
-            raise ValueError(
-                f"cluster {cluster.cluster_id}: {cluster.total_peaks} peaks "
-                f"> peak bucket {k}"
-            )
-        n_members[ci] = cluster.n_members
-        pos = 0
-        for mi, s in enumerate(cluster.members):
-            np_ = s.n_peaks
-            mz[ci, pos : pos + np_] = s.mz
-            mz64[ci, pos : pos + np_] = s.mz
-            intensity[ci, pos : pos + np_] = s.intensity
-            member_id[ci, pos : pos + np_] = mi
-            pos += np_
-            member_mask[ci, mi] = True
-            precursor_mz[ci, mi] = s.precursor_mz
-            precursor_charge[ci, mi] = s.precursor_charge
-            rt[ci, mi] = s.rt
-            n_peaks[ci, mi] = np_
-        n_peaks_total[ci] = pos
-
-    return PackedBatch(
-        mz=mz,
-        mz64=mz64,
-        intensity=intensity,
-        member_id=member_id,
-        n_peaks_total=n_peaks_total,
-        n_members=n_members,
-        member_mask=member_mask,
-        precursor_mz=precursor_mz,
-        precursor_charge=precursor_charge,
-        rt=rt,
-        n_peaks=n_peaks,
-        cluster_ids=[c.cluster_id for c in clusters],
-        source_indices=(
-            list(source_indices) if source_indices is not None else list(range(b))
-        ),
-    )
 
 
 @dataclasses.dataclass
@@ -150,60 +131,6 @@ class BinPackedBatch:
     n_members: np.ndarray  # (B,) int32
     cluster_ids: list[str]
     source_indices: list[int]
-
-
-def _dedup_last_per_bin(bins: np.ndarray) -> np.ndarray:
-    """Boolean keep-mask: last occurrence of each bin value within one
-    member's peak array (array order = reference scatter order)."""
-    if bins.size == 0:
-        return np.zeros((0,), dtype=bool)
-    if bins.size > 1 and np.all(np.diff(bins) >= 0):
-        # sorted-m/z fast path: runs are contiguous
-        return np.concatenate([bins[1:] != bins[:-1], [True]])
-    # general: np.unique on the reversed array marks last occurrences
-    _, first_of_reversed = np.unique(bins[::-1], return_index=True)
-    keep = np.zeros(bins.shape, dtype=bool)
-    keep[bins.size - 1 - first_of_reversed] = True
-    return keep
-
-
-def pack_bin_mean(
-    clusters: Sequence[Cluster],
-    bins_per_member: Sequence[Sequence[np.ndarray]],
-    keep_per_member: Sequence[Sequence[np.ndarray]],
-    k: int,
-    source_indices: Sequence[int],
-    sentinel: int,
-) -> BinPackedBatch:
-    """Assemble a BinPackedBatch from per-member quantized bins + keep masks
-    (see ``pack_bucketize_bin_mean``)."""
-    b = len(clusters)
-    mz = np.zeros((b, k), dtype=np.float32)
-    intensity = np.zeros((b, k), dtype=np.float32)
-    bins = np.full((b, k), sentinel, dtype=np.int32)
-    n_valid = np.zeros((b,), dtype=np.int32)
-    n_members = np.zeros((b,), dtype=np.int32)
-    for ci, cluster in enumerate(clusters):
-        pos = 0
-        for s, mb, kp in zip(
-            cluster.members, bins_per_member[ci], keep_per_member[ci]
-        ):
-            kept = int(kp.sum())
-            mz[ci, pos : pos + kept] = s.mz[kp]
-            intensity[ci, pos : pos + kept] = s.intensity[kp]
-            bins[ci, pos : pos + kept] = mb[kp]
-            pos += kept
-        n_valid[ci] = pos
-        n_members[ci] = cluster.n_members
-    return BinPackedBatch(
-        mz=mz,
-        intensity=intensity,
-        bins=bins,
-        n_valid=n_valid,
-        n_members=n_members,
-        cluster_ids=[c.cluster_id for c in clusters],
-        source_indices=list(source_indices),
-    )
 
 
 @dataclasses.dataclass
@@ -230,69 +157,197 @@ class GapPackedBatch:
     source_indices: list[int]
 
 
-def pack_bucketize_gap(
-    clusters: Iterable[Cluster],
-    config,
-    batch_config: BatchConfig = BatchConfig(),
-) -> list[GapPackedBatch]:
-    """Sort + f64 gap-segment each cluster (``ops.quantize.gap_segments`` —
-    the same grouping code the numpy oracle runs), then bucket by total peak
-    count for the gap-average kernel
-    (``ops.gap_average.gap_average_compact``)."""
-    from specpride_tpu.ops.quantize import gap_segments
+# ---------------------------------------------------------------------------
+# Shared vectorized grouping machinery
+# ---------------------------------------------------------------------------
 
-    prepared = []  # (i, cluster, mz, inten, seg)
-    for i, c in enumerate(clusters):
-        if c.n_members == 0:
-            continue
-        prepared.append((i, c, *gap_segments(c.members, config)))
 
-    buckets: dict[int, list] = {}
-    for item in prepared:
-        kkey = _bucket_for(max(item[2].size, 1), batch_config.total_peak_buckets)
-        buckets.setdefault(kkey, []).append(item)
+@dataclasses.dataclass
+class _BucketPlan:
+    """One (K[, M]) bucket group of clusters, chunked by clusters_per_batch."""
 
-    batches: list[GapPackedBatch] = []
-    for kkey, group in buckets.items():
-        for start in range(0, len(group), batch_config.clusters_per_batch):
-            chunk = group[start : start + batch_config.clusters_per_batch]
-            b = len(chunk)
-            mz = np.zeros((b, kkey), dtype=np.float32)
-            inten = np.zeros((b, kkey), dtype=np.float32)
-            seg = np.zeros((b, kkey), dtype=np.int32)
-            n_valid = np.zeros((b,), dtype=np.int32)
-            quorum = np.zeros((b,), dtype=np.int32)
-            n_members = np.zeros((b,), dtype=np.int32)
-            n_groups = np.zeros((b,), dtype=np.int64)
-            for ci, (_, c, cmz, cint, cseg) in enumerate(chunk):
-                n = cmz.size
-                mz[ci, :n] = cmz
-                inten[ci, :n] = cint
-                seg[ci, :n] = cseg
-                n_valid[ci] = n
-                # integer quorum, exact in f64: for integer group sizes s,
-                # s >= min_fraction*n  <=>  s >= ceil(min_fraction*n)
-                quorum[ci] = int(np.ceil(config.min_fraction * c.n_members))
-                n_members[ci] = c.n_members
-                n_groups[ci] = int(cseg[-1]) + 1 if n else 0
-            batches.append(
-                GapPackedBatch(
-                    mz=mz,
-                    intensity=inten,
-                    seg=seg,
-                    n_valid=n_valid,
-                    quorum=quorum,
-                    n_members=n_members,
-                    n_groups=n_groups,
-                    cluster_ids=[c.cluster_id for _, c, _, _, _ in chunk],
-                    source_indices=[i for i, _, _, _, _ in chunk],
-                )
+    codes: np.ndarray  # cluster codes in this chunk, appearance order
+    k: int
+    m: int  # 0 when the member axis is unbucketed
+
+
+def _plan_buckets(
+    idx: ClusterIndex,
+    eligible: np.ndarray,  # (C,) bool
+    totals: np.ndarray,  # (C,) value that picks the K bucket
+    config: BatchConfig,
+    bucket_members: bool,
+) -> list[_BucketPlan]:
+    codes = np.flatnonzero(eligible)
+    if codes.size == 0:
+        return []
+    kkeys = _bucket_keys(totals[codes], config.total_peak_buckets)
+    if bucket_members:
+        mkeys = _bucket_keys(idx.n_members[codes], config.member_buckets)
+    else:
+        mkeys = np.zeros(codes.size, dtype=np.int64)
+    plans: list[_BucketPlan] = []
+    for kkey in np.unique(kkeys):
+        for mkey in np.unique(mkeys[kkeys == kkey]):
+            sel = codes[(kkeys == kkey) & (mkeys == mkey)]
+            for start in range(0, sel.size, config.clusters_per_batch):
+                chunk = sel[start : start + config.clusters_per_batch]
+                plans.append(_BucketPlan(chunk, int(kkey), int(mkey)))
+    return plans
+
+
+def _peak_layout(table: SpectraTable, idx: ClusterIndex, plan: _BucketPlan):
+    """Flat source/destination indices for scattering a plan's peaks into a
+    (B, K) buffer in cluster-member-peak order.
+
+    Returns (spec_ids, row_of_spec, member_idx, counts, src, dest) — all
+    vectorized; ``src`` indexes ``table.mz``; ``dest`` indexes the flat
+    (B*K,) buffer."""
+    codes = plan.codes
+    nm = idx.n_members[codes]
+    # positions of each chosen cluster's spectra within idx.order
+    first = np.zeros(len(idx.n_members), dtype=np.int64)
+    np.cumsum(idx.n_members[:-1], out=first[1:])
+    starts = first[codes]
+    row_of_spec = np.repeat(np.arange(codes.size, dtype=np.int64), nm)
+    member_idx = _grouped_arange(nm)
+    spec_ids = idx.order[np.repeat(starts, nm) + member_idx]
+    counts = table.peak_counts[spec_ids]
+    # within-row start offset of each spectrum's peaks
+    cs = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    row_spec_start = np.concatenate([[0], np.cumsum(nm)])[:-1]
+    base = np.repeat(cs[row_spec_start], nm)
+    within = cs - base
+    src = np.repeat(table.peak_offsets[spec_ids], counts) + _grouped_arange(
+        counts
+    )
+    dest = (
+        np.repeat(row_of_spec, counts) * plan.k
+        + np.repeat(within, counts)
+        + _grouped_arange(counts)
+    )
+    return spec_ids, row_of_spec, member_idx, counts, src, dest
+
+
+# ---------------------------------------------------------------------------
+# Generic packed batches (medoid, cosine)
+# ---------------------------------------------------------------------------
+
+
+def pack_bucketize(
+    clusters_or_table,
+    config: BatchConfig = BatchConfig(),
+    bucket_members: bool = False,
+) -> list[PackedBatch]:
+    """Group clusters into PackedBatches of homogeneous K bucket shape,
+    recording cluster codes in ``source_indices``.
+
+    With ``bucket_members=False`` (default) the member axis M is sized to
+    the largest cluster in each batch, rounded to a power of two — right for
+    kernels where M shapes only small metadata.  ``bucket_members=True``
+    buckets M explicitly (the medoid kernel's run×member occupancy shape)."""
+    table = _as_table(clusters_or_table)
+    idx = table.cluster_order()
+    eligible = idx.n_members > 0
+    plans = _plan_buckets(idx, eligible, idx.total_peaks, config, bucket_members)
+
+    batches: list[PackedBatch] = []
+    for plan in plans:
+        codes = plan.codes
+        b, k = codes.size, plan.k
+        spec_ids, row_of_spec, member_idx, counts, src, dest = _peak_layout(
+            table, idx, plan
+        )
+        if plan.m:
+            m = plan.m
+        else:
+            mx = int(idx.n_members[codes].max(initial=1))
+            m = 1 << (max(mx, 1) - 1).bit_length()
+
+        mz64 = np.zeros(b * k, dtype=np.float64)
+        mz64[dest] = table.mz[src]
+        inten = np.zeros(b * k, dtype=np.float32)
+        inten[dest] = table.intensity[src]
+        member_id = np.full(b * k, -1, dtype=np.int32)
+        member_id[dest] = np.repeat(member_idx, counts)
+
+        member_mask = np.zeros((b, m), dtype=bool)
+        member_mask[row_of_spec, member_idx] = True
+        precursor_mz = np.zeros((b, m), dtype=np.float32)
+        precursor_mz[row_of_spec, member_idx] = table.precursor_mz[spec_ids]
+        precursor_charge = np.zeros((b, m), dtype=np.int32)
+        precursor_charge[row_of_spec, member_idx] = table.precursor_charge[
+            spec_ids
+        ]
+        rt = np.zeros((b, m), dtype=np.float32)
+        rt[row_of_spec, member_idx] = table.rt[spec_ids]
+        n_peaks = np.zeros((b, m), dtype=np.int32)
+        n_peaks[row_of_spec, member_idx] = counts
+        member_spec = np.full((b, m), -1, dtype=np.int64)
+        member_spec[row_of_spec, member_idx] = spec_ids
+
+        batches.append(
+            PackedBatch(
+                mz=mz64.astype(np.float32).reshape(b, k),
+                mz64=mz64.reshape(b, k),
+                intensity=inten.reshape(b, k),
+                member_id=member_id.reshape(b, k),
+                n_peaks_total=idx.total_peaks[codes].astype(np.int32),
+                n_members=idx.n_members[codes].astype(np.int32),
+                member_mask=member_mask,
+                precursor_mz=precursor_mz,
+                precursor_charge=precursor_charge,
+                rt=rt,
+                n_peaks=n_peaks,
+                member_spec=member_spec,
+                cluster_ids=[table.cluster_names[c] for c in codes],
+                source_indices=[int(c) for c in codes],
             )
+        )
     return batches
 
 
+# ---------------------------------------------------------------------------
+# Binned-mean packing (K1): f64 quantize + dedup, all vectorized
+# ---------------------------------------------------------------------------
+
+
+def _dedup_keep_mask(
+    spec_of_peak: np.ndarray,  # (P,) i64 spectrum id per peak
+    bins: np.ndarray,  # (P,) i64, -1 = out of range
+    mz: np.ndarray,  # (P,) f64 — sortedness probe for the fast path
+) -> np.ndarray:
+    """Keep-mask: last occurrence of each (spectrum, bin) pair in array
+    order, matching numpy's buffered fancy-index ``+=`` semantics (ref
+    src/binning.py:197-199).
+
+    Fast path: when every spectrum's m/z is non-decreasing (the MGF norm),
+    duplicate bins are consecutive and out-of-range peaks sit only at the
+    ends, so one vector compare suffices.  Fallback: a global
+    (spectrum, bin, position) lexsort marks last occurrences for arbitrary
+    orderings."""
+    p = bins.size
+    if p == 0:
+        return np.zeros(0, dtype=bool)
+    same_spec = spec_of_peak[1:] == spec_of_peak[:-1]
+    if not (same_spec & (mz[1:] < mz[:-1])).any():
+        consecutive_dup = same_spec & (bins[1:] == bins[:-1]) & (bins[1:] >= 0)
+        keep = np.ones(p, dtype=bool)
+        keep[:-1] &= ~consecutive_dup
+        return keep
+    # general: last occurrence per (spectrum, bin) via lexsort
+    order = np.lexsort((np.arange(p), bins, spec_of_peak))
+    sb = bins[order]
+    ss = spec_of_peak[order]
+    last = np.ones(p, dtype=bool)
+    last[:-1] = (sb[1:] != sb[:-1]) | (ss[1:] != ss[:-1])
+    keep = np.zeros(p, dtype=bool)
+    keep[order] = last
+    return keep
+
+
 def pack_bucketize_bin_mean(
-    clusters: Iterable[Cluster],
+    clusters_or_table,
     min_mz: float,
     max_mz: float,
     bin_size: float,
@@ -301,88 +356,193 @@ def pack_bucketize_bin_mean(
 ) -> list[BinPackedBatch]:
     """Quantize (float64), dedup, and bucket clusters for the binned-mean
     kernel.  K buckets are chosen on the DEDUPED, range-filtered peak
-    counts."""
-    prepared = []  # (i, cluster, bins_per_member, keep_per_member, total)
-    for i, c in enumerate(clusters):
-        if c.n_members == 0:
-            continue
-        mbs, kps, total = [], [], 0
-        for s in c.members:
-            mz64 = s.mz.astype(np.float64, copy=False)
-            in_range = (mz64 >= min_mz) & (mz64 < max_mz)
-            b = ((mz64 - min_mz) / bin_size).astype(np.int64)
-            b = np.where(in_range, np.clip(b, 0, n_bins - 1), -1)
-            keep = _dedup_last_per_bin(b) & in_range
-            mbs.append(b.astype(np.int32))
-            kps.append(keep)
-            total += int(keep.sum())
-        prepared.append((i, c, mbs, kps, total))
+    counts.  One vectorized pass over the whole table."""
+    table = _as_table(clusters_or_table)
+    idx = table.cluster_order()
 
-    buckets: dict[int, list] = {}
-    for item in prepared:
-        kkey = _bucket_for(max(item[4], 1), config.total_peak_buckets)
-        buckets.setdefault(kkey, []).append(item)
+    mz = table.mz
+    in_range = (mz >= min_mz) & (mz < max_mz)
+    bins64 = ((mz - min_mz) / bin_size).astype(np.int64)
+    bins64 = np.where(in_range, np.clip(bins64, 0, n_bins - 1), -1)
+    spec_of_peak = np.repeat(
+        np.arange(table.n_spectra, dtype=np.int64), table.peak_counts
+    )
+    keep = _dedup_keep_mask(spec_of_peak, bins64, mz) & in_range
+
+    # kept-peak table view: rebuild per-spectrum offsets over kept peaks
+    kept_counts = np.bincount(
+        spec_of_peak[keep], minlength=table.n_spectra
+    ).astype(np.int64)
+    kept_offsets = np.zeros(table.n_spectra + 1, dtype=np.int64)
+    np.cumsum(kept_counts, out=kept_offsets[1:])
+    kept_src = np.flatnonzero(keep)  # kept-peak -> original peak
+
+    kept_totals = np.bincount(
+        table.cluster_code, weights=kept_counts, minlength=table.n_clusters
+    ).astype(np.int64)
+
+    eligible = idx.n_members > 0
+    plans = _plan_buckets(idx, eligible, kept_totals, config, False)
+
+    # a lightweight "table" over kept peaks drives the same layout helper
+    kept_table = dataclasses.replace(
+        table,
+        mz=table.mz,  # unused by _peak_layout beyond indexing via offsets
+        peak_offsets=kept_offsets,
+    )
+    kept_idx = ClusterIndex(
+        order=idx.order,
+        spec_first=idx.spec_first,
+        member_index=idx.member_index,
+        n_members=idx.n_members,
+        total_peaks=kept_totals,
+        max_members=idx.max_members,
+    )
 
     batches: list[BinPackedBatch] = []
-    for kkey, group in buckets.items():
-        for start in range(0, len(group), config.clusters_per_batch):
-            chunk = group[start : start + config.clusters_per_batch]
-            batches.append(
-                pack_bin_mean(
-                    [c for _, c, _, _, _ in chunk],
-                    [m for _, _, m, _, _ in chunk],
-                    [k2 for _, _, _, k2, _ in chunk],
-                    kkey,
-                    [i for i, _, _, _, _ in chunk],
-                    n_bins,
-                )
+    for plan in plans:
+        codes = plan.codes
+        b, k = codes.size, plan.k
+        _, _, _, _, src_kept, dest = _peak_layout(kept_table, kept_idx, plan)
+        src = kept_src[src_kept]
+        mzf = np.zeros(b * k, dtype=np.float32)
+        mzf[dest] = mz[src]
+        inten = np.zeros(b * k, dtype=np.float32)
+        inten[dest] = table.intensity[src]
+        pbins = np.full(b * k, n_bins, dtype=np.int32)
+        pbins[dest] = bins64[src]
+        batches.append(
+            BinPackedBatch(
+                mz=mzf.reshape(b, k),
+                intensity=inten.reshape(b, k),
+                bins=pbins.reshape(b, k),
+                n_valid=kept_totals[codes].astype(np.int32),
+                n_members=idx.n_members[codes].astype(np.int32),
+                cluster_ids=[table.cluster_names[c] for c in codes],
+                source_indices=[int(c) for c in codes],
             )
+        )
     return batches
 
 
-def _bucket_for(value: int, buckets: Sequence[int]) -> int:
-    i = bisect.bisect_left(buckets, value)
-    if i < len(buckets):
-        return buckets[i]
-    return 1 << (max(value, 1) - 1).bit_length()  # grow past the last bucket
+# ---------------------------------------------------------------------------
+# Gap-average packing (K3): f64 sort + gap segments, all vectorized
+# ---------------------------------------------------------------------------
 
 
-def pack_bucketize(
-    clusters: Iterable[Cluster],
-    config: BatchConfig = BatchConfig(),
-    bucket_members: bool = False,
-) -> list[PackedBatch]:
-    """Group clusters into PackedBatches of homogeneous K bucket shape,
-    recording original positions in ``source_indices``.
+def pack_bucketize_gap(
+    clusters_or_table,
+    config,
+    batch_config: BatchConfig = BatchConfig(),
+) -> list[GapPackedBatch]:
+    """Sort + f64 gap-segment every cluster in one vectorized pass (same
+    grouping semantics as ``ops.quantize.gap_segments`` — the numpy oracle's
+    code path — validated against it by the parity suite), then bucket by
+    total peak count for ``ops.gap_average.gap_average_compact``.
 
-    With ``bucket_members=False`` (default) the member axis M is sized to
-    the largest cluster in each batch — right for kernels that never ship
-    the (B, M) metadata to the device (bin-mean, gap-average), since every
-    distinct batch shape is one XLA compile and one set of transfers.
-    ``bucket_members=True`` additionally buckets M (medoid occupancy needs
-    a device (B, M, grid) tensor)."""
-    buckets: dict[tuple[int, int], list[tuple[int, Cluster]]] = {}
-    for i, c in enumerate(clusters):
-        if c.n_members == 0:
-            continue
-        kkey = _bucket_for(max(c.total_peaks, 1), config.total_peak_buckets)
-        mkey = _bucket_for(c.n_members, config.member_buckets) if bucket_members else 0
-        buckets.setdefault((kkey, mkey), []).append((i, c))
+    Vectorized formulation: one global lexsort groups peaks by cluster and
+    orders them by m/z (singleton clusters order by input position instead,
+    ref :88-90 passthrough); gap booleans, the reference's final-gap merge
+    (``tail_mode="reference"``), and segment ids all come from flat
+    cumsum/bincount passes."""
+    table = _as_table(clusters_or_table)
+    idx = table.cluster_order()
 
-    batches: list[PackedBatch] = []
-    for (kkey, mkey), group in buckets.items():
-        for start in range(0, len(group), config.clusters_per_batch):
-            chunk = group[start : start + config.clusters_per_batch]
-            if bucket_members:
-                m = mkey
-            else:
-                # round to a power of two so the (B, M) metadata shape — and
-                # the kernels' static m — stay stable across similar runs
-                mx = max(c.n_members for _, c in chunk)
-                m = 1 << (max(mx, 1) - 1).bit_length()
-            batches.append(
-                pack_clusters(
-                    [c for _, c in chunk], kkey, m, [i for i, _ in chunk]
-                )
+    p_total = table.n_peaks
+    spec_of_peak = np.repeat(
+        np.arange(table.n_spectra, dtype=np.int64), table.peak_counts
+    )
+    cluster_of_peak = table.cluster_code[spec_of_peak]
+    nm_of_peak = idx.n_members[cluster_of_peak]
+
+    # sort key: m/z for multi-member clusters, input position for singletons
+    # (positions are small integers — exact in f64)
+    key = np.where(
+        nm_of_peak == 1, np.arange(p_total, dtype=np.float64), table.mz
+    )
+    order = np.lexsort((key, cluster_of_peak))
+    s_cluster = cluster_of_peak[order]
+    s_mz = table.mz[order]
+
+    same_cluster = np.zeros(p_total, dtype=bool)
+    if p_total > 1:
+        same_cluster[1:] = s_cluster[1:] == s_cluster[:-1]
+    gap = np.zeros(p_total, dtype=bool)  # gap[i]: boundary BEFORE peak i
+    if p_total > 1:
+        diff_ok = (s_mz[1:] - s_mz[:-1]) >= config.mz_accuracy
+        gap[1:] = same_cluster[1:] & diff_ok
+        # singletons: every peak its own group regardless of spacing
+        gap[1:] |= same_cluster[1:] & (idx.n_members[s_cluster[1:]] == 1)
+
+    if config.tail_mode == "reference":
+        # drop each multi-member cluster's final gap when it has >= 2 gaps
+        # (ref :79-87 iterates ind_list[1:-1])
+        gpos = np.flatnonzero(gap)
+        if gpos.size:
+            gcluster = s_cluster[gpos]
+            counts = np.bincount(gcluster, minlength=table.n_clusters)
+            is_last = np.ones(gpos.size, dtype=bool)
+            is_last[:-1] = gcluster[1:] != gcluster[:-1]
+            drop = (
+                is_last
+                & (counts[gcluster] >= 2)
+                & (idx.n_members[gcluster] > 1)
             )
+            gap[gpos[drop]] = False
+
+    # segment ids, reset at cluster starts
+    gseg = np.cumsum(gap)
+    cluster_first_peak = np.zeros(p_total, dtype=bool)
+    if p_total:
+        cluster_first_peak[0] = True
+        cluster_first_peak[1:] = ~same_cluster[1:]
+    first_pos = np.zeros(table.n_clusters, dtype=np.int64)
+    fidx = np.flatnonzero(cluster_first_peak)
+    first_pos[s_cluster[fidx]] = fidx
+    seg = (gseg - gseg[first_pos[s_cluster]]).astype(np.int32)
+
+    n_groups = np.zeros(table.n_clusters, dtype=np.int64)
+    if p_total:
+        last_peak = np.ones(p_total, dtype=bool)
+        last_peak[:-1] = ~same_cluster[1:]
+        lidx = np.flatnonzero(last_peak)
+        n_groups[s_cluster[lidx]] = seg[lidx] + 1
+
+    quorum_all = np.ceil(
+        config.min_fraction * idx.n_members.astype(np.float64)
+    ).astype(np.int32)
+
+    eligible = idx.n_members > 0
+    plans = _plan_buckets(idx, eligible, idx.total_peaks, batch_config, False)
+
+    # per-cluster start of its sorted-peak block, for the (B, K) scatter
+    batches: list[GapPackedBatch] = []
+    s_intensity = table.intensity[order]
+    for plan in plans:
+        codes = plan.codes
+        b, k = codes.size, plan.k
+        totals = idx.total_peaks[codes]
+        src = np.repeat(first_pos[codes], totals) + _grouped_arange(totals)
+        dest = np.repeat(
+            np.arange(b, dtype=np.int64) * k, totals
+        ) + _grouped_arange(totals)
+        mzf = np.zeros(b * k, dtype=np.float32)
+        mzf[dest] = s_mz[src]
+        inten = np.zeros(b * k, dtype=np.float32)
+        inten[dest] = s_intensity[src]
+        pseg = np.zeros(b * k, dtype=np.int32)
+        pseg[dest] = seg[src]
+        batches.append(
+            GapPackedBatch(
+                mz=mzf.reshape(b, k),
+                intensity=inten.reshape(b, k),
+                seg=pseg.reshape(b, k),
+                n_valid=totals.astype(np.int32),
+                quorum=quorum_all[codes],
+                n_members=idx.n_members[codes].astype(np.int32),
+                n_groups=n_groups[codes],
+                cluster_ids=[table.cluster_names[c] for c in codes],
+                source_indices=[int(c) for c in codes],
+            )
+        )
     return batches

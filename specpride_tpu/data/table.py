@@ -1,0 +1,258 @@
+"""Columnar spectra dataset: the framework's hot-path data layout.
+
+The reference moves data as Python objects (pyteomics dicts / lists of
+spectra), which caps every pipeline stage at Python-loop speed.  Here the
+canonical in-memory form is ONE flat columnar table — all peaks of all
+spectra concatenated, with offset arrays — so that every host-side stage
+(cluster assembly, bucketing, quantization, packing into device batches) is
+a vectorized numpy pass over flat arrays, and the C++ MGF parser
+(``io.native``) can materialise it directly from its column output without
+ever constructing per-spectrum Python objects.
+
+``Spectrum``/``Cluster`` (``data.peaks``) remain the user-facing staging
+types; ``SpectraTable.from_clusters`` / ``to_clusters`` convert at the
+boundary.  Device batches are built from tables by the vectorized packers in
+``data.packed``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from specpride_tpu.data.peaks import Cluster, Spectrum, parse_title
+
+
+@dataclasses.dataclass
+class SpectraTable:
+    """S spectra / P peaks in flat columns, with per-spectrum cluster codes.
+
+    Spectra keep file order.  ``cluster_code[s]`` indexes
+    ``cluster_names``; codes are assigned in first-seen order (the
+    reference's cluster iteration order, ref src/binning.py:159-165)."""
+
+    mz: np.ndarray  # (P,) f64 — all peaks, spectrum-major
+    intensity: np.ndarray  # (P,) f64
+    peak_offsets: np.ndarray  # (S+1,) i64
+    precursor_mz: np.ndarray  # (S,) f64
+    precursor_charge: np.ndarray  # (S,) i32
+    rt: np.ndarray  # (S,) f64
+    titles: list[str]  # (S,)
+    cluster_code: np.ndarray  # (S,) i64 — index into cluster_names
+    cluster_names: list[str]
+
+    @property
+    def n_spectra(self) -> int:
+        return len(self.titles)
+
+    @property
+    def n_peaks(self) -> int:
+        return int(self.mz.size)
+
+    @property
+    def n_clusters(self) -> int:
+        return len(self.cluster_names)
+
+    @property
+    def peak_counts(self) -> np.ndarray:
+        """(S,) peaks per spectrum."""
+        return np.diff(self.peak_offsets)
+
+    def spectrum(self, s: int) -> Spectrum:
+        lo, hi = int(self.peak_offsets[s]), int(self.peak_offsets[s + 1])
+        return Spectrum(
+            mz=self.mz[lo:hi],
+            intensity=self.intensity[lo:hi],
+            precursor_mz=float(self.precursor_mz[s]),
+            precursor_charge=int(self.precursor_charge[s]),
+            rt=float(self.rt[s]),
+            title=self.titles[s],
+        )
+
+    def to_clusters(self) -> list[Cluster]:
+        """Materialise Cluster objects (first-seen cluster order, in-file
+        member order) — the object-API boundary, not a hot path."""
+        members: list[list[Spectrum]] = [[] for _ in self.cluster_names]
+        for s in range(self.n_spectra):
+            members[int(self.cluster_code[s])].append(self.spectrum(s))
+        return [
+            Cluster(name, mem) for name, mem in zip(self.cluster_names, members)
+        ]
+
+    @classmethod
+    def from_spectra(cls, spectra: Sequence[Spectrum]) -> "SpectraTable":
+        """Build from Spectrum objects, parsing cluster ids from titles."""
+        s_count = len(spectra)
+        counts = np.fromiter(
+            (s.n_peaks for s in spectra), dtype=np.int64, count=s_count
+        )
+        offsets = np.zeros(s_count + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        mz = (
+            np.concatenate([s.mz for s in spectra])
+            if s_count
+            else np.zeros(0, np.float64)
+        )
+        inten = (
+            np.concatenate([s.intensity for s in spectra])
+            if s_count
+            else np.zeros(0, np.float64)
+        )
+        titles = [s.title for s in spectra]
+        codes = np.zeros(s_count, dtype=np.int64)
+        names: list[str] = []
+        index: dict[str, int] = {}
+        for i, t in enumerate(titles):
+            cid = parse_title(t)[0]
+            code = index.get(cid)
+            if code is None:
+                code = index[cid] = len(names)
+                names.append(cid)
+            codes[i] = code
+        return cls(
+            mz=np.ascontiguousarray(mz, dtype=np.float64),
+            intensity=np.ascontiguousarray(inten, dtype=np.float64),
+            peak_offsets=offsets,
+            precursor_mz=np.array(
+                [s.precursor_mz for s in spectra], dtype=np.float64
+            ),
+            precursor_charge=np.array(
+                [s.precursor_charge for s in spectra], dtype=np.int32
+            ),
+            rt=np.array([s.rt for s in spectra], dtype=np.float64),
+            titles=titles,
+            cluster_code=codes,
+            cluster_names=names,
+        )
+
+    @classmethod
+    def from_clusters(cls, clusters: Sequence[Cluster]) -> "SpectraTable":
+        """Build from Cluster objects.  Cluster codes follow the given list
+        order; members stay contiguous."""
+        spectra: list[Spectrum] = []
+        codes: list[int] = []
+        names: list[str] = []
+        for ci, c in enumerate(clusters):
+            names.append(c.cluster_id)
+            for s in c.members:
+                spectra.append(s)
+                codes.append(ci)
+        table = cls.from_spectra(spectra)
+        # override title-derived grouping with the explicit cluster structure
+        # (titles may be absent or disagree when callers build clusters
+        # programmatically)
+        table.cluster_code = np.asarray(codes, dtype=np.int64)
+        table.cluster_names = names
+        return table
+
+    @classmethod
+    def from_columns(
+        cls,
+        mz: np.ndarray,
+        intensity: np.ndarray,
+        peak_offsets: np.ndarray,
+        precursor_mz: np.ndarray,
+        precursor_charge: np.ndarray,
+        rt: np.ndarray,
+        titles: list[str],
+    ) -> "SpectraTable":
+        """Build from raw parser columns (the ``io.native`` fast path),
+        deriving cluster codes from titles in first-seen order."""
+        codes = np.zeros(len(titles), dtype=np.int64)
+        names: list[str] = []
+        index: dict[str, int] = {}
+        for i, t in enumerate(titles):
+            cid = parse_title(t)[0]
+            code = index.get(cid)
+            if code is None:
+                code = index[cid] = len(names)
+                names.append(cid)
+            codes[i] = code
+        return cls(
+            mz=np.ascontiguousarray(mz, dtype=np.float64),
+            intensity=np.ascontiguousarray(intensity, dtype=np.float64),
+            peak_offsets=np.ascontiguousarray(peak_offsets, dtype=np.int64),
+            precursor_mz=np.ascontiguousarray(precursor_mz, dtype=np.float64),
+            precursor_charge=np.ascontiguousarray(
+                precursor_charge, dtype=np.int32
+            ),
+            rt=np.ascontiguousarray(rt, dtype=np.float64),
+            titles=titles,
+            cluster_code=codes,
+            cluster_names=names,
+        )
+
+    # -- derived, cached cluster-level structure -------------------------
+
+    def cluster_order(self) -> "ClusterIndex":
+        """Spectrum ordering grouped by cluster + per-cluster extents (one
+        stable argsort; cached)."""
+        cached = getattr(self, "_cluster_index", None)
+        if cached is not None:
+            return cached
+        idx = ClusterIndex.build(self)
+        object.__setattr__(self, "_cluster_index", idx)
+        return idx
+
+
+@dataclasses.dataclass
+class ClusterIndex:
+    """Vectorized cluster structure over a SpectraTable.
+
+    ``order`` lists spectrum indices grouped by cluster code (stable — file
+    order within a cluster, matching the reference's member order);
+    derived arrays give each spectrum's member index and each cluster's
+    member/peak extent without any per-cluster Python."""
+
+    order: np.ndarray  # (S,) spectrum indices, cluster-grouped
+    spec_first: np.ndarray  # (S,) position-in-order of own cluster's first
+    member_index: np.ndarray  # (S,) member position within cluster, in order
+    n_members: np.ndarray  # (C,) members per cluster
+    total_peaks: np.ndarray  # (C,) peaks per cluster
+    cluster_start: np.ndarray  # (C,) position-in-order of first member
+    max_members: int
+
+    def first_spectrum(self) -> np.ndarray:
+        """(C,) spectrum id of each cluster's first (file-order) member;
+        0 for empty clusters."""
+        safe = np.minimum(self.cluster_start, max(len(self.order) - 1, 0))
+        return self.order[safe] if len(self.order) else safe
+
+    def member_spectrum(self, codes: np.ndarray, member: np.ndarray) -> np.ndarray:
+        """(len(codes),) spectrum id of member ``member[i]`` of cluster
+        ``codes[i]``."""
+        return self.order[self.cluster_start[codes] + member]
+
+    @classmethod
+    def build(cls, table: SpectraTable) -> "ClusterIndex":
+        s_count = table.n_spectra
+        c_count = table.n_clusters
+        order = np.argsort(table.cluster_code, kind="stable")
+        sorted_code = table.cluster_code[order]
+        n_members = np.bincount(
+            table.cluster_code, minlength=c_count
+        ).astype(np.int64)
+        counts = table.peak_counts
+        total_peaks = np.bincount(
+            table.cluster_code, weights=counts, minlength=c_count
+        ).astype(np.int64)
+        # position-in-order of each cluster's first spectrum
+        cluster_start = np.zeros(c_count, dtype=np.int64)
+        if s_count:
+            first_mask = np.concatenate(
+                [[True], sorted_code[1:] != sorted_code[:-1]]
+            )
+            cluster_start[sorted_code[first_mask]] = np.flatnonzero(first_mask)
+        spec_first = cluster_start[sorted_code]
+        member_index = np.arange(s_count, dtype=np.int64) - spec_first
+        return cls(
+            order=order,
+            spec_first=spec_first,
+            member_index=member_index,
+            n_members=n_members,
+            total_peaks=total_peaks,
+            cluster_start=cluster_start,
+            max_members=int(n_members.max(initial=0)),
+        )

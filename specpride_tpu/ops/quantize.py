@@ -80,19 +80,16 @@ def distinct_bins_per_row(bins: np.ndarray, sentinel: int) -> np.ndarray:
     return first + changes
 
 
-def medoid_bins_packed(batch, config: MedoidConfig) -> tuple[np.ndarray, int]:
-    """Packed-layout variant of ``medoid_bins``: (B, K) cluster-relative
-    occupancy bins, sentinel = grid for padding slots."""
-    mz = batch.mz64
+def medoid_bins_packed(batch, config: MedoidConfig) -> np.ndarray:
+    """(B, K) GLOBAL occupancy-grid bin indices (``floor(mz / bin_size)``,
+    float64), sentinel 2**30 for padding slots.  Pairwise shared-bin counts
+    are origin-independent, so no per-cluster rel-bin/span pass exists (the
+    old span-derived ``grid`` was a data-dependent static jit arg — one XLA
+    recompile per batch)."""
     valid = batch.member_id >= 0
-    bins = (mz / config.bin_size).astype(np.int64)
-    big = np.iinfo(np.int64).max
-    per_cluster_min = np.where(valid, bins, big).min(axis=1)
-    per_cluster_min = np.where(per_cluster_min == big, 0, per_cluster_min)
-    rel = bins - per_cluster_min[:, None]
-    span = int(np.where(valid, rel, -1).max(initial=0)) + 1
-    grid = max(128, ((span + 127) // 128) * 128)
-    return np.where(valid, rel, grid).astype(np.int32), grid
+    bins = (batch.mz64 / config.bin_size).astype(np.int64)
+    sent = np.int64(2**30)
+    return np.where(valid, np.clip(bins, 0, sent - 1), sent).astype(np.int32)
 
 
 def cosine_edge_count(last_mz, space):
