@@ -390,14 +390,7 @@ def pack_bucketize_bin_mean(
         mz=table.mz,  # unused by _peak_layout beyond indexing via offsets
         peak_offsets=kept_offsets,
     )
-    kept_idx = ClusterIndex(
-        order=idx.order,
-        spec_first=idx.spec_first,
-        member_index=idx.member_index,
-        n_members=idx.n_members,
-        total_peaks=kept_totals,
-        max_members=idx.max_members,
-    )
+    kept_idx = dataclasses.replace(idx, total_peaks=kept_totals)
 
     batches: list[BinPackedBatch] = []
     for plan in plans:
