@@ -26,6 +26,7 @@
 #include <charconv>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
@@ -325,6 +326,12 @@ bool parse_buffer(const std::string& text, Columns& c, std::string& err) {
 
   unsigned hw = std::thread::hardware_concurrency();
   size_t want = hw ? hw : 1;
+  // SPECPRIDE_MGF_THREADS overrides autodetection (containers often
+  // report 1 core; tests use it to force the parallel split path)
+  if (const char* env = std::getenv("SPECPRIDE_MGF_THREADS")) {
+    long v = std::atol(env);
+    if (v > 0) want = static_cast<size_t>(v);
+  }
   if (want > 16) want = 16;
   const size_t min_chunk = 4 << 20;  // below ~4 MB threads don't pay
   if (text.size() / min_chunk < want) want = text.size() / min_chunk;
@@ -343,8 +350,18 @@ bool parse_buffer(const std::string& text, Columns& c, std::string& err) {
       q = nl + 1;
       if (static_cast<size_t>(end - q) >= 10 &&
           std::memcmp(q, "BEGIN IONS", 10) == 0) {
-        found = q;
-        break;
+        // the serial parser only treats a line *trimming to exactly*
+        // "BEGIN IONS" as a record start; accepting e.g. "BEGIN IONSX"
+        // or "BEGIN IONS extra" as a split point would silently drop the
+        // enclosing record on multithreaded parses.  A missed split point
+        // is harmless (the previous chunk parses through it), so be
+        // strict: rest of the line must be whitespace only.
+        const char* r = q + 10;
+        while (r < end && (*r == ' ' || *r == '\t' || *r == '\r')) ++r;
+        if (r == end || *r == '\n') {
+          found = q;
+          break;
+        }
       }
     }
     if (found && found > starts.back()) starts.push_back(found);

@@ -106,8 +106,15 @@ class TpuBackend:
     def _dispatch_size(self, chunk: int, b: int) -> int:
         """Dispatch (padded) cluster count: the chunk size rounded up to a
         power of two (so odd-sized tail batches reuse compiled shapes), then
-        to a multiple of the mesh size when sharding."""
-        size = _pow2(min(chunk, b), floor=64)
+        to a multiple of the mesh size when sharding.
+
+        The 64-row floor amortizes compile shapes, but it must never
+        overshoot the memory-derived ``chunk``: with very wide rows (e.g.
+        medoid k*m ~ 2^24) chunk can be 1-4, and a hard floor of 64 would
+        exceed the ``max_grid_elements`` budget up to 64x (device OOM
+        risk).  Clamping the floor to pow2(chunk) bounds padding at 2x the
+        budget."""
+        size = _pow2(min(chunk, b), floor=min(64, _pow2(chunk)))
         if self.mesh is not None:
             n = self.mesh.size
             size = ((size + n - 1) // n) * n

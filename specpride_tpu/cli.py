@@ -81,16 +81,57 @@ def _run_method(backend, method: str, clusters, args):
 
 
 def _checkpointed_run(backend, method, clusters, args, stats: RunStats):
-    """Chunked execution with a resume manifest (survey §5)."""
+    """Chunked execution with a resume manifest (survey §5).
+
+    Crash-safety contract: each chunk appends to the output FIRST, then the
+    manifest records {done ids, output byte size} atomically.  A crash in
+    between leaves output past the manifest's recorded size; resume
+    truncates back to that offset before appending, so the re-run chunk is
+    never duplicated (the advisor's r1 duplicate-append window)."""
     done: set[str] = set()
+    output_bytes: int | None = None  # None: manifest predates offset tracking
     if args.checkpoint and os.path.exists(args.checkpoint):
         with open(args.checkpoint) as fh:
-            done = set(json.load(fh).get("done", []))
+            manifest = json.load(fh)
+        done = set(manifest.get("done", []))
+        raw = manifest.get("output_bytes")
+        output_bytes = None if raw is None else int(raw)
+        out_size = (
+            os.path.getsize(args.output)
+            if os.path.exists(args.output)
+            else None
+        )
+        if done and out_size is None:
+            logger.warning(
+                "checkpoint lists %d done clusters but output %s is gone; "
+                "restarting from scratch", len(done), args.output,
+            )
+            done, output_bytes = set(), 0
+        elif output_bytes is not None and out_size is not None and (
+            out_size < output_bytes
+        ):
+            # un-fsynced append lost in a power cut after the manifest
+            # landed: done-listed clusters are missing from the output, so
+            # trusting the manifest would silently drop them
+            logger.warning(
+                "output %s is %d bytes but the manifest recorded %d; "
+                "restarting from scratch", args.output, out_size, output_bytes,
+            )
+            done, output_bytes = set(), 0
+        elif output_bytes is not None and out_size is not None and (
+            out_size > output_bytes
+        ):
+            logger.info(
+                "dropping %d output bytes past the manifest (interrupted "
+                "chunk)", out_size - output_bytes,
+            )
+            with open(args.output, "r+b") as fh:
+                fh.truncate(output_bytes)
         logger.info("resuming: %d clusters already done", len(done))
 
     todo = [c for c in clusters if c.cluster_id not in done]
     stats.count("clusters_skipped_done", len(clusters) - len(todo))
-    first_write = not (args.checkpoint and done)
+    first_write = not done if output_bytes is None else output_bytes == 0
     chunk = args.checkpoint_every if args.checkpoint else len(todo) or 1
 
     for start in range(0, len(todo), chunk):
@@ -104,13 +145,21 @@ def _checkpointed_run(backend, method, clusters, args, stats: RunStats):
         stats.count("representatives", len(reps))
         done.update(c.cluster_id for c in part)
         if args.checkpoint:
+            output_bytes = os.path.getsize(args.output)
             tmp = args.checkpoint + ".tmp"
             with open(tmp, "w") as fh:
-                json.dump({"done": sorted(done)}, fh)
+                json.dump(
+                    {"done": sorted(done), "output_bytes": output_bytes}, fh
+                )
             os.replace(tmp, args.checkpoint)
 
 
 def _load_clusters(path: str, stats: RunStats) -> list[Cluster]:
+    # explicit opt-in site for the C++ fast parser: the CLI (unlike
+    # library reads) may spawn the one-shot in-tree build
+    from specpride_tpu.io import native
+
+    native.ensure_built()
     with stats.phase("parse"):
         spectra = read_mgf(path)
         clusters = group_into_clusters(spectra)

@@ -68,7 +68,8 @@ class MedoidConfig:
     smaller raw peak count — the capability pyOpenMS
     ``XQuestScores::xCorrelationPrescore(spec1, spec2, 0.1)`` supplies at
     ref src/most_similar_representative.py:15.  ``bin_size`` is that 0.1 Da
-    literal.  Bin index is ``round(mz / bin_size)``.
+    literal.  Bin index is ``floor(mz / bin_size)`` (truncation — what both
+    the oracle and the device kernel implement).
     """
 
     bin_size: float = 0.1

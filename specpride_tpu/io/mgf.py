@@ -104,17 +104,24 @@ def parse_mgf_stream(stream: IO[str]) -> Iterator[Spectrum]:
 def read_mgf(path: str | os.PathLike, use_native: bool | None = None) -> list[Spectrum]:
     """Read all spectra from an MGF file.
 
-    ``use_native`` selects the C++ parser: True forces it, False forbids it,
-    None (default) uses it when the shared library is available.
+    ``use_native`` selects the C++ parser: True forces it (building it
+    in-tree if needed), False forbids it, None (default) uses it only when
+    the shared library is already built and loadable — library code must
+    not spawn a compiler as a side effect of reading a file.  Opt in to
+    auto-build on the default path with ``SPECPRIDE_NATIVE_BUILD=1`` (the
+    CLI and bench harness call ``native.ensure_built()`` explicitly).
     """
     if use_native is not False:
         try:
             from specpride_tpu.io import native
 
-            # lazy in-tree build, attempted at most once per process — the
-            # cost lands exactly where the fast path pays off, not on CLI
-            # commands that never read an MGF
-            if native.ensure_built():
+            auto_build = os.environ.get("SPECPRIDE_NATIVE_BUILD", "") == "1"
+            ok = (
+                native.ensure_built()
+                if (use_native or auto_build)
+                else native.available()
+            )
+            if ok:
                 return native.read_mgf_native(os.fspath(path))
             if use_native:
                 raise RuntimeError("native MGF parser requested but not built")

@@ -23,6 +23,7 @@ import re
 import struct
 import zlib
 import xml.etree.ElementTree as ET
+from xml.sax.saxutils import quoteattr
 from typing import IO, Iterator
 
 import numpy as np
@@ -184,14 +185,19 @@ def write_mzml(
         fh.write(f'  <run id="run"><spectrumList count="{len(spectra)}">\n')
         for index, (scan, s, params) in enumerate(spectra):
             fh.write(
-                f'    <spectrum index="{index}" id="scan={scan}" '
+                f'    <spectrum index="{index}" id={quoteattr(f"scan={scan}")} '
                 f'defaultArrayLength="{s.n_peaks}">\n'
             )
             fh.write(
                 '      <cvParam accession="MS:1000511" name="ms level" value="2"/>\n'
             )
+            # userParams carry free text (cluster ids, peptide sequences) —
+            # quoteattr so &/</quotes survive a round-trip as valid XML
             for key, value in params.items():
-                fh.write(f'      <userParam name="{key}" value="{value}"/>\n')
+                fh.write(
+                    f"      <userParam name={quoteattr(str(key))} "
+                    f"value={quoteattr(str(value))}/>\n"
+                )
             fh.write(
                 '      <precursorList count="1"><precursor><selectedIonList '
                 'count="1"><selectedIon>\n'

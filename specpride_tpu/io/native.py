@@ -27,7 +27,8 @@ import numpy as np
 from specpride_tpu.data.peaks import Spectrum
 
 _LIB_NAME = "libmgf_parser.so"
-_lock = threading.Lock()
+_lock = threading.Lock()  # guards the dlopen state (_lib/_load_failed)
+_build_lock = threading.Lock()  # guards the one-shot `make` build
 _lib: ctypes.CDLL | None = None
 _load_failed = False
 _build_attempted = False
@@ -100,28 +101,37 @@ def ensure_built(quiet: bool = True) -> bool:
 
     Returns ``available()`` afterwards; never raises on build failure (the
     Python parser remains the fallback).  A failed build is attempted only
-    once per process — repeated calls return False immediately."""
+    once per process — repeated calls return False immediately.  The whole
+    check-and-build is serialized under ``_build_lock`` so two threads
+    reading MGFs concurrently cannot both spawn ``make`` writing the same
+    ``.so`` (advisor r2); the build subprocess deliberately runs under its
+    own lock, not ``_lock``, so loads already in flight aren't blocked."""
     global _load_failed, _build_attempted
     if available():
         return True
-    if _build_attempted:
-        return False
-    _build_attempted = True
-    here = os.path.dirname(os.path.abspath(__file__))
-    native_dir = os.path.join(os.path.dirname(os.path.dirname(here)), "native")
-    if not os.path.exists(os.path.join(native_dir, "Makefile")):
-        return False
-    try:
-        subprocess.run(
-            ["make", "-C", native_dir],
-            check=True,
-            capture_output=quiet,
-            timeout=120,
+    with _build_lock:
+        if available():
+            return True
+        if _build_attempted:
+            return False
+        _build_attempted = True
+        here = os.path.dirname(os.path.abspath(__file__))
+        native_dir = os.path.join(
+            os.path.dirname(os.path.dirname(here)), "native"
         )
-    except (OSError, subprocess.SubprocessError):
-        return False
-    with _lock:
-        _load_failed = False  # retry the load now that the build ran
+        if not os.path.exists(os.path.join(native_dir, "Makefile")):
+            return False
+        try:
+            subprocess.run(
+                ["make", "-C", native_dir],
+                check=True,
+                capture_output=quiet,
+                timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return False
+        with _lock:
+            _load_failed = False  # retry the load now that the build ran
     return available()
 
 

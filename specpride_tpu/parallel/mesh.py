@@ -31,8 +31,14 @@ def initialize_distributed(
     """Bring up the multi-host runtime (JAX's coordination service over
     ICI/DCN — the capability slot NCCL/MPI fills in torch frameworks; the
     reference has no equivalent).  No-op if already initialized or
-    single-process with no coordinator configured."""
-    if jax.process_count() > 1:
+    single-process with no coordinator configured.
+
+    The guard must NOT touch ``jax.process_count()``/``jax.devices()``:
+    those initialize the local backend, and ``jax.distributed.initialize``
+    is only legal *before* backend init — probing through them would make
+    multi-host bring-up self-defeating.  ``jax.distributed.is_initialized``
+    reads coordination-service state without spinning up a backend."""
+    if jax.distributed.is_initialized():
         return
     if coordinator_address is None:
         return  # single-process

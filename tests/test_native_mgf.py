@@ -164,3 +164,41 @@ def test_read_mgf_dispatches_to_native(tmp_path):
     path = tmp_path / "d.mgf"
     write_mgf(make_spectra(rng, 5), path)
     assert_identical(read_mgf(path, use_native=False), read_mgf(path))
+
+
+def test_parallel_chunk_split_ignores_begin_ions_prefix(tmp_path, monkeypatch):
+    """Multithreaded parses (files >= 8 MB) split the buffer at lines that
+    trim to exactly "BEGIN IONS".  A record-internal header line merely
+    *starting* with those 10 bytes (e.g. "BEGIN IONSFAKE=1" — a legal
+    KEY=VALUE extra for both parsers) must NOT be a split point: the old
+    prefix-only memcmp silently dropped the enclosing record (advisor r2).
+    Every record carries such headers, so any false boundary would show as
+    a parity break against the serial Python result.
+
+    Construction: one giant record spans the file midpoint (where the
+    2-thread splitter places its guess) and carries the fake header just
+    PAST the midpoint, so the old forward scan found the fake line before
+    the next real record boundary and dropped the giant record."""
+    monkeypatch.setenv("SPECPRIDE_MGF_THREADS", "2")  # containers report 1 core
+    parts = ["BEGIN IONS\nTITLE=cluster-0;u0\nPEPMASS=500.25\nCHARGE=2+\n"]
+    # ~5.5 MB of peaks, fake header, a few more peaks
+    parts.append(
+        "\n".join(f"{100.0 + i * 0.001:.3f} {i % 997}.5" for i in range(450000))
+    )
+    parts.append("\nBEGIN IONSFAKE=1\nBEGIN IONS EXTRA=x\n")
+    parts.append("".join(f"{600.0 + i:.1f} 1.0\n" for i in range(5)))
+    parts.append("END IONS\n")
+    small = (
+        "BEGIN IONS\nTITLE=cluster-{i};u{i}\nPEPMASS=400.5\nCHARGE=2+\n"
+        + "".join(f"{200.0 + j * 0.5:.1f} {j + 1}.0\n" for j in range(400))
+        + "END IONS\n"
+    )
+    for i in range(1, 600):
+        parts.append(small.replace("{i}", str(i)))
+    path = tmp_path / "big.mgf"
+    path.write_text("".join(parts))
+    assert path.stat().st_size >= 8 << 20, "fixture must trigger threading"
+    py = read_mgf(path, use_native=False)
+    assert len(py) == 600
+    assert py[0].extra["BEGIN IONSFAKE"] == "1"
+    assert_identical(py, native.read_mgf_native(path))

@@ -70,3 +70,43 @@ def test_input_sharding_is_applied(rng):
     # each device holds 16/8 = 2 clusters
     shard_shapes = {s.data.shape for s in sx.addressable_shards}
     assert shard_shapes == {(2, 4, 8)}
+
+
+def test_initialize_distributed_guard(monkeypatch):
+    """The already-initialized probe must go through
+    jax.distributed.is_initialized — NOT jax.process_count(), which spins
+    up the local backend and makes a subsequent real
+    jax.distributed.initialize illegal (advisor r1)."""
+    from specpride_tpu.parallel import mesh as pm
+
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: False
+    )
+    monkeypatch.setattr(
+        jax.distributed,
+        "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    monkeypatch.setattr(
+        jax, "process_count",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("guard must not initialize the backend")
+        ),
+    )
+    # no coordinator: stays a no-op
+    pm.initialize_distributed()
+    assert calls == []
+    # coordinator given: forwarded to jax.distributed.initialize
+    pm.initialize_distributed("host0:1234", 4, 1)
+    assert calls == [
+        {
+            "coordinator_address": "host0:1234",
+            "num_processes": 4,
+            "process_id": 1,
+        }
+    ]
+    # already initialized: no second init
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    pm.initialize_distributed("host0:1234", 4, 1)
+    assert len(calls) == 1

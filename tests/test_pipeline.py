@@ -92,6 +92,29 @@ class TestMzml:
         write_mzml(specs, path)
         assert len(list(iter_mzml(path))) == 2
 
+    def test_hostile_userparams_stay_valid_xml(self, tmp_path, rng):
+        """Free text in userParams (peptide/cluster ids with &, <, quotes)
+        must be escaped — the file stays well-formed and values round-trip
+        exactly (advisor r1: unescaped interpolation)."""
+        import xml.etree.ElementTree as ET
+
+        hostile = {
+            "Peptide sequence": 'PEP<T&IDE">K',
+            'Cluster "accession"': "cluster-1;a&b<c>'d",
+        }
+        specs = [(7, make_spectrum(rng, n_peaks=5, scan=7), hostile)]
+        path = tmp_path / "hostile.mzML"
+        write_mzml(specs, path)
+        tree = ET.parse(path)  # raises ParseError if escaping is broken
+        ns = "{http://psi.hupo.org/ms/mzml}"
+        got = {
+            p.get("name"): p.get("value")
+            for p in tree.iter(f"{ns}userParam")
+        }
+        assert got == hostile
+        # the spectrum itself still reads back
+        assert set(read_mzml_scans(path)) == {7}
+
 
 class TestConvert:
     def test_convert_mgf(self, tmp_path, rng, raw_spectra):
@@ -231,6 +254,92 @@ class TestCli:
             "--checkpoint", str(ckpt), "--checkpoint-every", "2",
         ]) == 0
         assert len(read_mgf(out)) == 6
+
+    def test_crash_between_write_and_manifest_no_duplicates(
+        self, tmp_path, rng
+    ):
+        """A crash after a chunk's output append but before its manifest
+        update must not duplicate the chunk on resume: the manifest's
+        recorded output_bytes truncates the orphaned tail (advisor r1)."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=20)
+            for i in range(4)
+        ]
+        spectra = [s for c in clusters for s in c.members]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf(spectra, clustered)
+
+        # clean full run = the expected final output
+        golden = tmp_path / "golden.mgf"
+        assert cli_main([
+            "consensus", str(clustered), str(golden),
+            "--checkpoint", str(tmp_path / "g.json"), "--checkpoint-every", "2",
+        ]) == 0
+        golden_bytes = golden.read_bytes()
+
+        # crashed state: chunk 1 (clusters 0-1) committed in the manifest,
+        # chunk 2's bytes already appended to the output but NOT recorded
+        from specpride_tpu.backends import numpy_backend as nb
+
+        out = tmp_path / "out.mgf"
+        write_mgf(nb.run_bin_mean(clusters[:2]), out)
+        committed = out.stat().st_size
+        write_mgf(nb.run_bin_mean(clusters[2:]), out, append=True)
+        ckpt = tmp_path / "ckpt.json"
+        ckpt.write_text(json.dumps(
+            {"done": ["cluster-0", "cluster-1"], "output_bytes": committed}
+        ))
+
+        assert cli_main([
+            "consensus", str(clustered), str(out),
+            "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+        ]) == 0
+        reps = read_mgf(out)
+        assert [s.title for s in reps] == [c.cluster_id for c in clusters]
+        assert out.read_bytes() == golden_bytes
+
+    def test_checkpoint_output_shorter_than_manifest_restarts(
+        self, tmp_path, rng
+    ):
+        """Power-cut ordering can persist the manifest but lose the
+        un-fsynced output append; trusting the manifest would silently
+        drop the done-listed clusters, so the run restarts."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=20)
+            for i in range(2)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out = tmp_path / "out.mgf"
+        out.write_text("BEGIN IONS\n")  # truncated remnant
+        ckpt = tmp_path / "ckpt.json"
+        ckpt.write_text(json.dumps(
+            {"done": ["cluster-0"], "output_bytes": 10_000}
+        ))
+        assert cli_main([
+            "consensus", str(clustered), str(out),
+            "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+        ]) == 0
+        assert [s.title for s in read_mgf(out)] == ["cluster-0", "cluster-1"]
+
+    def test_checkpoint_output_deleted_restarts(self, tmp_path, rng):
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=20)
+            for i in range(2)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out = tmp_path / "out.mgf"
+        ckpt = tmp_path / "ckpt.json"
+        ckpt.write_text(json.dumps(
+            {"done": ["cluster-0", "cluster-1"], "output_bytes": 123}
+        ))
+        # output is gone: the stale manifest must not mask the loss
+        assert cli_main([
+            "consensus", str(clustered), str(out),
+            "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+        ]) == 0
+        assert len(read_mgf(out)) == 2
 
     def test_partial_checkpoint_resumes(self, tmp_path, rng):
         clusters = [
