@@ -53,7 +53,28 @@ def _get_backend(name: str):
     return TpuBackend()
 
 
-def _run_method(backend, method: str, clusters, args):
+def _load_scores(args) -> dict[str, float]:
+    """Resolve the --method best score source ONCE per command (a real
+    msms.txt is hundreds of MB — it must not be re-parsed per chunk)."""
+    from specpride_tpu.io.maxquant import (
+        read_msms_scores,
+        read_percolator_scores,
+    )
+
+    if getattr(args, "psms", None):
+        return read_percolator_scores(
+            args.psms, args.px_accession,
+            raw_name=getattr(args, "raw_name", None),
+        )
+    if args.msms:
+        return read_msms_scores(args.msms, args.px_accession)
+    raise SystemExit(
+        "select --method best needs a score source: --msms "
+        "(MaxQuant msms.txt) or --psms (percolator/crux TSV)"
+    )
+
+
+def _run_method(backend, method: str, clusters, args, scores=None):
     if method == "bin-mean":
         config = BinMeanConfig(
             min_mz=args.min_mz, max_mz=args.max_mz, bin_size=args.bin_size,
@@ -71,16 +92,17 @@ def _run_method(backend, method: str, clusters, args):
     if method == "medoid":
         return backend.run_medoid(clusters, MedoidConfig(bin_size=args.xcorr_bin))
     if method == "best":
-        from specpride_tpu.io.maxquant import read_msms_scores
-
-        scores = read_msms_scores(args.msms, args.px_accession)
+        if scores is None:
+            scores = _load_scores(args)
         return backend.run_best_spectrum(
             clusters, scores, BestSpectrumConfig(px_accession=args.px_accession)
         )
     raise ValueError(method)
 
 
-def _checkpointed_run(backend, method, clusters, args, stats: RunStats):
+def _checkpointed_run(
+    backend, method, clusters, args, stats: RunStats, scores=None
+):
     """Chunked execution with a resume manifest (survey §5).
 
     Crash-safety contract: each chunk appends to the output FIRST, then the
@@ -132,12 +154,15 @@ def _checkpointed_run(backend, method, clusters, args, stats: RunStats):
     todo = [c for c in clusters if c.cluster_id not in done]
     stats.count("clusters_skipped_done", len(clusters) - len(todo))
     first_write = not done if output_bytes is None else output_bytes == 0
+    if getattr(args, "append", False):
+        # ref average_spectrum_clustering.py:183-184,198: mode 'wa'[append]
+        first_write = False
     chunk = args.checkpoint_every if args.checkpoint else len(todo) or 1
 
     for start in range(0, len(todo), chunk):
         part = todo[start : start + chunk]
         with stats.phase("compute"):
-            reps = _run_method(backend, method, part, args)
+            reps = _run_method(backend, method, part, args, scores=scores)
         with stats.phase("write"):
             write_mgf(reps, args.output, append=not first_write)
         first_write = False
@@ -171,6 +196,13 @@ def _load_clusters(path: str, stats: RunStats) -> list[Cluster]:
 def cmd_consensus(args) -> int:
     stats = RunStats()
     clusters = _load_clusters(args.input, stats)
+    if args.single:
+        # whole file = one cluster; the reference titles the result with
+        # the output filename (ref average_spectrum_clustering.py:203-205).
+        # Zero input spectra stay zero clusters — a truly empty cluster
+        # would crash the backends.
+        spectra = [s for c in clusters for s in c.members]
+        clusters = [Cluster(args.output, spectra)] if spectra else []
     backend = _get_backend(args.backend)
     _checkpointed_run(backend, args.method, clusters, args, stats)
     logger.info(
@@ -184,7 +216,8 @@ def cmd_select(args) -> int:
     stats = RunStats()
     clusters = _load_clusters(args.input, stats)
     backend = _get_backend(args.backend)
-    _checkpointed_run(backend, args.method, clusters, args, stats)
+    scores = _load_scores(args) if args.method == "best" else None
+    _checkpointed_run(backend, args.method, clusters, args, stats, scores)
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0
 
@@ -299,6 +332,11 @@ def build_parser() -> argparse.ArgumentParser:
                     default="lower_median")
     pc.add_argument("--rt", choices=["median", "mass_lower_median"],
                     default="median")
+    pc.add_argument("--single", action="store_true",
+                    help="treat the whole input file as one cluster "
+                         "(ref average_spectrum_clustering.py:172-176)")
+    pc.add_argument("--append", action="store_true",
+                    help="append to the output instead of replacing it")
     pc.add_argument("--checkpoint", help="resume manifest path")
     pc.add_argument("--checkpoint-every", type=int, default=512)
     pc.set_defaults(fn=cmd_consensus)
@@ -309,8 +347,14 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--method", choices=["best", "medoid"], default="medoid")
     _add_backend(ps)
     ps.add_argument("--msms", help="MaxQuant msms.txt (for --method best)")
+    ps.add_argument("--psms", help="percolator/crux PSM TSV score source "
+                                   "(for --method best; ref search.sh:6)")
+    ps.add_argument("--raw-name", help="raw file name for --psms USIs "
+                                       "(default: basename of its 'file' column)")
     ps.add_argument("--px-accession", default="PXD004732")
     ps.add_argument("--xcorr-bin", type=float, default=0.1)
+    ps.add_argument("--append", action="store_true",
+                    help="append to the output instead of replacing it")
     ps.add_argument("--checkpoint", help="resume manifest path")
     ps.add_argument("--checkpoint-every", type=int, default=512)
     ps.set_defaults(fn=cmd_select)

@@ -18,29 +18,84 @@ import csv
 import os
 
 
+def _score_usi(
+    px_accession: str, raw: str, scan: str, raw_suffix: str
+) -> str:
+    """The score-side USI both readers share, so MaxQuant and percolator
+    sources join identically: ``mzspec:<PX>:<raw><suffix>::scan:<n>`` —
+    the reference's double colon (empty index-type field,
+    ref src/best_spectrum.py:61-62) is reproduced for join parity.
+    ``raw_suffix`` is appended only when ``raw`` doesn't already carry it
+    (MaxQuant's 'Raw file' column has no extension; user-supplied
+    ``--raw-name`` values conventionally do)."""
+    if raw_suffix and not raw.endswith(raw_suffix):
+        raw = raw + raw_suffix
+    return f"mzspec:{px_accession}:{raw}::scan:{scan}"
+
+
+def _add_score(scores: dict[str, float], usi: str, score: float) -> None:
+    """Max-wins on duplicate USIs (pandas idxmax over a duplicated index
+    effectively compares all entries)."""
+    if usi not in scores or score > scores[usi]:
+        scores[usi] = score
+
+
 def read_msms_scores(
     path: str | os.PathLike,
     px_accession: str = "PXD004732",
     raw_suffix: str = ".raw",
 ) -> dict[str, float]:
-    """USI → MaxQuant PSM score.
-
-    USI construction matches ref src/best_spectrum.py:61-62:
-    ``mzspec:<PX>:<raw file>.raw::scan:<n>`` — note the reference's double
-    colon (empty index-type field) is reproduced for join parity.
-    When a USI occurs more than once, the max score wins (pandas idxmax over
-    a duplicated index effectively compares all entries).
-    """
+    """USI → MaxQuant PSM score (ref src/best_spectrum.py:43-64)."""
     scores: dict[str, float] = {}
     with open(path, newline="") as fh:
         reader = csv.DictReader(fh, delimiter="\t")
         for row in reader:
-            raw = row["Raw file"]
-            scan = row["Scan number"]
-            score = float(row["Score"])
-            usi = f"mzspec:{px_accession}:{raw}{raw_suffix}::scan:{scan}"
-            if usi not in scores or score > scores[usi]:
-                scores[usi] = score
+            usi = _score_usi(
+                px_accession, row["Raw file"], row["Scan number"], raw_suffix
+            )
+            _add_score(scores, usi, float(row["Score"]))
+    return scores
+
+
+def read_percolator_scores(
+    path: str | os.PathLike,
+    px_accession: str = "PXD004732",
+    raw_suffix: str = ".raw",
+    raw_name: str | None = None,
+) -> dict[str, float]:
+    """USI → percolator (crux) PSM score.
+
+    Second score source for ``select --method best``: the reference's only
+    external validation pipeline rescores PSMs with crux tide-search +
+    percolator (ref search.sh:4-6) but never wires the result back in —
+    here the ``*.target.psms.txt`` / percolator TSV output joins through
+    the same normalized-USI path as msms.txt.
+
+    Column handling (header-aware, tab-separated): scan from ``scan``,
+    score from the first of ``percolator score`` / ``xcorr score`` /
+    ``score``; the raw-file name from ``raw_name`` if given, else the
+    ``file`` column's basename without extension (crux records the mzML
+    path there), else empty.  USIs go through the shared ``_score_usi``
+    so both score sources join identically.
+    """
+    score_cols = ("percolator score", "xcorr score", "score")
+    scores: dict[str, float] = {}
+    with open(path, newline="") as fh:
+        reader = csv.DictReader(fh, delimiter="\t")
+        for row in reader:
+            scan = row.get("scan")
+            if scan is None:
+                continue
+            col = next((c for c in score_cols if c in row), None)
+            if col is None:
+                continue
+            if raw_name is not None:
+                raw = raw_name
+            else:
+                raw = os.path.basename(row.get("file", ""))
+                raw = raw.rsplit(".", 1)[0] if "." in raw else raw
+            usi = _score_usi(px_accession, raw, scan, raw_suffix)
+            _add_score(scores, usi, float(row[col]))
     return scores
 
 

@@ -199,8 +199,10 @@ def format_spectrum(spectrum: Spectrum, skip_nan: bool = True) -> str:
     """Format one spectrum as an MGF record.
 
     Field order TITLE / PEPMASS / RTINSECONDS / CHARGE matches the
-    interchange examples (ref file_formats.md:5-9); NaN-intensity peaks are
-    skipped as in the reference writer (ref src/binning.py:242).
+    interchange examples (ref file_formats.md:5-9); extra headers (e.g.
+    SEQUENCE=, present in the interchange example at ref file_formats.md:9)
+    follow in insertion order so records round-trip; NaN-intensity peaks
+    are skipped as in the reference writer (ref src/binning.py:242).
     """
     lines = ["BEGIN IONS", f"TITLE={spectrum.title}"]
     lines.append(f"PEPMASS={spectrum.precursor_mz}")
@@ -209,6 +211,8 @@ def format_spectrum(spectrum: Spectrum, skip_nan: bool = True) -> str:
     z = spectrum.precursor_charge
     if z:
         lines.append(f"CHARGE={abs(z)}{'+' if z > 0 else '-'}")
+    for key, value in spectrum.extra.items():
+        lines.append(f"{key}={value}")
     for mz, inten in zip(spectrum.mz, spectrum.intensity):
         if skip_nan and (np.isnan(inten) or np.isnan(mz)):
             continue

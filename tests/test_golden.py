@@ -1,0 +1,110 @@
+"""Golden-file tests: a checked-in clustered MGF in the interchange format
+(docs/file_formats.md; structure mirrors the example at ref
+file_formats.md:5-50 — full USI titles with peptide interpretation,
+PEPMASS/CHARGE/RTINSECONDS headers, SEQUENCE extras) plus frozen outputs
+for all four methods.
+
+The frozen outputs pin the numpy oracle BYTE-EXACTLY (any behavioral
+drift in a kernel, the MGF writer, or float formatting fails here), and
+the TPU backend must match them within fp32 tolerance.  Regenerate only
+for intentional behavior changes (see git history for the generator).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from specpride_tpu.backends import numpy_backend as nb
+from specpride_tpu.backends.tpu_backend import TpuBackend
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.data.peaks import group_into_clusters
+from specpride_tpu.io.maxquant import read_msms_scores
+from specpride_tpu.io.mgf import read_mgf, write_mgf
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def golden(name: str) -> str:
+    return os.path.join(DATA, name)
+
+
+@pytest.fixture(scope="module")
+def clusters():
+    return group_into_clusters(
+        read_mgf(golden("golden_clustered.mgf"), use_native=False)
+    )
+
+
+@pytest.fixture(scope="module")
+def scores():
+    return read_msms_scores(golden("golden_msms.txt"))
+
+
+def test_golden_input_is_interchange_format(clusters):
+    assert [c.cluster_id for c in clusters] == [
+        "cluster-1", "cluster-2", "cluster-3"
+    ]
+    assert [c.n_members for c in clusters] == [1, 3, 4]
+    s = clusters[0].members[0]
+    assert s.usi.startswith("mzspec:PXD004732:01650b_BA5-TUM")
+    assert ":scan:17551:VLHPLEGAVVIIFK/2" in s.usi
+    assert s.precursor_charge == 2
+    assert s.extra["SEQUENCE"] == "VLHPLEGAVVIIFK/2"
+
+
+def run_numpy(method, clusters, scores):
+    if method == "bin_mean":
+        return nb.run_bin_mean(clusters)
+    if method == "gap_average":
+        return nb.run_gap_average(clusters)
+    if method == "medoid":
+        return nb.run_medoid(clusters)
+    return nb.run_best_spectrum(clusters, scores)
+
+
+def run_tpu(method, clusters, scores):
+    backend = TpuBackend()
+    if method == "bin_mean":
+        return backend.run_bin_mean(clusters)
+    if method == "gap_average":
+        return backend.run_gap_average(clusters)
+    if method == "medoid":
+        return backend.run_medoid(clusters)
+    return backend.run_best_spectrum(clusters, scores)
+
+
+METHODS = ["bin_mean", "gap_average", "medoid", "best"]
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_numpy_backend_matches_golden_bytes(method, clusters, scores, tmp_path):
+    reps = run_numpy(method, clusters, scores)
+    out = tmp_path / "out.mgf"
+    write_mgf(reps, out)
+    assert out.read_bytes() == open(golden(f"golden_{method}.mgf"), "rb").read()
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_tpu_backend_matches_golden(method, clusters, scores):
+    expected = read_mgf(golden(f"golden_{method}.mgf"), use_native=False)
+    reps = run_tpu(method, clusters, scores)
+    assert len(reps) == len(expected)
+    for got, want in zip(reps, expected):
+        assert got.title.split(";")[0] == want.title.split(";")[0]
+        np.testing.assert_allclose(got.mz, want.mz, rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(
+            got.intensity, want.intensity, rtol=1e-4, atol=1e-2
+        )
+        np.testing.assert_allclose(
+            got.precursor_mz, want.precursor_mz, rtol=1e-6
+        )
+
+
+def test_cli_reproduces_golden_bin_mean(tmp_path):
+    out = tmp_path / "out.mgf"
+    assert cli_main([
+        "consensus", golden("golden_clustered.mgf"), str(out),
+        "--backend", "numpy",
+    ]) == 0
+    assert out.read_bytes() == open(golden("golden_bin_mean.mgf"), "rb").read()

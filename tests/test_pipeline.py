@@ -231,6 +231,88 @@ class TestCli:
             "--consensus", str(tmp_path / "consensus_bin-mean.mgf"),
         ]) == 0
 
+    def test_single_mode(self, tmp_path, rng):
+        """--single merges the whole file as ONE cluster, titled with the
+        output name (ref average_spectrum_clustering.py:172-176,203-205)."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=20)
+            for i in range(3)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out = tmp_path / "single.mgf"
+        assert cli_main([
+            "consensus", str(clustered), str(out),
+            "--method", "gap-average", "--single", "--backend", "numpy",
+        ]) == 0
+        reps = read_mgf(out)
+        assert len(reps) == 1
+        assert reps[0].title == str(out)
+        # matches merging all six spectra as one cluster directly
+        from specpride_tpu.backends import numpy_backend as nb
+
+        spectra = [s for c in clusters for s in c.members]
+        oracle = nb.run_gap_average([Cluster(str(out), spectra)])[0]
+        np.testing.assert_allclose(reps[0].mz, oracle.mz)
+        np.testing.assert_allclose(reps[0].intensity, oracle.intensity)
+
+    def test_append_flag(self, tmp_path, rng):
+        cluster = make_cluster(rng, "cluster-0", n_members=2, n_peaks=15)
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf(cluster.members, clustered)
+        out = tmp_path / "out.mgf"
+        for _ in range(2):
+            assert cli_main([
+                "consensus", str(clustered), str(out),
+                "--append", "--backend", "numpy",
+            ]) == 0
+        assert len(read_mgf(out)) == 2  # appended, not replaced
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--backend", "numpy",
+        ]) == 0
+        assert len(read_mgf(out)) == 1  # default mode replaces
+
+    def test_select_best_percolator_scores(self, tmp_path, rng, raw_spectra):
+        mgf, msms, tsv = write_inputs(tmp_path, raw_spectra)
+        clustered = tmp_path / "clustered.mgf"
+        assert cli_main([
+            "convert", str(mgf), str(clustered),
+            "--msms", str(msms), "--clusters", str(tsv),
+            "--raw-name", "run1.raw",
+        ]) == 0
+        # percolator TSV: scans 100-107, scan 103 / 107 score highest
+        psms = tmp_path / "perc.target.psms.txt"
+        rows = ["file\tscan\tcharge\tpercolator score\tsequence"]
+        for scan in range(100, 108):
+            score = 9.0 if scan in (103, 107) else 1.0
+            rows.append(f"data/run1.mzML\t{scan}\t2\t{score}\tPEPTIDEK")
+        psms.write_text("\n".join(rows) + "\n")
+        out = tmp_path / "best.mgf"
+        assert cli_main([
+            "select", str(clustered), str(out), "--method", "best",
+            "--psms", str(psms),
+        ]) == 0
+        reps = read_mgf(out)
+        assert sorted(s.usi.split(":scan:")[1].split(":")[0] for s in reps) \
+            == ["103", "107"]
+        # explicit --raw-name already carrying the extension joins the same
+        out2 = tmp_path / "best2.mgf"
+        assert cli_main([
+            "select", str(clustered), str(out2), "--method", "best",
+            "--psms", str(psms), "--raw-name", "run1.raw",
+        ]) == 0
+        assert [s.title for s in read_mgf(out2)] == [s.title for s in reps]
+
+    def test_select_best_requires_score_source(self, tmp_path, rng):
+        cluster = make_cluster(rng, "cluster-0", n_members=2, n_peaks=15)
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf(cluster.members, clustered)
+        with pytest.raises(SystemExit):
+            cli_main([
+                "select", str(clustered), str(tmp_path / "o.mgf"),
+                "--method", "best",
+            ])
+
     def test_checkpoint_resume(self, tmp_path, rng):
         clusters = [
             make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=30)
