@@ -135,13 +135,22 @@ class TpuBackend:
     ) -> list[Spectrum]:
         """Batched equivalent of ref src/binning.py:291-297 on the packed
         ragged layout; dispatches all chunks asynchronously, then collects
-        (overlapping H2D/compute/D2H)."""
+        (overlapping H2D/compute/D2H).
+
+        Single-device runs use the zero-padding FLAT layout (H2D bytes are
+        the bottleneck on tunneled hosts; bucket padding wastes ~50% of
+        them).  With a mesh, the (B, K) bucket layout shards along the
+        cluster axis — a flat peak axis would split clusters across
+        devices."""
         from specpride_tpu.data.packed import pack_bucketize_bin_mean
         from specpride_tpu.ops.binning import bin_mean_deduped_compact
 
         _check_no_empty(clusters)
         for c in clusters:
             numpy_backend.check_uniform_charge(c.members)
+
+        if self.mesh is None:
+            return self._run_bin_mean_flat(clusters, config)
 
         out: list[Spectrum | None] = [None] * len(clusters)
         pending = []
@@ -191,6 +200,56 @@ class TpuBackend:
                     ),
                     precursor_charge=members[0].precursor_charge,
                     title=batch.cluster_ids[lo + ci],
+                )
+        return [s for s in out if s is not None]
+
+    def _run_bin_mean_flat(
+        self, clusters: list[Cluster], config: BinMeanConfig
+    ) -> list[Spectrum]:
+        """Flat zero-padding K1 path (see ``data.packed.FlatBinBatch``)."""
+        from specpride_tpu.data.packed import pack_flat_bin_mean
+        from specpride_tpu.ops.binning import bin_mean_flat_compact
+
+        out: list[Spectrum | None] = [None] * len(clusters)
+        pending = []
+        sent = np.int32(2**31 - 1)
+        for batch in pack_flat_bin_mean(
+            clusters,
+            config.min_mz,
+            config.max_mz,
+            config.bin_size,
+            config.n_bins,
+            max_elements=self.max_grid_elements // 4,
+        ):
+            n = batch.gbin.size
+            n_pad = _pow2(n, floor=1024)
+            rows = len(batch.source_indices)
+            b_cap = _pow2(rows, floor=64)
+            cap = _pow2(batch.n_distinct_total, floor=1024)
+            fused = bin_mean_flat_compact(
+                np.pad(batch.mz, (0, n_pad - n)),
+                np.pad(batch.intensity, (0, n_pad - n)),
+                np.pad(batch.gbin, (0, n_pad - n), constant_values=sent),
+                np.pad(batch.n_members, (0, b_cap - rows)),
+                config=config,
+                total_cap=cap,
+                b_cap=b_cap,
+            )
+            pending.append((batch, rows, cap, fused))
+
+        for batch, rows, cap, fused in pending:
+            for ci, r_mz, r_int in _iter_compacted(fused, cap, rows):
+                gi = batch.source_indices[ci]
+                members = clusters[gi].members
+                out[gi] = Spectrum(
+                    mz=r_mz,
+                    intensity=r_int,
+                    # exact f64 mean, as the oracle (ref src/binning.py:224)
+                    precursor_mz=float(
+                        np.mean([s.precursor_mz for s in members])
+                    ),
+                    precursor_charge=members[0].precursor_charge,
+                    title=batch.cluster_ids[ci],
                 )
         return [s for s in out if s is not None]
 
@@ -281,14 +340,24 @@ class TpuBackend:
             bins = quantize.medoid_bins_packed(batch, config)
             b, k = batch.mz.shape
             m = batch.m
+            # host pre-sort by (bin, member) — the kernel does no device
+            # sort; padding member maps to m, padding bin is the 2**30
+            # sentinel, so padding sorts last either way
+            mm = np.where(batch.member_id >= 0, batch.member_id, m).astype(
+                np.int64
+            )
+            key = bins.astype(np.int64) * (m + 1) + mm
+            order = np.argsort(key, axis=1, kind="stable")
+            sbins = np.take_along_axis(bins, order, axis=1)
+            smm = np.take_along_axis(mm.astype(np.int32), order, axis=1)
             # largest device intermediate is the (K*M,) run×member occupancy
             chunk = max(1, self.max_grid_elements // max(k * m, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
                 res = shared_bins_packed(
                     *self._ship(
-                        _pad_axis0(bins[lo:hi], size, fill=2**30),
-                        _pad_axis0(batch.member_id[lo:hi], size, fill=-1),
+                        _pad_axis0(sbins[lo:hi], size, fill=2**30),
+                        _pad_axis0(smm[lo:hi], size, fill=m),
                     ),
                     m=m,
                 )
@@ -344,6 +413,8 @@ class TpuBackend:
         if len(representatives) != len(clusters):
             raise ValueError("representatives and clusters must align")
         _check_no_empty(clusters)
+        if self.mesh is None:
+            return self._average_cosines_flat(representatives, clusters, config)
         space = config.mz_space
         out = np.zeros((len(clusters),), dtype=np.float64)
         pending = []
@@ -376,6 +447,24 @@ class TpuBackend:
                 batch.mz64, batch.member_id >= 0, config
             )
 
+            # host pre-sort (device sorts were the dominant kernel cost):
+            # rep rows by bin; member rows by (member, bin) with padding
+            # mapped to m so it sorts last.  Sentinels (2**30) stay well
+            # below the composite-key bounds.
+            r_order = np.argsort(rep_bins, axis=1, kind="stable")
+            rep_bins = np.take_along_axis(rep_bins, r_order, axis=1)
+            rep_int = np.take_along_axis(rep_int, r_order, axis=1)
+            mm = np.where(batch.member_id >= 0, batch.member_id, m).astype(
+                np.int64
+            )
+            key = mm * (1 << 31) + mem_bins
+            m_order = np.argsort(key, axis=1, kind="stable")
+            mem_bins = np.take_along_axis(mem_bins, m_order, axis=1)
+            mem_int = np.take_along_axis(batch.intensity, m_order, axis=1)
+            mem_mm = np.take_along_axis(
+                mm.astype(np.int32), m_order, axis=1
+            )
+
             chunk = max(1, self.max_grid_elements // max((k + pr) * 6, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
@@ -385,8 +474,8 @@ class TpuBackend:
                         _pad_axis0(rep_int[lo:hi], size),
                         _pad_axis0(rep_edges[lo:hi], size),
                         _pad_axis0(mem_bins[lo:hi], size, fill=2**30),
-                        _pad_axis0(batch.intensity[lo:hi], size),
-                        _pad_axis0(batch.member_id[lo:hi], size, fill=-1),
+                        _pad_axis0(mem_int[lo:hi], size),
+                        _pad_axis0(mem_mm[lo:hi], size, fill=m),
                         _pad_axis0(mem_edges[lo:hi], size),
                         _pad_axis0(batch.member_mask[lo:hi], size),
                         _pad_axis0(batch.n_members[lo:hi], size),
@@ -399,4 +488,180 @@ class TpuBackend:
             mean = np.asarray(mean)
             for ci in range(hi - lo):
                 out[idxs[lo + ci]] = float(mean[ci])
+        return out
+
+    def _average_cosines_flat(
+        self,
+        representatives: list[Spectrum],
+        clusters: list[Cluster],
+        config: CosineConfig,
+    ) -> np.ndarray:
+        """Flat zero-padding K2b path (``ops.similarity.cosine_flat``):
+        member peaks and rep peaks each travel as ONE flat sorted axis with
+        int32 (row, bin) composite keys — no bucket padding, no per-cluster
+        Python fill loop, one dispatch per ~max_grid_elements peaks."""
+        from specpride_tpu.data.packed import _as_table, _grouped_arange
+        from specpride_tpu.ops.similarity import cosine_flat
+
+        table = _as_table(clusters)
+        idx = table.cluster_order()
+        c = table.n_clusters
+        space = config.mz_space
+
+        # --- member flat arrays, sorted by (row, member, bin)
+        order = idx.order  # spectrum ids grouped by cluster code
+        sorted_code = table.cluster_code[order]
+        cnt = table.peak_counts[order]
+        row_pk = np.repeat(sorted_code, cnt)
+        mem_pk = np.repeat(idx.member_index, cnt)
+        src = np.repeat(table.peak_offsets[order], cnt) + _grouped_arange(cnt)
+        mz64 = table.mz[src]
+        inten = table.intensity[src].astype(np.float32)
+        cbin = np.maximum(
+            np.floor((mz64 + space / 2.0) / space).astype(np.int64), 0
+        )
+        # per-spectrum edge count off the LAST peak in ORIGINAL order
+        # (ref src/benchmark.py:20 assumes sorted spectra; parity demands
+        # the last element, not the max)
+        has = cnt > 0
+        last_pos = table.peak_offsets[order] + np.maximum(cnt - 1, 0)
+        last_mz = np.where(has, table.mz[np.minimum(last_pos,
+                                                    table.mz.size - 1)],
+                           -np.inf)
+        spec_edges = quantize.cosine_edge_count(last_mz, space)
+
+        perm = np.lexsort((cbin, mem_pk, row_pk))
+        row_pk = row_pk[perm]
+        mem_pk = mem_pk[perm]
+        cbin = cbin[perm]
+        inten = inten[perm]
+
+        # --- rep flat arrays, sorted by (row, bin)
+        rep_counts = np.array(
+            [representatives[i].n_peaks for i in range(c)], dtype=np.int64
+        )
+        rep_mz = (
+            np.concatenate([np.asarray(representatives[i].mz, np.float64)
+                            for i in range(c)])
+            if rep_counts.sum()
+            else np.zeros(0, np.float64)
+        )
+        rep_in = (
+            np.concatenate([np.asarray(representatives[i].intensity,
+                                       np.float32) for i in range(c)])
+            if rep_counts.sum()
+            else np.zeros(0, np.float32)
+        )
+        rep_row = np.repeat(np.arange(c, dtype=np.int64), rep_counts)
+        rbin = np.maximum(
+            np.floor((rep_mz + space / 2.0) / space).astype(np.int64), 0
+        )
+        rep_last = np.array(
+            [
+                representatives[i].mz[-1] if representatives[i].n_peaks else
+                -np.inf
+                for i in range(c)
+            ],
+            dtype=np.float64,
+        )
+        rep_edges_all = quantize.cosine_edge_count(rep_last, space)
+        rperm = np.lexsort((rbin, rep_row))
+        rep_row = rep_row[rperm]
+        rbin = rbin[rperm]
+        rep_in = rep_in[rperm]
+        rep_offsets_all = np.zeros(c + 1, dtype=np.int64)
+        np.cumsum(rep_counts, out=rep_offsets_all[1:])
+        row_peak_offsets = np.zeros(c + 1, dtype=np.int64)
+        np.cumsum(idx.total_peaks, out=row_peak_offsets[1:])
+
+        max_bin = int(
+            max(
+                cbin.max(initial=0),
+                rbin.max(initial=0),
+                int(np.max(spec_edges, initial=0)),
+                int(np.max(rep_edges_all, initial=0)),
+            )
+        )
+        shift = _pow2(max_bin + 2, floor=1 << 20)
+        mcap = _pow2(int(idx.max_members))
+        max_rows_cap = max((2**31 - 2) // shift, 1)
+        # rows_cap (pow2) must stay under the composite budget
+        max_rows = max(1 << (max_rows_cap.bit_length() - 1), 1)
+
+        sent = np.int32(2**31 - 1)
+        out = np.zeros((c,), dtype=np.float64)
+        pending = []
+        lo = 0
+        budget = self.max_grid_elements // 4
+        while lo < c:
+            hi = min(lo + max_rows, c)
+            while (
+                hi > lo + 1
+                and row_peak_offsets[hi] - row_peak_offsets[lo] > budget
+            ):
+                hi = lo + max(
+                    int(
+                        np.searchsorted(
+                            row_peak_offsets[lo + 1 : hi + 1],
+                            row_peak_offsets[lo] + budget,
+                            side="right",
+                        )
+                    ),
+                    1,
+                )
+            rows = hi - lo
+            rows_cap = _pow2(rows, floor=min(64, max_rows))
+            p0, p1 = int(row_peak_offsets[lo]), int(row_peak_offsets[hi])
+            n = p1 - p0
+            n_pad = _pow2(n, floor=1024)
+            mkey = (
+                (row_pk[p0:p1] - lo) * np.int64(shift) + cbin[p0:p1]
+            ).astype(np.int32)
+            gmem = ((row_pk[p0:p1] - lo) * mcap + mem_pk[p0:p1]).astype(
+                np.int32
+            )
+            r0 = int(rep_offsets_all[lo])
+            r1 = int(rep_offsets_all[hi])
+            nr = r1 - r0
+            nr_pad = _pow2(nr, floor=256)
+            rkey = ((rep_row[r0:r1] - lo) * np.int64(shift) + rbin[r0:r1]).astype(
+                np.int32
+            )
+            rep_offsets = np.zeros(rows_cap + 1, dtype=np.int32)
+            rep_offsets[: rows + 1] = (
+                rep_offsets_all[lo : hi + 1] - r0
+            ).astype(np.int32)
+            rep_offsets[rows + 1 :] = rep_offsets[rows]
+            rep_edges = np.zeros(rows_cap, dtype=np.int32)
+            rep_edges[:rows] = rep_edges_all[lo:hi]
+            # per-(row, member) edge counts scattered dense
+            medges = np.zeros(rows_cap * mcap, dtype=np.int32)
+            sel = (sorted_code >= lo) & (sorted_code < hi)
+            medges[
+                (sorted_code[sel] - lo) * mcap + idx.member_index[sel]
+            ] = spec_edges[sel]
+            nm = np.zeros(rows_cap, dtype=np.int32)
+            nm[:rows] = idx.n_members[lo:hi]
+
+            mean = cosine_flat(
+                np.pad(rkey, (0, nr_pad - nr), constant_values=sent),
+                np.pad(rep_in[r0:r1], (0, nr_pad - nr)),
+                rep_offsets,
+                rep_edges,
+                np.pad(mkey, (0, n_pad - n), constant_values=sent),
+                np.pad(inten[p0:p1], (0, n_pad - n)),
+                np.pad(
+                    gmem, (0, n_pad - n),
+                    constant_values=np.int32(rows_cap * mcap),
+                ),
+                medges,
+                nm,
+                mcap=mcap,
+                shift=shift,
+            )
+            pending.append((lo, rows, mean))
+            lo = hi
+
+        for lo, rows, mean in pending:
+            out[lo : lo + rows] = np.asarray(mean)[:rows]
         return out

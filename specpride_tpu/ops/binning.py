@@ -3,13 +3,15 @@
 TPU-native replacement for the per-cluster Python loop + numpy scatter-add of
 ref src/binning.py:170-231 (``combine_bin_mean``).  Pipeline (see
 ``data.packed.BinPackedBatch``): the host quantizes m/z to grid bins in
-float64 and drops duplicate-(member, bin) peaks (the numpy buffered ``+=``
-semantics, ref src/binning.py:197-199), so the device kernel is pure dense
-work on K packed peaks per cluster — one stable sort by bin, segmented
-reductions for per-bin member counts / intensity / m/z sums, the dynamic
-quorum ``int(n_members * fraction) + 1`` (ref src/binning.py:181-183), and a
+float64, drops duplicate-(member, bin) peaks (the numpy buffered ``+=``
+semantics, ref src/binning.py:197-199) and PRE-SORTS each row by bin, so
+the device kernel is pure dense work on K packed peaks per cluster —
+segment detection on the sorted bins, segmented reductions for per-bin
+member counts / intensity / m/z sums, the dynamic quorum
+``int(n_members * fraction) + 1`` (ref src/binning.py:181-183), and a
 global compaction so the device→host transfer carries only real output
-bytes.  The (n_bins,)-sized dense grid of the reference never materialises.
+bytes.  The (n_bins,)-sized dense grid of the reference never materialises
+and no sort runs on device (TPU sorts were the dominant kernel cost).
 """
 
 from __future__ import annotations
@@ -23,19 +25,22 @@ from specpride_tpu.config import BinMeanConfig
 
 
 def _bin_mean_deduped_stats(
-    mz: jax.Array,  # (K,) f32
-    intensity: jax.Array,  # (K,) f32
-    bins: jax.Array,  # (K,) i32, sentinel = n_bins (padding)
+    mz: jax.Array,  # (K,) f32, row PRE-SORTED by bin
+    intensity: jax.Array,  # (K,) f32, same order
+    bins: jax.Array,  # (K,) i32 NON-DECREASING, sentinel = n_bins (padding)
     n_members: jax.Array,  # () i32
     config: BinMeanConfig,
 ):
     """Per-cluster per-bin stats (mz mean, intensity mean, keep mask) in
-    segment-id positions — the vmappable core of ``bin_mean_deduped``."""
+    segment-id positions — the vmappable core of ``bin_mean_deduped``.
+
+    ``bins`` must be non-decreasing per row (the packer sorts on the host —
+    device-side stable sorts were the dominant kernel cost on TPU); the
+    kernel is pure segment detection + sorted segment sums."""
     k = bins.shape[0]
     n_bins = config.n_bins
 
-    order = jnp.argsort(bins, stable=True)
-    sb = bins[order]
+    sb = bins
     valid = sb < n_bins
 
     new_bin = jnp.concatenate(
@@ -46,10 +51,10 @@ def _bin_mean_deduped_stats(
     w = jnp.where(valid, 1.0, 0.0)
     counts = jax.ops.segment_sum(w, seg, num_segments=k, indices_are_sorted=True)
     inten_sum = jax.ops.segment_sum(
-        intensity[order] * w, seg, num_segments=k, indices_are_sorted=True
+        intensity * w, seg, num_segments=k, indices_are_sorted=True
     )
     mz_sum = jax.ops.segment_sum(
-        mz[order] * w, seg, num_segments=k, indices_are_sorted=True
+        mz * w, seg, num_segments=k, indices_are_sorted=True
     )
 
     if config.apply_peak_quorum:
@@ -62,6 +67,77 @@ def _bin_mean_deduped_stats(
     keep_bin = counts >= quorum
     safe = jnp.maximum(counts, 1.0)
     return mz_sum / safe, inten_sum / safe, keep_bin
+
+
+@functools.partial(jax.jit, static_argnames=("config", "total_cap", "b_cap"))
+def bin_mean_flat_compact(
+    mz: jax.Array,  # (N,) f32, sorted by (row, bin); tail padding
+    intensity: jax.Array,  # (N,) f32, same order
+    gbin: jax.Array,  # (N,) i32 row*(n_bins+1)+bin, sentinel 2**31-1
+    n_members: jax.Array,  # (b_cap,) i32, 0 past the real rows
+    config: BinMeanConfig,
+    total_cap: int,
+    b_cap: int,
+):
+    """Flat zero-padding variant of ``bin_mean_deduped_compact`` (see
+    ``data.packed.FlatBinBatch``): one fused 1-D output
+    ``[flat_mz (total_cap) | flat_intensity (total_cap) | n_out (b_cap)]``.
+
+    The (row, bin) composite ``gbin`` makes runs globally unique, so one
+    segment pass over the whole flat batch handles every cluster at once —
+    no vmap, no per-row padding, and the sentinel tail contributes
+    nothing."""
+    n = gbin.shape[0]
+    nb1 = jnp.int32(config.n_bins + 1)
+    sent = jnp.int32(2**31 - 1)
+    valid = gbin < sent
+
+    new_run = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), (gbin[1:] != gbin[:-1]).astype(jnp.int32)]
+    )
+    seg = jnp.cumsum(new_run)
+    w = jnp.where(valid, 1.0, 0.0)
+    counts = jax.ops.segment_sum(w, seg, num_segments=n, indices_are_sorted=True)
+    inten_sum = jax.ops.segment_sum(
+        intensity * w, seg, num_segments=n, indices_are_sorted=True
+    )
+    mz_sum = jax.ops.segment_sum(
+        mz * w, seg, num_segments=n, indices_are_sorted=True
+    )
+
+    # row of each segment (empty segments -> -1 via the sentinel input)
+    row_of_elem = jnp.where(valid, gbin // nb1, -1)
+    row_of_seg = jax.ops.segment_max(
+        row_of_elem, seg, num_segments=n, indices_are_sorted=True
+    )
+    real_seg = row_of_seg >= 0
+
+    if config.apply_peak_quorum:
+        nm = n_members[jnp.clip(row_of_seg, 0, b_cap - 1)].astype(jnp.float32)
+        quorum = jnp.floor(nm * config.quorum_fraction) + 1.0
+    else:
+        quorum = jnp.float32(1.0)
+    keep = real_seg & (counts >= quorum)
+
+    safe = jnp.maximum(counts, 1.0)
+    mz_mean = mz_sum / safe
+    inten_mean = inten_sum / safe
+
+    n_out = jax.ops.segment_sum(
+        jnp.where(keep, 1.0, 0.0),
+        jnp.where(keep, row_of_seg, b_cap),
+        num_segments=b_cap + 1,
+    )[:b_cap]
+
+    (idx,) = jnp.nonzero(keep, size=total_cap, fill_value=n)
+    ok = idx < n
+    flat_mz = jnp.where(
+        ok, mz_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0
+    )
+    flat_int = jnp.where(
+        ok, inten_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0
+    )
+    return jnp.concatenate([flat_mz, flat_int, n_out])
 
 
 @functools.partial(jax.jit, static_argnames=("config", "total_cap"))

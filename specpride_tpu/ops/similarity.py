@@ -42,8 +42,8 @@ _SENT = jnp.int32(2**30)  # padding sentinel for global bin ids
 
 @functools.partial(jax.jit, static_argnames=("m",))
 def shared_bins_packed(
-    bins: jax.Array,  # (B, K) i32 GLOBAL f64-quantized bins, sentinel 2**30
-    member_id: jax.Array,  # (B, K) i32, -1 = padding
+    bins: jax.Array,  # (B, K) i32 GLOBAL bins, PRE-SORTED (bin, member)
+    member_id: jax.Array,  # (B, K) i32 in [0, m], same order, padding = m
     m: int,
 ) -> jax.Array:
     """(B, M, M) shared occupied-bin counts for every member pair.
@@ -51,25 +51,20 @@ def shared_bins_packed(
     Sort/segment formulation — no dense (M, grid) occupancy and no scatter
     (TPU scatters serialize; the round-1 dense-grid kernel spent its time
     there and its data-dependent ``grid`` static arg recompiled per batch).
-    Peaks sort by (bin, member); the first element of each (bin, member) run
-    contributes 1 to a runs×members occupancy ``V`` built with ONE sorted
-    ``segment_sum`` (segment id = bin_run * m + member, non-decreasing by
-    construction), and all pairwise counts come from the batched gram matmul
-    ``Vᵀ @ V`` on the MXU.  Bin ids are global grid positions
-    (``floor(mz / bin_size)`` in f64 on the host) — pairwise intersections
-    don't care about a per-cluster origin, so no span/rel-bin pass exists
-    any more.  Counts return as uint16: D2H bytes are the bottleneck on
-    tunneled hosts, and counts are bounded by per-member peak counts (the
-    driver asserts < 2**16)."""
+    Rows arrive PRE-SORTED by (bin, member) from the host (device sorts
+    were the dominant kernel cost); the first element of each
+    (bin, member) run contributes 1 to a runs×members occupancy ``V``
+    built with ONE sorted ``segment_sum`` (segment id = bin_run * m +
+    member, non-decreasing by construction), and all pairwise counts come
+    from the batched gram matmul ``Vᵀ @ V`` on the MXU.  Bin ids are
+    global grid positions (``floor(mz / bin_size)`` in f64 on the host) —
+    pairwise intersections don't care about a per-cluster origin, so no
+    span/rel-bin pass exists any more.  Counts return as uint16: D2H bytes
+    are the bottleneck on tunneled hosts, and counts are bounded by
+    per-member peak counts (the driver asserts < 2**16)."""
 
-    def one(b, mid):
-        k = b.shape[0]
-        mm = jnp.where(mid >= 0, mid, m)  # padding sorts last
-        o1 = jnp.argsort(mm, stable=True)
-        o2 = jnp.argsort(b[o1], stable=True)
-        perm = o1[o2]
-        sb = b[perm]
-        sm = mm[perm]
+    def one(sb, sm):
+        k = sb.shape[0]
         ok = (sm < m) & (sb < _SENT)
         new_bin = jnp.concatenate(
             [jnp.ones((1,), jnp.int32), (sb[1:] != sb[:-1]).astype(jnp.int32)]
@@ -126,12 +121,12 @@ def medoid_finalize(
 # ---------------------------------------------------------------------------
 
 def _cosine_packed_cluster(
-    rep_bins: jax.Array,  # (Pr,) i32, sentinel = SENT for padding
-    rep_int: jax.Array,  # (Pr,) f32, 0 where invalid
+    rep_bins: jax.Array,  # (Pr,) i32 NON-DECREASING, sentinel = SENT last
+    rep_int: jax.Array,  # (Pr,) f32, same order, 0 where invalid
     rep_edges: jax.Array,  # () i32
-    mem_bins: jax.Array,  # (K,) i32, sentinel = SENT
-    mem_int: jax.Array,  # (K,) f32
-    mem_member: jax.Array,  # (K,) i32, -1 = padding
+    mem_bins: jax.Array,  # (K,) i32 sorted by (member, bin), sentinel = SENT
+    mem_int: jax.Array,  # (K,) f32, same order
+    mem_member: jax.Array,  # (K,) i32 sorted member ids, padding = m (last)
     mem_edges: jax.Array,  # (M,) i32 per-member edge counts
     member_mask: jax.Array,  # (M,) bool
     n_members: jax.Array,  # () i32
@@ -139,24 +134,25 @@ def _cosine_packed_cluster(
 ):
     """All (representative, member) cosines of one cluster from packed peaks.
 
-    Per-bin algebra instead of per-pair grids: sort member peaks by
-    (member, bin) → per-(member, bin) intensity sums; sort rep peaks by bin
-    → per-bin rep sums with a prefix of squared run totals; then each
-    member's dot/norms are segment reductions with an O(log Pr)
-    searchsorted lookup of the rep per-bin sum.  The pair's grid-edge cut
-    (ref src/benchmark.py:20-22: bins beyond the pair's last edge are
-    excluded) becomes a per-member cutoff ``max(rep_edges, mem_edges[m])-2``
-    applied to member contributions directly and to the rep norm via the
-    prefix array.  Device output is just the (M,) cosines.
+    Per-bin algebra instead of per-pair grids: per-(member, bin) intensity
+    sums on member peaks PRE-SORTED by (member, bin) on the host; per-bin
+    rep sums (rep pre-sorted by bin) with a prefix of squared run totals;
+    then each member's dot/norms are segment reductions with an O(log Pr)
+    searchsorted lookup of the rep per-bin sum.  No sort runs on device —
+    TPU sorts were the dominant kernel cost; the host lexsorts at prep
+    time.  The pair's grid-edge cut (ref src/benchmark.py:20-22: bins
+    beyond the pair's last edge are excluded) becomes a per-member cutoff
+    ``max(rep_edges, mem_edges[m])-2`` applied to member contributions
+    directly and to the rep norm via the prefix array.  Device output is
+    just the (M,) cosines.
     """
     sent = jnp.int32(2**30)
     pr = rep_bins.shape[0]
     k = mem_bins.shape[0]
 
     # --- rep side: per-bin sums + prefix of squared run totals
-    r_order = jnp.argsort(rep_bins, stable=True)
-    rb = rep_bins[r_order]
-    ri = rep_int[r_order]
+    rb = rep_bins
+    ri = rep_int
     r_new = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), (rb[1:] != rb[:-1]).astype(jnp.int32)]
     )
@@ -170,14 +166,10 @@ def _cosine_packed_cluster(
     r_sq_contrib = jnp.where(r_last & (rb < sent), r_sum_at * r_sum_at, 0.0)
     r_sq_prefix = jnp.cumsum(r_sq_contrib)  # inclusive, in sorted-bin order
 
-    # --- member side: sort by (member, bin) via two stable argsorts
-    mm = jnp.where(mem_member >= 0, mem_member, m)  # padding sorts last
-    o1 = jnp.argsort(mem_bins, stable=True)
-    o2 = jnp.argsort(mm[o1], stable=True)
-    perm = o1[o2]
-    sb = mem_bins[perm]
-    si = mem_int[perm]
-    sm = mm[perm]
+    # --- member side: already sorted by (member, bin) host-side
+    sb = mem_bins
+    si = mem_int
+    sm = mem_member
 
     cutoff = jnp.maximum(rep_edges, mem_edges) - 2  # (M,) last includable bin
     cut_at = cutoff[jnp.clip(sm, 0, m - 1)]
@@ -233,6 +225,143 @@ def _cosine_packed_cluster(
     return mean, cos
 
 
+@functools.partial(jax.jit, static_argnames=("mcap", "shift"))
+def cosine_flat(
+    rkey: jax.Array,  # (Nr,) i32 row*shift+bin, ascending; sentinel tail
+    rint: jax.Array,  # (Nr,) f32, same order
+    rep_offsets: jax.Array,  # (rows_cap + 1,) i32 rep extents per row
+    rep_edges: jax.Array,  # (rows_cap,) i32
+    cbin: jax.Array,  # (N,) i32 cosine bins sorted by (row, member, bin)
+    mint: jax.Array,  # (N,) f32, same order
+    spec_offsets: jax.Array,  # (S + 1,) i32 peak extents per spectrum
+    spec_gmem: jax.Array,  # (S + 1,) i32 row*mcap+member per spectrum;
+    #   entry S is the rows_cap*mcap sentinel for the padding tail
+    mem_edges: jax.Array,  # (rows_cap * mcap,) i32 per-(row, member)
+    n_members: jax.Array,  # (rows_cap,) i32
+    mcap: int,
+    shift: int,
+):
+    """Flat zero-padding rep-vs-members binned cosine (see
+    ``cosine_packed`` for the per-bin algebra; this is the same math over
+    ONE flat peak axis for the whole batch).  Composite int32 keys
+    (``row * shift + bin``) make rep lookups a single global searchsorted
+    and member runs globally unique — no vmap, no per-row padding.  The
+    per-peak (row, member) channel is DERIVED on device from the tiny
+    per-spectrum extent table (H2D bytes are the bottleneck; shipping it
+    per peak would cost 4 B/peak).  The per-row rep-norm prefix is a
+    global cumsum differenced at row starts.  Returns the (rows_cap,)
+    mean cosines — the only D2H bytes."""
+    sent = jnp.int32(2**31 - 1)
+    nr = rkey.shape[0]
+    n = cbin.shape[0]
+    rows_cap = rep_edges.shape[0]
+    s = spec_gmem.shape[0] - 1
+
+    # derive per-peak (row, member) + composite bin key on device
+    spec_of_elem = (
+        jnp.searchsorted(
+            spec_offsets, jnp.arange(n, dtype=jnp.int32), side="right"
+        )
+        - 1
+    )
+    gmem = spec_gmem[jnp.clip(spec_of_elem, 0, s)]
+    valid0 = cbin < sent
+    mkey_row = jnp.clip(gmem // mcap, 0, rows_cap - 1)
+    # dead-branch overflow of the multiply is discarded by the where
+    mkey = jnp.where(
+        valid0, mkey_row * jnp.int32(shift) + cbin, sent
+    )
+
+    # --- rep side: per-bin sums + global prefix of squared run totals
+    rvalid = rkey < sent
+    r_new = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), (rkey[1:] != rkey[:-1]).astype(jnp.int32)]
+    )
+    r_seg = jnp.cumsum(r_new)
+    r_sum_per_seg = jax.ops.segment_sum(
+        jnp.where(rvalid, rint, 0.0), r_seg, num_segments=nr,
+        indices_are_sorted=True,
+    )
+    r_sum_at = r_sum_per_seg[r_seg]
+    r_last = jnp.concatenate([rkey[:-1] != rkey[1:], jnp.ones((1,), bool)])
+    r_sq_contrib = jnp.where(r_last & rvalid, r_sum_at * r_sum_at, 0.0)
+    r_sq_prefix = jnp.cumsum(r_sq_contrib)
+
+    # --- member side: runs of (row, member, bin) = (gmem, mkey) pairs
+    valid = mkey < sent
+    row_of_elem = jnp.clip(gmem // mcap, 0, rows_cap - 1)
+    gm_c = jnp.clip(gmem, 0, rows_cap * mcap - 1)
+    cut = jnp.maximum(rep_edges[row_of_elem], mem_edges[gm_c]) - 2
+    cutkey = row_of_elem.astype(jnp.int32) * jnp.int32(shift) + cut
+    ok = valid & (mkey <= cutkey)
+
+    run_new = jnp.concatenate(
+        [
+            jnp.zeros((1,), jnp.int32),
+            ((mkey[1:] != mkey[:-1]) | (gmem[1:] != gmem[:-1])).astype(
+                jnp.int32
+            ),
+        ]
+    )
+    run_seg = jnp.cumsum(run_new)
+    run_sum = jax.ops.segment_sum(
+        jnp.where(ok, mint, 0.0), run_seg, num_segments=n,
+        indices_are_sorted=True,
+    )
+    run_sum_at = run_sum[run_seg]
+    is_last = jnp.concatenate(
+        [(mkey[:-1] != mkey[1:]) | (gmem[:-1] != gmem[1:]), jnp.ones((1,), bool)]
+    )
+
+    pos = jnp.searchsorted(rkey, mkey, side="left")
+    pos_c = jnp.clip(pos, 0, nr - 1)
+    rep_hit = (rkey[pos_c] == mkey) & valid
+    rep_val = jnp.where(rep_hit, r_sum_per_seg[r_seg[pos_c]], 0.0)
+
+    contrib = is_last & ok
+    seg_ids = jnp.where(valid, gm_c, rows_cap * mcap)
+    dots = jax.ops.segment_sum(
+        jnp.where(contrib, run_sum_at * rep_val, 0.0),
+        seg_ids,
+        num_segments=rows_cap * mcap + 1,
+        indices_are_sorted=True,
+    )[:-1]
+    norms = jax.ops.segment_sum(
+        jnp.where(contrib, run_sum_at * run_sum_at, 0.0),
+        seg_ids,
+        num_segments=rows_cap * mcap + 1,
+        indices_are_sorted=True,
+    )[:-1]
+
+    # rep norm per (row, member): prefix difference over the row's window
+    row_ids = jnp.repeat(
+        jnp.arange(rows_cap, dtype=jnp.int32), mcap
+    )  # (rows_cap*mcap,)
+    pair_cut = (
+        jnp.maximum(rep_edges[row_ids], mem_edges) - 2
+    )  # (rows_cap*mcap,)
+    npos = jnp.searchsorted(
+        rkey, row_ids * jnp.int32(shift) + pair_cut + 1, side="left"
+    )
+    upto = jnp.where(npos > 0, r_sq_prefix[jnp.clip(npos - 1, 0, nr - 1)], 0.0)
+    row_start = rep_offsets[row_ids]
+    base = jnp.where(
+        row_start > 0, r_sq_prefix[jnp.clip(row_start - 1, 0, nr - 1)], 0.0
+    )
+    rep_norm = jnp.maximum(upto - base, 0.0)
+
+    okc = (norms > 0) & (rep_norm > 0)
+    cos = jnp.where(
+        okc, dots / jnp.sqrt(jnp.maximum(norms * rep_norm, 1e-30)), 0.0
+    )
+    member_ids = jnp.tile(jnp.arange(mcap, dtype=jnp.int32), rows_cap)
+    mask = member_ids < n_members[row_ids]
+    cos = jnp.where(mask, cos, 0.0).reshape(rows_cap, mcap)
+    return jnp.sum(cos, axis=1) / jnp.maximum(
+        n_members.astype(jnp.float32), 1.0
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("m",))
 def cosine_packed(
     rep_bins: jax.Array,  # (B, Pr) i32
@@ -247,7 +376,10 @@ def cosine_packed(
     m: int,
 ):
     """Packed rep-vs-members binned cosine (ref src/benchmark.py:31-38).
-    Returns ((B,) mean cosine, (B, M) pair cosines) — the only D2H bytes."""
+    Rep rows must be pre-sorted by bin and member rows by (member, bin)
+    with the member channel already padding-mapped to ``m`` (the backend's
+    host prep does both).  Returns ((B,) mean cosine, (B, M) pair
+    cosines) — the only D2H bytes."""
     return jax.vmap(
         lambda a, b, c, d, e, f, g, h, i: _cosine_packed_cluster(
             a, b, c, d, e, f, g, h, i, m
