@@ -531,10 +531,15 @@ class TpuBackend:
         spec_edges = quantize.cosine_edge_count(last_mz, space)
 
         perm = np.lexsort((cbin, mem_pk, row_pk))
-        row_pk = row_pk[perm]
-        mem_pk = mem_pk[perm]
         cbin = cbin[perm]
         inten = inten[perm]
+        # per-spectrum peak extents: the lexsort keeps each spectrum's peaks
+        # contiguous in (row, member) order — exactly the `order` sequence —
+        # so cumsum(cnt) gives every spectrum's [start, end) in the permuted
+        # flat arrays.  The kernel derives per-peak (row, member) from these
+        # tiny tables on device (shipping it per peak costs 4 B/peak of H2D).
+        spec_start = np.zeros(order.size + 1, dtype=np.int64)
+        np.cumsum(cnt, out=spec_start[1:])
 
         # --- rep flat arrays, sorted by (row, bin)
         rep_counts = np.array(
@@ -614,11 +619,20 @@ class TpuBackend:
             p0, p1 = int(row_peak_offsets[lo]), int(row_peak_offsets[hi])
             n = p1 - p0
             n_pad = _pow2(n, floor=1024)
-            mkey = (
-                (row_pk[p0:p1] - lo) * np.int64(shift) + cbin[p0:p1]
-            ).astype(np.int32)
-            gmem = ((row_pk[p0:p1] - lo) * mcap + mem_pk[p0:p1]).astype(
-                np.int32
+            # spectra of this chunk (sorted_code is non-decreasing over
+            # `order`, so a searchsorted window covers exactly rows [lo, hi))
+            s0 = int(np.searchsorted(sorted_code, lo, side="left"))
+            s1 = int(np.searchsorted(sorted_code, hi, side="left"))
+            # pow2-padded like every other kernel input (shapes key the jit
+            # cache).  Tail entries repeat the final offset / the sentinel:
+            # searchsorted(side="right")-1 + clip in the kernel then maps
+            # padded peaks to the sentinel row and real peaks unchanged.
+            s_pad = _pow2(s1 - s0 + 1, floor=64)
+            spec_offsets = np.full(s_pad, n, dtype=np.int32)
+            spec_offsets[: s1 - s0 + 1] = spec_start[s0 : s1 + 1] - p0
+            spec_gmem = np.full(s_pad, rows_cap * mcap, dtype=np.int32)
+            spec_gmem[: s1 - s0] = (sorted_code[s0:s1] - lo) * mcap + (
+                idx.member_index[s0:s1]
             )
             r0 = int(rep_offsets_all[lo])
             r1 = int(rep_offsets_all[hi])
@@ -636,10 +650,7 @@ class TpuBackend:
             rep_edges[:rows] = rep_edges_all[lo:hi]
             # per-(row, member) edge counts scattered dense
             medges = np.zeros(rows_cap * mcap, dtype=np.int32)
-            sel = (sorted_code >= lo) & (sorted_code < hi)
-            medges[
-                (sorted_code[sel] - lo) * mcap + idx.member_index[sel]
-            ] = spec_edges[sel]
+            medges[spec_gmem[: s1 - s0]] = spec_edges[s0:s1]
             nm = np.zeros(rows_cap, dtype=np.int32)
             nm[:rows] = idx.n_members[lo:hi]
 
@@ -648,12 +659,13 @@ class TpuBackend:
                 np.pad(rep_in[r0:r1], (0, nr_pad - nr)),
                 rep_offsets,
                 rep_edges,
-                np.pad(mkey, (0, n_pad - n), constant_values=sent),
-                np.pad(inten[p0:p1], (0, n_pad - n)),
                 np.pad(
-                    gmem, (0, n_pad - n),
-                    constant_values=np.int32(rows_cap * mcap),
+                    cbin[p0:p1].astype(np.int32), (0, n_pad - n),
+                    constant_values=sent,
                 ),
+                np.pad(inten[p0:p1], (0, n_pad - n)),
+                spec_offsets,
+                spec_gmem,
                 medges,
                 nm,
                 mcap=mcap,
