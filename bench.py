@@ -8,10 +8,16 @@ line on stdout:
 
     {"metric": ..., "value": N, "unit": "clusters/sec", "vs_baseline": N}
 
-``value`` is the device-backend end-to-end rate (bucketize + f64 quantize +
-H2D + kernel + D2H + unpad); ``vs_baseline`` is the speedup over the numpy
+``value`` is the device-backend end-to-end rate (pack + f64 quantize + H2D +
+kernel + D2H + finalize); ``vs_baseline`` is the speedup over the numpy
 oracle rate.  Runs on whatever JAX platform the environment provides (the
 real TPU chip under the driver; CPU elsewhere).  Diagnostics go to stderr.
+
+``--report FILE`` benches EVERY method (bin_mean / gap_average / medoid /
+pipeline) with the backend's phase timers (pack / dispatch / d2h / finalize,
+plus a synchronous device split) and the numpy oracle timed on the FULL
+cluster set, and writes the per-method JSON report (committed as
+BENCH_METHODS.json).
 """
 
 from __future__ import annotations
@@ -58,15 +64,131 @@ def make_workload(n_clusters: int, seed: int = 42):
     return clusters
 
 
+def _runners(backend, nb):
+    def np_pipeline(cs):
+        reps = nb.run_bin_mean(cs)
+        return [nb.average_cosine(r, c.members) for r, c in zip(reps, cs)]
+
+    def dev_pipeline(cs):
+        reps = backend.run_bin_mean(cs)
+        cos = backend.average_cosines(reps, cs)
+        assert len(reps) == len(cos) == len(cs)
+        return cos
+
+    run_np = {
+        "pipeline": np_pipeline,
+        "bin_mean": nb.run_bin_mean,
+        "gap_average": nb.run_gap_average,
+        "medoid": nb.run_medoid,
+    }
+    run_dev = {
+        "pipeline": dev_pipeline,
+        "bin_mean": backend.run_bin_mean,
+        "gap_average": backend.run_gap_average,
+        "medoid": backend.run_medoid,
+    }
+    return run_np, run_dev
+
+
+METRIC_NAMES = {
+    "pipeline": "consensus+QC pipeline (bin-mean + binned-cosine)",
+    "bin_mean": "consensus spectra/sec (bin-mean)",
+    "gap_average": "consensus spectra/sec (gap-average)",
+    "medoid": "medoid representatives/sec",
+}
+
+
+def bench_method(
+    method: str,
+    clusters,
+    backend,
+    nb,
+    numpy_sample: int,
+    seed: int,
+    steady_runs: int = 3,
+) -> dict:
+    """Bench one method: numpy oracle rate (stratified sample or full set),
+    device warm-up (compile) time, steady-state rate, and the backend's
+    per-phase seconds for the best steady run."""
+    from specpride_tpu.utils.observe import RunStats
+
+    run_np, run_dev = _runners(backend, nb)
+
+    # numpy oracle: stratified random sample (NOT the first-N prefix — the
+    # gamma-skewed workload makes early clusters unrepresentative), full set
+    # when numpy_sample covers it
+    if numpy_sample >= len(clusters):
+        sample = clusters
+    else:
+        pick = np.random.default_rng(seed + 1).choice(
+            len(clusters), size=numpy_sample, replace=False
+        )
+        sample = [clusters[i] for i in pick]
+    t0 = time.perf_counter()
+    run_np[method](sample)
+    np_elapsed = time.perf_counter() - t0
+    numpy_rate = len(sample) / np_elapsed
+    eprint(
+        f"[{method}] numpy oracle: {numpy_rate:.1f} clusters/sec "
+        f"({len(sample)} clusters in {np_elapsed:.2f}s)"
+    )
+
+    # device: first run includes compile; report it separately
+    t0 = time.perf_counter()
+    run_dev[method](clusters)
+    warmup_s = time.perf_counter() - t0
+    eprint(f"[{method}] device warm-up (incl compile): {warmup_s:.1f}s")
+
+    best_rate, best_phases = 0.0, {}
+    for i in range(steady_runs):
+        backend.stats = RunStats()
+        t0 = time.perf_counter()
+        out = run_dev[method](clusters)
+        elapsed = time.perf_counter() - t0
+        rate = len(clusters) / elapsed
+        eprint(
+            f"[{method}] device steady-state run {i}: {rate:.1f} clusters/sec "
+            f"phases={ {k: round(v, 3) for k, v in backend.stats.phases.items()} }"
+        )
+        assert len(out) == len(clusters)
+        if rate > best_rate:
+            best_rate = rate
+            best_phases = {
+                k: round(v, 4) for k, v in backend.stats.phases.items()
+            }
+
+    return {
+        "method": method,
+        "metric": METRIC_NAMES[method],
+        "numpy_clusters_per_sec": round(numpy_rate, 2),
+        "numpy_sample_clusters": len(sample),
+        "device_clusters_per_sec": round(best_rate, 2),
+        "device_warmup_s": round(warmup_s, 2),
+        "device_phases_s": best_phases,
+        "speedup_vs_numpy": round(best_rate / numpy_rate, 3),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-clusters", type=int, default=2000)
-    ap.add_argument("--numpy-sample", type=int, default=100,
-                    help="clusters timed on the numpy oracle (rate-based)")
+    ap.add_argument("--numpy-sample", type=int, default=200,
+                    help="clusters timed on the numpy oracle (stratified "
+                    "random sample; >= n-clusters means the full set)")
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument(
         "--method", default="pipeline",
         choices=["pipeline", "bin_mean", "gap_average", "medoid"],
+    )
+    ap.add_argument(
+        "--report", metavar="FILE", default=None,
+        help="bench ALL methods with phase breakdown + full-set numpy "
+        "baselines and write the JSON report here (BENCH_METHODS.json)",
+    )
+    ap.add_argument(
+        "--sync-timing", action="store_true",
+        help="block after dispatch so the 'device' (H2D+kernel) and 'd2h' "
+        "(pure transfer) phases time apart",
     )
     args = ap.parse_args()
 
@@ -88,65 +210,47 @@ def main() -> None:
     # large batches: on tunneled hosts every extra dispatch costs a full
     # round-trip, so amortize over as many clusters as memory allows
     backend = TpuBackend(
-        batch_config=BatchConfig(clusters_per_batch=4096)
+        batch_config=BatchConfig(clusters_per_batch=4096),
+        sync_timing=args.sync_timing,
     )
-    def np_pipeline(cs):
-        reps = nb.run_bin_mean(cs)
-        return [nb.average_cosine(r, c.members) for r, c in zip(reps, cs)]
 
-    def dev_pipeline(cs):
-        reps = backend.run_bin_mean(cs)
-        cos = backend.average_cosines(reps, cs)
-        assert len(reps) == len(cos) == len(cs)
-        return cos
+    if args.report:
+        report = {
+            "workload": {
+                "n_clusters": len(clusters),
+                "n_spectra": n_spectra,
+                "seed": args.seed,
+            },
+            "jax_devices": [str(d) for d in jax.devices()],
+            "methods": [],
+        }
+        for method in ("bin_mean", "gap_average", "medoid", "pipeline"):
+            report["methods"].append(
+                bench_method(
+                    method, clusters, backend, nb,
+                    numpy_sample=len(clusters), seed=args.seed,
+                )
+            )
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        eprint(f"wrote {args.report}")
+        head = next(
+            r for r in report["methods"] if r["method"] == "pipeline"
+        )
+    else:
+        head = bench_method(
+            args.method, clusters, backend, nb,
+            numpy_sample=args.numpy_sample, seed=args.seed,
+        )
 
-    run_np = {
-        "pipeline": np_pipeline,
-        "bin_mean": nb.run_bin_mean,
-        "gap_average": nb.run_gap_average,
-        "medoid": nb.run_medoid,
-    }[args.method]
-    run_dev = {
-        "pipeline": dev_pipeline,
-        "bin_mean": backend.run_bin_mean,
-        "gap_average": backend.run_gap_average,
-        "medoid": backend.run_medoid,
-    }[args.method]
-
-    # numpy oracle rate on a sample
-    sample = clusters[: args.numpy_sample]
-    t0 = time.perf_counter()
-    run_np(sample)
-    numpy_rate = len(sample) / (time.perf_counter() - t0)
-    eprint(f"numpy oracle: {numpy_rate:.1f} clusters/sec")
-
-    # device: first run includes compile; report the steady-state second run
-    t0 = time.perf_counter()
-    run_dev(clusters)
-    eprint(f"device warm-up (incl compile): {time.perf_counter() - t0:.1f}s")
-    best = 0.0
-    for i in range(3):
-        t0 = time.perf_counter()
-        out = run_dev(clusters)
-        rate = len(clusters) / (time.perf_counter() - t0)
-        eprint(f"device steady-state run {i}: {rate:.1f} clusters/sec")
-        best = max(best, rate)
-        assert len(out) == len(clusters)
-    device_rate = best
-
-    metric = {
-        "pipeline": "consensus+QC pipeline (bin-mean + binned-cosine)",
-        "bin_mean": "consensus spectra/sec (bin-mean)",
-        "gap_average": "consensus spectra/sec (gap-average)",
-        "medoid": "medoid representatives/sec",
-    }[args.method]
     print(
         json.dumps(
             {
-                "metric": metric,
-                "value": round(device_rate, 2),
+                "metric": head["metric"],
+                "value": head["device_clusters_per_sec"],
                 "unit": "clusters/sec",
-                "vs_baseline": round(device_rate / numpy_rate, 2),
+                "vs_baseline": head["speedup_vs_numpy"],
             }
         )
     )

@@ -34,6 +34,7 @@ from specpride_tpu.config import (
 from specpride_tpu.data.peaks import Cluster, Spectrum
 from specpride_tpu.ops import quantize
 from specpride_tpu.backends import numpy_backend
+from specpride_tpu.utils.observe import RunStats
 
 
 def _chunk_ranges(b: int, chunk: int):
@@ -102,6 +103,14 @@ class TpuBackend:
     batch_config: BatchConfig = dataclasses.field(default_factory=BatchConfig)
     max_grid_elements: int = 64 * 1024 * 1024
     mesh: object | None = None  # jax.sharding.Mesh
+    # always-on phase timers (pack / dispatch / d2h / finalize; plus
+    # "device" when ``sync_timing``).  One RunStats accumulates across calls;
+    # bench.py reads and resets it per method run.
+    stats: RunStats = dataclasses.field(default_factory=RunStats)
+    # bench-only: block after dispatch so "device" (H2D+kernel) and "d2h"
+    # (pure transfer) time apart.  Off by default — each block is a tunnel
+    # round trip (~0.1 s measured).
+    sync_timing: bool = False
 
     def _dispatch_size(self, chunk: int, b: int) -> int:
         """Dispatch (padded) cluster count: the chunk size rounded up to a
@@ -127,6 +136,35 @@ class TpuBackend:
         from specpride_tpu.parallel.mesh import shard_batch_arrays
 
         return shard_batch_arrays(self.mesh, *arrays)
+
+    def _timed_batches(self, batches):
+        """Iterate pack output under the "pack" phase timer (pack functions
+        may be lists or generators; either way the host work lands here)."""
+        it = iter(batches)
+        while True:
+            with self.stats.phase("pack"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
+    def _collect(self, arrays):
+        """Fetch all pending device results to host.  Every blocking read
+        pays a full tunnel round trip (~0.1 s measured) and the D2H link is
+        the pipeline bottleneck (~25 MB/s vs ~220 MB/s H2D), so ALL copies
+        start asynchronously before the first blocking read — transfers
+        overlap each other and the still-running kernels."""
+        if self.sync_timing:
+            with self.stats.phase("device"):
+                for a in arrays:
+                    if hasattr(a, "block_until_ready"):
+                        a.block_until_ready()
+        with self.stats.phase("d2h"):
+            for a in arrays:
+                if hasattr(a, "copy_to_host_async"):
+                    a.copy_to_host_async()
+            return [np.asarray(a) for a in arrays]
 
     # -- binned-mean consensus (K1) -------------------------------------
 
@@ -154,13 +192,16 @@ class TpuBackend:
 
         out: list[Spectrum | None] = [None] * len(clusters)
         pending = []
-        for batch in pack_bucketize_bin_mean(
-            clusters,
-            config.min_mz,
-            config.max_mz,
-            config.bin_size,
-            config.n_bins,
-            self.batch_config,
+        st = self.stats
+        for batch in self._timed_batches(
+            pack_bucketize_bin_mean(
+                clusters,
+                config.min_mz,
+                config.max_mz,
+                config.bin_size,
+                config.n_bins,
+                self.batch_config,
+            )
         ):
             b, k = batch.mz.shape
             chunk = max(1, self.max_grid_elements // max(k * 4, 1))
@@ -168,26 +209,36 @@ class TpuBackend:
             for lo, hi in _chunk_ranges(b, chunk):
                 # exact total surviving-bin bound for this chunk -> the
                 # compacted D2H buffer carries only real output bytes
-                dist = quantize.distinct_bins_per_row(
-                    batch.bins[lo:hi], config.n_bins
-                )
-                # pow2: cap is a static jit arg — see _pow2
-                cap = _pow2(int(dist.sum()), floor=1024)
-                fused = bin_mean_deduped_compact(
-                    *self._ship(
-                        _pad_axis0(batch.mz[lo:hi], size),
-                        _pad_axis0(batch.intensity[lo:hi], size),
-                        # pad phantom rows with the sentinel so they emit
-                        # no output bins
-                        _pad_axis0(batch.bins[lo:hi], size, fill=config.n_bins),
-                        _pad_axis0(batch.n_members[lo:hi], size),
-                    ),
-                    config=config,
-                    total_cap=cap,
-                )
+                with st.phase("pack"):
+                    dist = quantize.distinct_bins_per_row(
+                        batch.bins[lo:hi], config.n_bins
+                    )
+                    # pow2: cap is a static jit arg — see _pow2
+                    cap = _pow2(int(dist.sum()), floor=1024)
+                with st.phase("dispatch"):
+                    fused = bin_mean_deduped_compact(
+                        *self._ship(
+                            _pad_axis0(batch.mz[lo:hi], size),
+                            _pad_axis0(batch.intensity[lo:hi], size),
+                            # pad phantom rows with the sentinel so they emit
+                            # no output bins
+                            _pad_axis0(
+                                batch.bins[lo:hi], size, fill=config.n_bins
+                            ),
+                            _pad_axis0(batch.n_members[lo:hi], size),
+                        ),
+                        config=config,
+                        total_cap=cap,
+                    )
                 pending.append((batch, lo, hi, cap, fused))
 
-        for batch, lo, hi, cap, fused in pending:
+        fuseds = self._collect([p[-1] for p in pending])
+        with st.phase("finalize"):
+            self._finalize_bin_mean(pending, fuseds, clusters, out)
+        return [s for s in out if s is not None]
+
+    def _finalize_bin_mean(self, pending, fuseds, clusters, out) -> None:
+        for (batch, lo, hi, cap, _), fused in zip(pending, fuseds):
             for ci, r_mz, r_int in _iter_compacted(fused, cap, hi - lo):
                 gi = batch.source_indices[lo + ci]
                 members = clusters[gi].members
@@ -201,7 +252,6 @@ class TpuBackend:
                     precursor_charge=members[0].precursor_charge,
                     title=batch.cluster_ids[lo + ci],
                 )
-        return [s for s in out if s is not None]
 
     def _run_bin_mean_flat(
         self, clusters: list[Cluster], config: BinMeanConfig
@@ -213,44 +263,51 @@ class TpuBackend:
         out: list[Spectrum | None] = [None] * len(clusters)
         pending = []
         sent = np.int32(2**31 - 1)
-        for batch in pack_flat_bin_mean(
-            clusters,
-            config.min_mz,
-            config.max_mz,
-            config.bin_size,
-            config.n_bins,
-            max_elements=self.max_grid_elements // 4,
+        st = self.stats
+        for batch in self._timed_batches(
+            pack_flat_bin_mean(
+                clusters,
+                config.min_mz,
+                config.max_mz,
+                config.bin_size,
+                config.n_bins,
+                max_elements=self.max_grid_elements // 4,
+            )
         ):
             n = batch.gbin.size
             n_pad = _pow2(n, floor=1024)
             rows = len(batch.source_indices)
             b_cap = _pow2(rows, floor=64)
             cap = _pow2(batch.n_distinct_total, floor=1024)
-            fused = bin_mean_flat_compact(
-                np.pad(batch.mz, (0, n_pad - n)),
-                np.pad(batch.intensity, (0, n_pad - n)),
-                np.pad(batch.gbin, (0, n_pad - n), constant_values=sent),
-                np.pad(batch.n_members, (0, b_cap - rows)),
-                config=config,
-                total_cap=cap,
-                b_cap=b_cap,
-            )
+            with st.phase("dispatch"):
+                fused = bin_mean_flat_compact(
+                    np.pad(batch.mz, (0, n_pad - n)),
+                    np.pad(batch.intensity, (0, n_pad - n)),
+                    np.pad(batch.gbin, (0, n_pad - n), constant_values=sent),
+                    np.pad(batch.n_members, (0, b_cap - rows)),
+                    config=config,
+                    total_cap=cap,
+                    b_cap=b_cap,
+                )
             pending.append((batch, rows, cap, fused))
 
-        for batch, rows, cap, fused in pending:
-            for ci, r_mz, r_int in _iter_compacted(fused, cap, rows):
-                gi = batch.source_indices[ci]
-                members = clusters[gi].members
-                out[gi] = Spectrum(
-                    mz=r_mz,
-                    intensity=r_int,
-                    # exact f64 mean, as the oracle (ref src/binning.py:224)
-                    precursor_mz=float(
-                        np.mean([s.precursor_mz for s in members])
-                    ),
-                    precursor_charge=members[0].precursor_charge,
-                    title=batch.cluster_ids[ci],
-                )
+        fuseds = self._collect([p[-1] for p in pending])
+        with st.phase("finalize"):
+            for (batch, rows, cap, _), fused in zip(pending, fuseds):
+                for ci, r_mz, r_int in _iter_compacted(fused, cap, rows):
+                    gi = batch.source_indices[ci]
+                    members = clusters[gi].members
+                    out[gi] = Spectrum(
+                        mz=r_mz,
+                        intensity=r_int,
+                        # exact f64 mean, as the oracle (ref
+                        # src/binning.py:224)
+                        precursor_mz=float(
+                            np.mean([s.precursor_mz for s in members])
+                        ),
+                        precursor_charge=members[0].precursor_charge,
+                        title=batch.cluster_ids[ci],
+                    )
         return [s for s in out if s is not None]
 
     # -- gap-average consensus (K3) -------------------------------------
@@ -275,7 +332,10 @@ class TpuBackend:
 
         out: list[Spectrum | None] = [None] * len(clusters)
         pending = []
-        for batch in pack_bucketize_gap(clusters, config, self.batch_config):
+        st = self.stats
+        for batch in self._timed_batches(
+            pack_bucketize_gap(clusters, config, self.batch_config)
+        ):
             b, k = batch.mz.shape
             chunk = max(1, self.max_grid_elements // max(k * 4, 1))
             size = self._dispatch_size(chunk, b)
@@ -284,33 +344,36 @@ class TpuBackend:
                 # compacted D2H buffer carries only real output bytes
                 # pow2: cap is a static jit arg — see _pow2
                 cap = _pow2(int(batch.n_groups[lo:hi].sum()), floor=1024)
-                fused = gap_average_compact(
-                    *self._ship(
-                        _pad_axis0(batch.mz[lo:hi], size),
-                        _pad_axis0(batch.intensity[lo:hi], size),
-                        _pad_axis0(batch.seg[lo:hi], size),
-                        _pad_axis0(batch.n_valid[lo:hi], size),
-                        _pad_axis0(batch.quorum[lo:hi], size),
-                        _pad_axis0(batch.n_members[lo:hi], size),
-                    ),
-                    config=config,
-                    total_cap=cap,
-                )
+                with st.phase("dispatch"):
+                    fused = gap_average_compact(
+                        *self._ship(
+                            _pad_axis0(batch.mz[lo:hi], size),
+                            _pad_axis0(batch.intensity[lo:hi], size),
+                            _pad_axis0(batch.seg[lo:hi], size),
+                            _pad_axis0(batch.n_valid[lo:hi], size),
+                            _pad_axis0(batch.quorum[lo:hi], size),
+                            _pad_axis0(batch.n_members[lo:hi], size),
+                        ),
+                        config=config,
+                        total_cap=cap,
+                    )
                 pending.append((batch, lo, hi, cap, fused))
 
-        for batch, lo, hi, cap, fused in pending:
-            for ci, r_mz, r_int in _iter_compacted(fused, cap, hi - lo):
-                gi = batch.source_indices[lo + ci]
-                members = clusters[gi].members
-                pep_mz, pep_z = get_pepmass(members)
-                out[gi] = Spectrum(
-                    mz=r_mz,
-                    intensity=r_int,
-                    precursor_mz=pep_mz,
-                    precursor_charge=pep_z,
-                    rt=get_rt(members),
-                    title=batch.cluster_ids[lo + ci],
-                )
+        fuseds = self._collect([p[-1] for p in pending])
+        with st.phase("finalize"):
+            for (batch, lo, hi, cap, _), fused in zip(pending, fuseds):
+                for ci, r_mz, r_int in _iter_compacted(fused, cap, hi - lo):
+                    gi = batch.source_indices[lo + ci]
+                    members = clusters[gi].members
+                    pep_mz, pep_z = get_pepmass(members)
+                    out[gi] = Spectrum(
+                        mz=r_mz,
+                        intensity=r_int,
+                        precursor_mz=pep_mz,
+                        precursor_charge=pep_z,
+                        rt=get_rt(members),
+                        title=batch.cluster_ids[lo + ci],
+                    )
         return [s for s in out if s is not None]
 
     # -- medoid representative (K2) -------------------------------------
@@ -328,8 +391,9 @@ class TpuBackend:
         _check_no_empty(clusters)
         out: list[int] = [0] * len(clusters)
         pending = []
-        for batch in pack_bucketize(
-            clusters, self.batch_config, bucket_members=True
+        st = self.stats
+        for batch in self._timed_batches(
+            pack_bucketize(clusters, self.batch_config, bucket_members=True)
         ):
             # shared-bin counts travel as uint16 (D2H is the bottleneck)
             if int(batch.n_peaks.max(initial=0)) >= 1 << 16:
@@ -337,44 +401,48 @@ class TpuBackend:
                     "medoid kernel: a member has >= 2**16 peaks; uint16 "
                     "shared-bin counts would overflow"
                 )
-            bins = quantize.medoid_bins_packed(batch, config)
-            b, k = batch.mz.shape
-            m = batch.m
-            # host pre-sort by (bin, member) — the kernel does no device
-            # sort; padding member maps to m, padding bin is the 2**30
-            # sentinel, so padding sorts last either way
-            mm = np.where(batch.member_id >= 0, batch.member_id, m).astype(
-                np.int64
-            )
-            key = bins.astype(np.int64) * (m + 1) + mm
-            order = np.argsort(key, axis=1, kind="stable")
-            sbins = np.take_along_axis(bins, order, axis=1)
-            smm = np.take_along_axis(mm.astype(np.int32), order, axis=1)
+            with st.phase("pack"):
+                bins = quantize.medoid_bins_packed(batch, config)
+                b, k = batch.mz.shape
+                m = batch.m
+                # host pre-sort by (bin, member) — the kernel does no device
+                # sort; padding member maps to m, padding bin is the 2**30
+                # sentinel, so padding sorts last either way
+                mm = np.where(batch.member_id >= 0, batch.member_id, m).astype(
+                    np.int64
+                )
+                key = bins.astype(np.int64) * (m + 1) + mm
+                order = np.argsort(key, axis=1, kind="stable")
+                sbins = np.take_along_axis(bins, order, axis=1)
+                smm = np.take_along_axis(mm.astype(np.int32), order, axis=1)
             # largest device intermediate is the (K*M,) run×member occupancy
             chunk = max(1, self.max_grid_elements // max(k * m, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                res = shared_bins_packed(
-                    *self._ship(
-                        _pad_axis0(sbins[lo:hi], size, fill=2**30),
-                        _pad_axis0(smm[lo:hi], size, fill=m),
-                    ),
-                    m=m,
-                )
+                with st.phase("dispatch"):
+                    res = shared_bins_packed(
+                        *self._ship(
+                            _pad_axis0(sbins[lo:hi], size, fill=2**30),
+                            _pad_axis0(smm[lo:hi], size, fill=m),
+                        ),
+                        m=m,
+                    )
+                    # slice on device first: D2H carries only real rows
+                    res = res[: hi - lo]
                 pending.append((batch, lo, hi, res))
 
-        for batch, lo, hi, res in pending:
-            # slice on device first: D2H carries only real rows (12 MB/s on
-            # tunneled hosts), then widen uint16 counts for the f64 finalize
-            shared = np.asarray(res[: hi - lo]).astype(np.int64)
-            idx = medoid_finalize(
-                shared,
-                batch.n_peaks[lo:hi],
-                batch.member_mask[lo:hi],
-                batch.n_members[lo:hi],
-            )
-            for ci in range(hi - lo):
-                out[batch.source_indices[lo + ci]] = int(idx[ci])
+        shareds = self._collect([p[-1] for p in pending])
+        with st.phase("finalize"):
+            for (batch, lo, hi, _), shared in zip(pending, shareds):
+                # widen uint16 counts for the f64 finalize
+                idx = medoid_finalize(
+                    shared.astype(np.int64),
+                    batch.n_peaks[lo:hi],
+                    batch.member_mask[lo:hi],
+                    batch.n_members[lo:hi],
+                )
+                for ci in range(hi - lo):
+                    out[batch.source_indices[lo + ci]] = int(idx[ci])
         return out
 
     def run_medoid(
@@ -418,76 +486,86 @@ class TpuBackend:
         space = config.mz_space
         out = np.zeros((len(clusters),), dtype=np.float64)
         pending = []
-        for batch in pack_bucketize(clusters, self.batch_config):
+        st = self.stats
+        for batch in self._timed_batches(
+            pack_bucketize(clusters, self.batch_config)
+        ):
             idxs = batch.source_indices
             b, k = batch.mz.shape
             m = batch.m
-            pr_raw = max(
-                max((representatives[i].n_peaks for i in idxs), default=1), 1
-            )
-            pr = _pow2(pr_raw, floor=256)  # shape-stable (one compile per value)
-            rep_mz = np.zeros((b, pr), np.float64)
-            rep_int = np.zeros((b, pr), np.float32)
-            rep_valid = np.zeros((b, pr), bool)
-            mem_edges = np.zeros((b, m), np.int32)
-            for ci, gi in enumerate(idxs):
-                r = representatives[gi]
-                rep_mz[ci, : r.n_peaks] = r.mz
-                rep_int[ci, : r.n_peaks] = r.intensity
-                rep_valid[ci, : r.n_peaks] = True
-                for mi, mem in enumerate(clusters[gi].members):
-                    if mem.n_peaks:
-                        # per-member edge count off the LAST peak
-                        # (ref src/benchmark.py:20, assumes sorted)
-                        mem_edges[ci, mi] = quantize.cosine_edge_count(
-                            mem.mz[-1], space
-                        )
-            rep_bins, rep_edges = quantize.cosine_bins(rep_mz, rep_valid, config)
-            mem_bins, _ = quantize.cosine_bins(
-                batch.mz64, batch.member_id >= 0, config
-            )
+            with st.phase("pack"):
+                pr_raw = max(
+                    max((representatives[i].n_peaks for i in idxs), default=1),
+                    1,
+                )
+                # shape-stable (one compile per value)
+                pr = _pow2(pr_raw, floor=256)
+                rep_mz = np.zeros((b, pr), np.float64)
+                rep_int = np.zeros((b, pr), np.float32)
+                rep_valid = np.zeros((b, pr), bool)
+                mem_edges = np.zeros((b, m), np.int32)
+                for ci, gi in enumerate(idxs):
+                    r = representatives[gi]
+                    rep_mz[ci, : r.n_peaks] = r.mz
+                    rep_int[ci, : r.n_peaks] = r.intensity
+                    rep_valid[ci, : r.n_peaks] = True
+                    for mi, mem in enumerate(clusters[gi].members):
+                        if mem.n_peaks:
+                            # per-member edge count off the LAST peak
+                            # (ref src/benchmark.py:20, assumes sorted)
+                            mem_edges[ci, mi] = quantize.cosine_edge_count(
+                                mem.mz[-1], space
+                            )
+                rep_bins, rep_edges = quantize.cosine_bins(
+                    rep_mz, rep_valid, config
+                )
+                mem_bins, _ = quantize.cosine_bins(
+                    batch.mz64, batch.member_id >= 0, config
+                )
 
-            # host pre-sort (device sorts were the dominant kernel cost):
-            # rep rows by bin; member rows by (member, bin) with padding
-            # mapped to m so it sorts last.  Sentinels (2**30) stay well
-            # below the composite-key bounds.
-            r_order = np.argsort(rep_bins, axis=1, kind="stable")
-            rep_bins = np.take_along_axis(rep_bins, r_order, axis=1)
-            rep_int = np.take_along_axis(rep_int, r_order, axis=1)
-            mm = np.where(batch.member_id >= 0, batch.member_id, m).astype(
-                np.int64
-            )
-            key = mm * (1 << 31) + mem_bins
-            m_order = np.argsort(key, axis=1, kind="stable")
-            mem_bins = np.take_along_axis(mem_bins, m_order, axis=1)
-            mem_int = np.take_along_axis(batch.intensity, m_order, axis=1)
-            mem_mm = np.take_along_axis(
-                mm.astype(np.int32), m_order, axis=1
-            )
+                # host pre-sort (device sorts were the dominant kernel cost):
+                # rep rows by bin; member rows by (member, bin) with padding
+                # mapped to m so it sorts last.  Sentinels (2**30) stay well
+                # below the composite-key bounds.
+                r_order = np.argsort(rep_bins, axis=1, kind="stable")
+                rep_bins = np.take_along_axis(rep_bins, r_order, axis=1)
+                rep_int = np.take_along_axis(rep_int, r_order, axis=1)
+                mm = np.where(batch.member_id >= 0, batch.member_id, m).astype(
+                    np.int64
+                )
+                key = mm * (1 << 31) + mem_bins
+                m_order = np.argsort(key, axis=1, kind="stable")
+                mem_bins = np.take_along_axis(mem_bins, m_order, axis=1)
+                mem_int = np.take_along_axis(batch.intensity, m_order, axis=1)
+                mem_mm = np.take_along_axis(
+                    mm.astype(np.int32), m_order, axis=1
+                )
 
             chunk = max(1, self.max_grid_elements // max((k + pr) * 6, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                mean, _ = cosine_packed(
-                    *self._ship(
-                        _pad_axis0(rep_bins[lo:hi], size, fill=2**30),
-                        _pad_axis0(rep_int[lo:hi], size),
-                        _pad_axis0(rep_edges[lo:hi], size),
-                        _pad_axis0(mem_bins[lo:hi], size, fill=2**30),
-                        _pad_axis0(mem_int[lo:hi], size),
-                        _pad_axis0(mem_mm[lo:hi], size, fill=m),
-                        _pad_axis0(mem_edges[lo:hi], size),
-                        _pad_axis0(batch.member_mask[lo:hi], size),
-                        _pad_axis0(batch.n_members[lo:hi], size),
-                    ),
-                    m=m,
-                )
+                with st.phase("dispatch"):
+                    mean, _ = cosine_packed(
+                        *self._ship(
+                            _pad_axis0(rep_bins[lo:hi], size, fill=2**30),
+                            _pad_axis0(rep_int[lo:hi], size),
+                            _pad_axis0(rep_edges[lo:hi], size),
+                            _pad_axis0(mem_bins[lo:hi], size, fill=2**30),
+                            _pad_axis0(mem_int[lo:hi], size),
+                            _pad_axis0(mem_mm[lo:hi], size, fill=m),
+                            _pad_axis0(mem_edges[lo:hi], size),
+                            _pad_axis0(batch.member_mask[lo:hi], size),
+                            _pad_axis0(batch.n_members[lo:hi], size),
+                        ),
+                        m=m,
+                    )
                 pending.append((idxs, lo, hi, mean))
 
-        for idxs, lo, hi, mean in pending:
-            mean = np.asarray(mean)
-            for ci in range(hi - lo):
-                out[idxs[lo + ci]] = float(mean[ci])
+        means = self._collect([p[-1] for p in pending])
+        with st.phase("finalize"):
+            for (idxs, lo, hi, _), mean in zip(pending, means):
+                for ci in range(hi - lo):
+                    out[idxs[lo + ci]] = float(mean[ci])
         return out
 
     def _average_cosines_flat(
@@ -500,8 +578,13 @@ class TpuBackend:
         member peaks and rep peaks each travel as ONE flat sorted axis with
         int32 (row, bin) composite keys — no bucket padding, no per-cluster
         Python fill loop, one dispatch per ~max_grid_elements peaks."""
+        st = self.stats
+        with st.phase("pack"):
+            prep = self._prep_cosine_flat(representatives, clusters, config)
+        return self._dispatch_cosine_flat(prep, config)
+
+    def _prep_cosine_flat(self, representatives, clusters, config):
         from specpride_tpu.data.packed import _as_table, _grouped_arange
-        from specpride_tpu.ops.similarity import cosine_flat
 
         table = _as_table(clusters)
         idx = table.cluster_order()
@@ -593,6 +676,35 @@ class TpuBackend:
         # rows_cap (pow2) must stay under the composite budget
         max_rows = max(1 << (max_rows_cap.bit_length() - 1), 1)
 
+        return dict(
+            c=c, sorted_code=sorted_code, spec_start=spec_start, cbin=cbin,
+            inten=inten, spec_edges=spec_edges, idx=idx, rep_row=rep_row,
+            rbin=rbin, rep_in=rep_in, rep_offsets_all=rep_offsets_all,
+            rep_edges_all=rep_edges_all, row_peak_offsets=row_peak_offsets,
+            shift=shift, mcap=mcap, max_rows=max_rows,
+        )
+
+    def _dispatch_cosine_flat(self, prep: dict, config) -> np.ndarray:
+        from specpride_tpu.ops.similarity import cosine_flat
+
+        st = self.stats
+        c = prep["c"]
+        sorted_code = prep["sorted_code"]
+        spec_start = prep["spec_start"]
+        cbin = prep["cbin"]
+        inten = prep["inten"]
+        spec_edges = prep["spec_edges"]
+        idx = prep["idx"]
+        rep_row = prep["rep_row"]
+        rbin = prep["rbin"]
+        rep_in = prep["rep_in"]
+        rep_offsets_all = prep["rep_offsets_all"]
+        rep_edges_all = prep["rep_edges_all"]
+        row_peak_offsets = prep["row_peak_offsets"]
+        shift = prep["shift"]
+        mcap = prep["mcap"]
+        max_rows = prep["max_rows"]
+
         sent = np.int32(2**31 - 1)
         out = np.zeros((c,), dtype=np.float64)
         pending = []
@@ -615,65 +727,70 @@ class TpuBackend:
                     1,
                 )
             rows = hi - lo
-            rows_cap = _pow2(rows, floor=min(64, max_rows))
-            p0, p1 = int(row_peak_offsets[lo]), int(row_peak_offsets[hi])
-            n = p1 - p0
-            n_pad = _pow2(n, floor=1024)
-            # spectra of this chunk (sorted_code is non-decreasing over
-            # `order`, so a searchsorted window covers exactly rows [lo, hi))
-            s0 = int(np.searchsorted(sorted_code, lo, side="left"))
-            s1 = int(np.searchsorted(sorted_code, hi, side="left"))
-            # pow2-padded like every other kernel input (shapes key the jit
-            # cache).  Tail entries repeat the final offset / the sentinel:
-            # searchsorted(side="right")-1 + clip in the kernel then maps
-            # padded peaks to the sentinel row and real peaks unchanged.
-            s_pad = _pow2(s1 - s0 + 1, floor=64)
-            spec_offsets = np.full(s_pad, n, dtype=np.int32)
-            spec_offsets[: s1 - s0 + 1] = spec_start[s0 : s1 + 1] - p0
-            spec_gmem = np.full(s_pad, rows_cap * mcap, dtype=np.int32)
-            spec_gmem[: s1 - s0] = (sorted_code[s0:s1] - lo) * mcap + (
-                idx.member_index[s0:s1]
-            )
-            r0 = int(rep_offsets_all[lo])
-            r1 = int(rep_offsets_all[hi])
-            nr = r1 - r0
-            nr_pad = _pow2(nr, floor=256)
-            rkey = ((rep_row[r0:r1] - lo) * np.int64(shift) + rbin[r0:r1]).astype(
-                np.int32
-            )
-            rep_offsets = np.zeros(rows_cap + 1, dtype=np.int32)
-            rep_offsets[: rows + 1] = (
-                rep_offsets_all[lo : hi + 1] - r0
-            ).astype(np.int32)
-            rep_offsets[rows + 1 :] = rep_offsets[rows]
-            rep_edges = np.zeros(rows_cap, dtype=np.int32)
-            rep_edges[:rows] = rep_edges_all[lo:hi]
-            # per-(row, member) edge counts scattered dense
-            medges = np.zeros(rows_cap * mcap, dtype=np.int32)
-            medges[spec_gmem[: s1 - s0]] = spec_edges[s0:s1]
-            nm = np.zeros(rows_cap, dtype=np.int32)
-            nm[:rows] = idx.n_members[lo:hi]
+            with st.phase("pack"):
+                rows_cap = _pow2(rows, floor=min(64, max_rows))
+                p0, p1 = int(row_peak_offsets[lo]), int(row_peak_offsets[hi])
+                n = p1 - p0
+                n_pad = _pow2(n, floor=1024)
+                # spectra of this chunk (sorted_code is non-decreasing over
+                # `order`: a searchsorted window covers exactly rows [lo, hi))
+                s0 = int(np.searchsorted(sorted_code, lo, side="left"))
+                s1 = int(np.searchsorted(sorted_code, hi, side="left"))
+                # pow2-padded like every other kernel input (shapes key the
+                # jit cache).  Tail entries repeat the final offset / the
+                # sentinel: searchsorted(side="right")-1 + clip in the kernel
+                # then maps padded peaks to the sentinel row and real peaks
+                # unchanged.
+                s_pad = _pow2(s1 - s0 + 1, floor=64)
+                spec_offsets = np.full(s_pad, n, dtype=np.int32)
+                spec_offsets[: s1 - s0 + 1] = spec_start[s0 : s1 + 1] - p0
+                spec_gmem = np.full(s_pad, rows_cap * mcap, dtype=np.int32)
+                spec_gmem[: s1 - s0] = (sorted_code[s0:s1] - lo) * mcap + (
+                    idx.member_index[s0:s1]
+                )
+                r0 = int(rep_offsets_all[lo])
+                r1 = int(rep_offsets_all[hi])
+                nr = r1 - r0
+                nr_pad = _pow2(nr, floor=256)
+                rkey = (
+                    (rep_row[r0:r1] - lo) * np.int64(shift) + rbin[r0:r1]
+                ).astype(np.int32)
+                rep_offsets = np.zeros(rows_cap + 1, dtype=np.int32)
+                rep_offsets[: rows + 1] = (
+                    rep_offsets_all[lo : hi + 1] - r0
+                ).astype(np.int32)
+                rep_offsets[rows + 1 :] = rep_offsets[rows]
+                rep_edges = np.zeros(rows_cap, dtype=np.int32)
+                rep_edges[:rows] = rep_edges_all[lo:hi]
+                # per-(row, member) edge counts scattered dense
+                medges = np.zeros(rows_cap * mcap, dtype=np.int32)
+                medges[spec_gmem[: s1 - s0]] = spec_edges[s0:s1]
+                nm = np.zeros(rows_cap, dtype=np.int32)
+                nm[:rows] = idx.n_members[lo:hi]
 
-            mean = cosine_flat(
-                np.pad(rkey, (0, nr_pad - nr), constant_values=sent),
-                np.pad(rep_in[r0:r1], (0, nr_pad - nr)),
-                rep_offsets,
-                rep_edges,
-                np.pad(
-                    cbin[p0:p1].astype(np.int32), (0, n_pad - n),
-                    constant_values=sent,
-                ),
-                np.pad(inten[p0:p1], (0, n_pad - n)),
-                spec_offsets,
-                spec_gmem,
-                medges,
-                nm,
-                mcap=mcap,
-                shift=shift,
-            )
+            with st.phase("dispatch"):
+                mean = cosine_flat(
+                    np.pad(rkey, (0, nr_pad - nr), constant_values=sent),
+                    np.pad(rep_in[r0:r1], (0, nr_pad - nr)),
+                    rep_offsets,
+                    rep_edges,
+                    np.pad(
+                        cbin[p0:p1].astype(np.int32), (0, n_pad - n),
+                        constant_values=sent,
+                    ),
+                    np.pad(inten[p0:p1], (0, n_pad - n)),
+                    spec_offsets,
+                    spec_gmem,
+                    medges,
+                    nm,
+                    mcap=mcap,
+                    shift=shift,
+                )
             pending.append((lo, rows, mean))
             lo = hi
 
-        for lo, rows, mean in pending:
-            out[lo : lo + rows] = np.asarray(mean)[:rows]
+        means = self._collect([p[-1] for p in pending])
+        with st.phase("finalize"):
+            for (lo, rows, _), mean in zip(pending, means):
+                out[lo : lo + rows] = mean[:rows]
         return out
