@@ -1,0 +1,21 @@
+#!/bin/sh
+# Minimal CI for specpride_tpu (survey §5: tests + native sanitizers).
+#
+#   sh scripts/ci.sh          # full: pytest + ASan/TSan parser suites
+#   sh scripts/ci.sh --fast   # pytest only
+#
+# The Python suite pins JAX to a virtual 8-device CPU mesh via
+# tests/conftest.py, so this runs anywhere (no TPU needed).
+set -eu
+cd "$(dirname "$0")/.."
+
+echo "== pytest =="
+python -m pytest tests/ -x -q
+
+if [ "${1:-}" != "--fast" ]; then
+    echo "== native: ASan parser suite =="
+    make -C native asan
+    echo "== native: TSan parser suite =="
+    make -C native tsan
+fi
+echo "CI OK"

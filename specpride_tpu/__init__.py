@@ -17,8 +17,8 @@ Architecture (TPU-first, not a port):
 * ``specpride_tpu.data``     ragged peak model + bucketed padded device batches
 * ``specpride_tpu.io``       host-side MGF / mzML / TSV ingest (C++ fast path)
 * ``specpride_tpu.ops``      JAX/XLA + Pallas device kernels (the compute core)
-* ``specpride_tpu.backends`` numpy oracle and tpu execution backends
-* ``specpride_tpu.methods``  the four merge strategies as a uniform API
+* ``specpride_tpu.backends`` numpy oracle and tpu execution backends (the
+  four merge strategies as a uniform ``run_*`` API on each)
 * ``specpride_tpu.parallel`` device mesh / sharding / multi-host scale-out
 * ``specpride_tpu.metrics``  quality metrics on device
 """

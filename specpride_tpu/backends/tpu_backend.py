@@ -581,7 +581,7 @@ class TpuBackend:
         st = self.stats
         with st.phase("pack"):
             prep = self._prep_cosine_flat(representatives, clusters, config)
-        return self._dispatch_cosine_flat(prep, config)
+        return self._dispatch_cosine_flat(prep)
 
     def _prep_cosine_flat(self, representatives, clusters, config):
         from specpride_tpu.data.packed import _as_table, _grouped_arange
@@ -684,7 +684,7 @@ class TpuBackend:
             shift=shift, mcap=mcap, max_rows=max_rows,
         )
 
-    def _dispatch_cosine_flat(self, prep: dict, config) -> np.ndarray:
+    def _dispatch_cosine_flat(self, prep: dict) -> np.ndarray:
         from specpride_tpu.ops.similarity import cosine_flat
 
         st = self.stats

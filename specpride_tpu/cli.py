@@ -112,6 +112,7 @@ def _checkpointed_run(
     never duplicated (the advisor's r1 duplicate-append window)."""
     done: set[str] = set()
     output_bytes: int | None = None  # None: manifest predates offset tracking
+    restarted = False  # a resume state was found unusable and discarded
     if args.checkpoint and os.path.exists(args.checkpoint):
         with open(args.checkpoint) as fh:
             manifest = json.load(fh)
@@ -128,6 +129,8 @@ def _checkpointed_run(
                 "checkpoint lists %d done clusters but output %s is gone; "
                 "restarting from scratch", len(done), args.output,
             )
+            # no output on disk -> nothing a redo could duplicate, so this
+            # restart is safe even under --append
             done, output_bytes = set(), 0
         elif output_bytes is not None and out_size is not None and (
             out_size < output_bytes
@@ -139,7 +142,7 @@ def _checkpointed_run(
                 "output %s is %d bytes but the manifest recorded %d; "
                 "restarting from scratch", args.output, out_size, output_bytes,
             )
-            done, output_bytes = set(), 0
+            done, output_bytes, restarted = set(), 0, True
         elif output_bytes is not None and out_size is not None and (
             out_size > output_bytes
         ):
@@ -155,6 +158,16 @@ def _checkpointed_run(
     stats.count("clusters_skipped_done", len(clusters) - len(todo))
     first_write = not done if output_bytes is None else output_bytes == 0
     if getattr(args, "append", False):
+        if restarted:
+            # with --append we cannot tell pre-existing user content apart
+            # from this run's partial/corrupt output, so re-appending would
+            # duplicate records (advisor r3): refuse rather than guess
+            raise SystemExit(
+                f"resume state for {args.output} is unusable (see warning "
+                "above) and --append cannot safely redo on top of partial "
+                f"output; remove the stale checkpoint {args.checkpoint} "
+                "(and clean the output) before re-running"
+            )
         # ref average_spectrum_clustering.py:183-184,198: mode 'wa'[append]
         first_write = False
     chunk = args.checkpoint_every if args.checkpoint else len(todo) or 1

@@ -80,9 +80,12 @@ def read_percolator_scores(
     """
     score_cols = ("percolator score", "xcorr score", "score")
     scores: dict[str, float] = {}
+    n_rows = 0
     with open(path, newline="") as fh:
         reader = csv.DictReader(fh, delimiter="\t")
+        header = reader.fieldnames or []
         for row in reader:
+            n_rows += 1
             scan = row.get("scan")
             if scan is None:
                 continue
@@ -96,6 +99,17 @@ def read_percolator_scores(
                 raw = raw.rsplit(".", 1)[0] if "." in raw else raw
             usi = _score_usi(px_accession, raw, scan, raw_suffix)
             _add_score(scores, usi, float(row[col]))
+    if n_rows and not scores:
+        missing = [c for c in ("scan",) if c not in header]
+        if not any(c in header for c in score_cols):
+            missing.append("|".join(score_cols))
+        raise ValueError(
+            f"{path}: {n_rows} rows but none yielded a score — "
+            f"missing column(s): {missing or 'unknown'}; header={header}. "
+            "Expected crux/percolator TSV with a 'scan' column and one of "
+            f"{score_cols} (native percolator 'PSMId' output is not "
+            "supported; re-export via crux percolator)."
+        )
     return scores
 
 

@@ -154,3 +154,23 @@ def test_maxquant(tmp_path):
     assert scores["mzspec:PXD1:run1.raw::scan:11"] == 20.0
     peptides = read_msms_peptides(p)
     assert peptides == {10: "PEPTIDE", 11: "AAAK"}
+
+
+def test_percolator_unrecognized_header_raises(tmp_path):
+    """A well-formed TSV whose headers match no known score column must
+    raise (naming what's missing), not silently return zero scores
+    (advisor r3: select --method best would then score nothing)."""
+    from specpride_tpu.io.maxquant import read_percolator_scores
+
+    p = tmp_path / "native_percolator.tsv"
+    p.write_text(
+        "PSMId\tscore\tq-value\n"  # no 'scan' column (native percolator)
+        .replace("score", "svm_score")  # ...and no known score column
+        + "target_0_100_2\t1.5\t0.01\n"
+    )
+    with pytest.raises(ValueError, match="scan"):
+        read_percolator_scores(p)
+    # an empty file (headers only) is fine — zero PSMs is a valid result
+    empty = tmp_path / "empty.tsv"
+    empty.write_text("file\tscan\tpercolator score\n")
+    assert read_percolator_scores(empty) == {}

@@ -404,6 +404,31 @@ class TestCli:
         ]) == 0
         assert [s.title for s in read_mgf(out)] == ["cluster-0", "cluster-1"]
 
+    def test_corrupt_resume_with_append_refuses(self, tmp_path, rng):
+        """--append + an unusable resume state must refuse rather than
+        re-append on top of partial output (advisor r3: the redo would
+        duplicate records because pre-existing appended content can't be
+        told apart from this run's)."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=20)
+            for i in range(2)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out = tmp_path / "out.mgf"
+        out.write_text("BEGIN IONS\n")  # truncated remnant
+        ckpt = tmp_path / "ckpt.json"
+        ckpt.write_text(json.dumps(
+            {"done": ["cluster-0"], "output_bytes": 10_000}
+        ))
+        with pytest.raises(SystemExit, match="append"):
+            cli_main([
+                "consensus", str(clustered), str(out), "--append",
+                "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+            ])
+        # the corrupt remnant was not appended to
+        assert out.read_text() == "BEGIN IONS\n"
+
     def test_checkpoint_output_deleted_restarts(self, tmp_path, rng):
         clusters = [
             make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=20)
