@@ -1,0 +1,44 @@
+#!/bin/sh
+# Fetch the PXD004732 benchmark inputs into data/ (the reference's
+# install.sh:5-10 dataset step, done right: resumable, checksummed when a
+# .sha256 manifest is present, and with curl told to actually save files —
+# the reference's bare `curl <url>` writes the payload to stdout).
+#
+#   sh scripts/fetch_data.sh [DEST_DIR]     # default: ./data
+#
+# Needs network access to ftp.pride.ebi.ac.uk (EBI PRIDE archive).
+set -eu
+
+DEST="${1:-data}"
+BASE="ftp://ftp.pride.ebi.ac.uk/pride/data/proteogenomics/projects/eubic-2020"
+FILES="01650b_BA5-TUM_first_pool_75_01_01-3xHCD-1h-R2.mzML msms.txt peptides.txt"
+
+mkdir -p "$DEST"
+for f in $FILES; do
+    echo "fetching $f ..."
+    # Always run curl: -C - resumes a partial file and is a cheap no-op
+    # when the file is already complete (a size-only "skip if non-empty"
+    # guard would treat an interrupted download as done and pin its
+    # truncated checksum below).  rc 33 = server refused the resume range,
+    # which also happens when the file is already complete.
+    curl --fail -C - -o "$DEST/$f" "$BASE/$f" || {
+        rc=$?
+        [ "$rc" -eq 33 ] && echo "  (server refused resume; file assumed complete)" || exit "$rc"
+    }
+done
+
+# Integrity: verify against a committed manifest when present, else record
+# one so later fetches on other machines can be checked against it.
+MANIFEST="$DEST/SHA256SUMS"
+if [ -f "$MANIFEST" ]; then
+    (cd "$DEST" && sha256sum -c SHA256SUMS)
+else
+    (cd "$DEST" && sha256sum $FILES > SHA256SUMS)
+    echo "recorded $MANIFEST — commit it to pin the dataset"
+fi
+
+cat <<EOF
+done. next steps (docs/datasets.md):
+  specpride convert $DEST/01650b_BA5-TUM_first_pool_75_01_01-3xHCD-1h-R2.mzML clustered.mgf \\
+      --msms $DEST/msms.txt --clusters MaRaCluster.clusters_p30.tsv
+EOF

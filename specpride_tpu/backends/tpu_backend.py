@@ -81,6 +81,16 @@ def _iter_compacted(fused, cap: int, n_rows: int):
         )
 
 
+def _max_run_len(sorted_keys: np.ndarray) -> int:
+    """Longest run of equal consecutive values (keys pre-sorted)."""
+    if sorted_keys.size == 0:
+        return 1
+    bounds = np.flatnonzero(
+        np.concatenate(([True], sorted_keys[1:] != sorted_keys[:-1], [True]))
+    )
+    return int(np.diff(bounds).max(initial=1))
+
+
 def _pad_axis0(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
     if arr.shape[0] == size:
         return arr
@@ -264,8 +274,10 @@ class TpuBackend:
         pending = []
         sent = np.int32(2**31 - 1)
         st = self.stats
-        for batch in self._timed_batches(
-            pack_flat_bin_mean(
+        # the pack call is eager (one vectorized pass over all clusters), so
+        # time the call itself, not just iteration
+        with st.phase("pack"):
+            batches = pack_flat_bin_mean(
                 clusters,
                 config.min_mz,
                 config.max_mz,
@@ -273,21 +285,34 @@ class TpuBackend:
                 config.n_bins,
                 max_elements=self.max_grid_elements // 4,
             )
-        ):
+        for batch in batches:
             n = batch.gbin.size
             n_pad = _pow2(n, floor=1024)
             rows = len(batch.source_indices)
             b_cap = _pow2(rows, floor=64)
             cap = _pow2(batch.n_distinct_total, floor=1024)
+            with st.phase("pack"):
+                rcap = _pow2(batch.n_distinct_total + 1, floor=1024)
+                # dedup bounds every (row, bin) run at the row's member count
+                lcap = _pow2(int(batch.n_members.max(initial=1)))
+                n_runs = batch.n_distinct_total + (1 if n_pad > n else 0)
+                # padded rows own zero runs: repeat the final extent
+                run_offsets = np.full(b_cap + 1, batch.run_offsets[-1],
+                                      dtype=np.int32)
+                run_offsets[: rows + 1] = batch.run_offsets
             with st.phase("dispatch"):
                 fused = bin_mean_flat_compact(
                     np.pad(batch.mz, (0, n_pad - n)),
                     np.pad(batch.intensity, (0, n_pad - n)),
                     np.pad(batch.gbin, (0, n_pad - n), constant_values=sent),
                     np.pad(batch.n_members, (0, b_cap - rows)),
+                    run_offsets,
+                    np.array([n_runs], dtype=np.int32),
                     config=config,
                     total_cap=cap,
                     b_cap=b_cap,
+                    rcap=rcap,
+                    lcap=lcap,
                 )
             pending.append((batch, rows, cap, fused))
 
@@ -619,10 +644,22 @@ class TpuBackend:
         # per-spectrum peak extents: the lexsort keeps each spectrum's peaks
         # contiguous in (row, member) order — exactly the `order` sequence —
         # so cumsum(cnt) gives every spectrum's [start, end) in the permuted
-        # flat arrays.  The kernel derives per-peak (row, member) from these
-        # tiny tables on device (shipping it per peak costs 4 B/peak of H2D).
+        # flat arrays.  The kernel derives per-peak (row, spectrum) from
+        # these tiny tables on device (shipping per peak costs 4 B/peak).
         spec_start = np.zeros(order.size + 1, dtype=np.int64)
         np.cumsum(cnt, out=spec_start[1:])
+
+        # scan-window caps for the kernel's segmented scans (ops.segments):
+        # the longest same-(spectrum, bin) duplicate run and the largest
+        # spectrum, computed from the sorted pass (floors bound the number
+        # of distinct compile classes)
+        spec_of_peak_sorted = np.repeat(
+            np.arange(order.size, dtype=np.int64), cnt
+        )
+        l_mem = _pow2(
+            int(_max_run_len(spec_of_peak_sorted * (1 << 31) + cbin)), floor=4
+        )
+        l_spec = _pow2(int(cnt.max(initial=1)), floor=256)
 
         # --- rep flat arrays, sorted by (row, bin)
         rep_counts = np.array(
@@ -670,18 +707,49 @@ class TpuBackend:
                 int(np.max(rep_edges_all, initial=0)),
             )
         )
-        shift = _pow2(max_bin + 2, floor=1 << 20)
-        mcap = _pow2(int(idx.max_members))
+        # shift floor kept low: every doubling of shift halves the rows one
+        # dispatch can carry, and each dispatch pays ~0.1 s of tunnel
+        # round-trip on remote-device hosts
+        shift = _pow2(max_bin + 2, floor=1 << 16)
         max_rows_cap = max((2**31 - 2) // shift, 1)
         # rows_cap (pow2) must stay under the composite budget
         max_rows = max(1 << (max_rows_cap.bit_length() - 1), 1)
 
+        # scan caps for the rep side: duplicate-bin runs within one rep and
+        # the largest rep (rows are contiguous in (row, bin) order)
+        l_rep = _pow2(
+            int(_max_run_len(rep_row * np.int64(1 << 31) + rbin)), floor=4
+        )
+        l_row = _pow2(int(rep_counts.max(initial=1)), floor=256)
+
+        # host-side edge gating: the pair cutoff (max of rep/member edge
+        # counts - 2, ref src/benchmark.py:20-22) zeroes failing peaks IN
+        # the shipped intensity, so the kernel needs no per-element gather
+        # from per-spectrum tables (XLA lowers those to one-hot matmuls —
+        # a measured 84 GB of HBM traffic per chunk)
+        cut_spec_all = (
+            np.maximum(rep_edges_all[sorted_code], spec_edges) - 2
+        )  # (S,)
+        cut_at = (
+            cut_spec_all[spec_of_peak_sorted]
+            if spec_of_peak_sorted.size
+            else np.zeros(0, np.int64)
+        )
+        inten_gated = np.where(cbin <= cut_at, inten, 0.0).astype(np.float32)
+
         return dict(
             c=c, sorted_code=sorted_code, spec_start=spec_start, cbin=cbin,
-            inten=inten, spec_edges=spec_edges, idx=idx, rep_row=rep_row,
+            inten_gated=inten_gated, idx=idx, rep_row=rep_row,
             rbin=rbin, rep_in=rep_in, rep_offsets_all=rep_offsets_all,
-            rep_edges_all=rep_edges_all, row_peak_offsets=row_peak_offsets,
-            shift=shift, mcap=mcap, max_rows=max_rows,
+            row_peak_offsets=row_peak_offsets,
+            # row/spectrum of each peak in the permuted flat order (the
+            # lexsort is stable within already-(row, member)-grouped
+            # arrays, so the pre-perm grouping survives)
+            row_elem=row_pk, spec_elem=spec_of_peak_sorted,
+            cut_spec_all=cut_spec_all,
+            shift=shift, max_rows=max_rows,
+            l_rep=l_rep, l_row=l_row, l_spec=l_spec, l_mem=l_mem,
+            l_members=_pow2(int(idx.max_members), floor=32),
         )
 
     def _dispatch_cosine_flat(self, prep: dict) -> np.ndarray:
@@ -692,17 +760,17 @@ class TpuBackend:
         sorted_code = prep["sorted_code"]
         spec_start = prep["spec_start"]
         cbin = prep["cbin"]
-        inten = prep["inten"]
-        spec_edges = prep["spec_edges"]
+        inten_gated = prep["inten_gated"]
         idx = prep["idx"]
         rep_row = prep["rep_row"]
         rbin = prep["rbin"]
         rep_in = prep["rep_in"]
         rep_offsets_all = prep["rep_offsets_all"]
-        rep_edges_all = prep["rep_edges_all"]
         row_peak_offsets = prep["row_peak_offsets"]
+        row_elem = prep["row_elem"]
+        spec_elem_all = prep["spec_elem"]
+        cut_spec_all = prep["cut_spec_all"]
         shift = prep["shift"]
-        mcap = prep["mcap"]
         max_rows = prep["max_rows"]
 
         sent = np.int32(2**31 - 1)
@@ -736,23 +804,29 @@ class TpuBackend:
                 # `order`: a searchsorted window covers exactly rows [lo, hi))
                 s0 = int(np.searchsorted(sorted_code, lo, side="left"))
                 s1 = int(np.searchsorted(sorted_code, hi, side="left"))
+                s_real = s1 - s0
                 # pow2-padded like every other kernel input (shapes key the
-                # jit cache).  Tail entries repeat the final offset / the
-                # sentinel: searchsorted(side="right")-1 + clip in the kernel
-                # then maps padded peaks to the sentinel row and real peaks
-                # unchanged.
-                s_pad = _pow2(s1 - s0 + 1, floor=64)
-                spec_offsets = np.full(s_pad, n, dtype=np.int32)
-                spec_offsets[: s1 - s0 + 1] = spec_start[s0 : s1 + 1] - p0
-                spec_gmem = np.full(s_pad, rows_cap * mcap, dtype=np.int32)
-                spec_gmem[: s1 - s0] = (sorted_code[s0:s1] - lo) * mcap + (
-                    idx.member_index[s0:s1]
-                )
+                # jit cache); the +1 guarantees at least one fill slot, which
+                # absorbs the padded peak tail as a zero-contribution
+                # spectrum mapped to the last row
+                s_pad = _pow2(s_real + 1, floor=64)
+                spec_offsets = np.full(s_pad + 1, n_pad, dtype=np.int32)
+                spec_offsets[: s_real + 1] = spec_start[s0 : s1 + 1] - p0
+                spec_row = np.full(s_pad, rows_cap - 1, dtype=np.int32)
+                spec_row[:s_real] = (sorted_code[s0:s1] - lo).astype(np.int32)
+                # spectrum extents per row (rows are contiguous in the
+                # spectrum axis); fill rows own empty extents
+                row_spec_offsets = np.full(rows_cap + 1, s_real,
+                                           dtype=np.int32)
+                row_spec_offsets[: rows + 1] = (
+                    np.searchsorted(sorted_code, np.arange(lo, hi + 1)) - s0
+                ).astype(np.int32)
                 r0 = int(rep_offsets_all[lo])
                 r1 = int(rep_offsets_all[hi])
                 nr = r1 - r0
                 nr_pad = _pow2(nr, floor=256)
-                rkey = (
+                rkey = np.full(nr_pad, sent, dtype=np.int32)
+                rkey[:nr] = (
                     (rep_row[r0:r1] - lo) * np.int64(shift) + rbin[r0:r1]
                 ).astype(np.int32)
                 rep_offsets = np.zeros(rows_cap + 1, dtype=np.int32)
@@ -760,31 +834,49 @@ class TpuBackend:
                     rep_offsets_all[lo : hi + 1] - r0
                 ).astype(np.int32)
                 rep_offsets[rows + 1 :] = rep_offsets[rows]
-                rep_edges = np.zeros(rows_cap, dtype=np.int32)
-                rep_edges[:rows] = rep_edges_all[lo:hi]
-                # per-(row, member) edge counts scattered dense
-                medges = np.zeros(rows_cap * mcap, dtype=np.int32)
-                medges[spec_gmem[: s1 - s0]] = spec_edges[s0:s1]
                 nm = np.zeros(rows_cap, dtype=np.int32)
                 nm[:rows] = idx.n_members[lo:hi]
+                # per-peak channels, host-gated and host-composited
+                mkey = np.full(n_pad, sent, dtype=np.int32)
+                mkey[:n] = (
+                    (row_elem[p0:p1] - lo) * np.int64(shift) + cbin[p0:p1]
+                ).astype(np.int32)
+                mint = np.zeros(n_pad, dtype=np.float32)
+                mint[:n] = inten_gated[p0:p1]
+                spec_elem = np.full(n_pad, s_real, dtype=np.int32)
+                spec_elem[:n] = (spec_elem_all[p0:p1] - s0).astype(np.int32)
+                # rep lookup: last element of the matching rep run
+                pos = (
+                    np.searchsorted(rkey, mkey, side="right") - 1
+                ).astype(np.int32)
+                # rep-norm cutoff position per spectrum
+                npos = np.zeros(s_pad, dtype=np.int32)
+                npos[:s_real] = np.searchsorted(
+                    rkey,
+                    (sorted_code[s0:s1] - lo) * np.int64(shift)
+                    + cut_spec_all[s0:s1] + 1,
+                ).astype(np.int32)
 
             with st.phase("dispatch"):
                 mean = cosine_flat(
-                    np.pad(rkey, (0, nr_pad - nr), constant_values=sent),
+                    rkey,
                     np.pad(rep_in[r0:r1], (0, nr_pad - nr)),
-                    rep_offsets,
-                    rep_edges,
-                    np.pad(
-                        cbin[p0:p1].astype(np.int32), (0, n_pad - n),
-                        constant_values=sent,
-                    ),
-                    np.pad(inten[p0:p1], (0, n_pad - n)),
+                    mkey,
+                    mint,
+                    spec_elem,
+                    pos,
                     spec_offsets,
-                    spec_gmem,
-                    medges,
+                    spec_row,
+                    npos,
+                    rep_offsets,
+                    row_spec_offsets,
                     nm,
-                    mcap=mcap,
                     shift=shift,
+                    l_rep=prep["l_rep"],
+                    l_row=prep["l_row"],
+                    l_spec=prep["l_spec"],
+                    l_mem=prep["l_mem"],
+                    l_members=prep["l_members"],
                 )
             pending.append((lo, rows, mean))
             lo = hi

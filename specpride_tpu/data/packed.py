@@ -471,6 +471,7 @@ class FlatBinBatch:
     gbin: np.ndarray  # (N,) i32 composite, sentinel = 2**31 - 1
     n_members: np.ndarray  # (rows,) i32
     n_distinct_total: int  # exact surviving-bin bound for this chunk
+    run_offsets: np.ndarray  # (rows + 1,) i64 per-row distinct-bin extents
     cluster_ids: list[str]
     source_indices: list[int]
 
@@ -539,13 +540,16 @@ def pack_flat_bin_mean(
         gbin = (
             (s_row[p0:p1] - lo) * np.int64(n_bins + 1) + s_bin[p0:p1]
         ).astype(np.int32)
+        run_offsets = np.zeros(hi - lo + 1, dtype=np.int64)
+        np.cumsum(distinct_per_row[lo:hi], out=run_offsets[1:])
         batches.append(
             FlatBinBatch(
                 mz=s_mz[p0:p1],
                 intensity=s_int[p0:p1],
                 gbin=gbin,
                 n_members=idx.n_members[lo:hi].astype(np.int32),
-                n_distinct_total=int(distinct_per_row[lo:hi].sum()),
+                n_distinct_total=int(run_offsets[-1]),
+                run_offsets=run_offsets,
                 cluster_ids=[table.cluster_names[i] for i in range(lo, hi)],
                 source_indices=list(range(lo, hi)),
             )

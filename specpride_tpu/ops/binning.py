@@ -69,68 +69,68 @@ def _bin_mean_deduped_stats(
     return mz_sum / safe, inten_sum / safe, keep_bin
 
 
-@functools.partial(jax.jit, static_argnames=("config", "total_cap", "b_cap"))
+@functools.partial(
+    jax.jit, static_argnames=("config", "total_cap", "b_cap", "rcap", "lcap")
+)
 def bin_mean_flat_compact(
     mz: jax.Array,  # (N,) f32, sorted by (row, bin); tail padding
     intensity: jax.Array,  # (N,) f32, same order
     gbin: jax.Array,  # (N,) i32 row*(n_bins+1)+bin, sentinel 2**31-1
     n_members: jax.Array,  # (b_cap,) i32, 0 past the real rows
+    run_offsets: jax.Array,  # (b_cap + 1,) i32 per-row run extents (host)
+    n_runs: jax.Array,  # (1,) i32 total runs incl. any sentinel tail run
     config: BinMeanConfig,
     total_cap: int,
     b_cap: int,
+    rcap: int,  # pow2 >= n_runs
+    lcap: int,  # pow2 >= longest real run (<= max n_members after dedup)
 ):
     """Flat zero-padding variant of ``bin_mean_deduped_compact`` (see
     ``data.packed.FlatBinBatch``): one fused 1-D output
     ``[flat_mz (total_cap) | flat_intensity (total_cap) | n_out (b_cap)]``.
 
     The (row, bin) composite ``gbin`` makes runs globally unique, so one
-    segment pass over the whole flat batch handles every cluster at once —
-    no vmap, no per-row padding, and the sentinel tail contributes
-    nothing."""
-    n = gbin.shape[0]
-    nb1 = jnp.int32(config.n_bins + 1)
+    scatter-free run pass (``ops.segments``; dedup bounds every real run at
+    the cluster's member count, so ``lcap`` stays tiny) handles every
+    cluster at once — no vmap, no per-row padding, and no per-row scatter
+    for the output counts either: the host already knows each row's run
+    extents (``run_offsets``), so per-row surviving-bin counts are an int
+    prefix-sum differenced at those offsets."""
+    from specpride_tpu.ops import segments as sg
+
     sent = jnp.int32(2**31 - 1)
-    valid = gbin < sent
-
-    new_run = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), (gbin[1:] != gbin[:-1]).astype(jnp.int32)]
-    )
-    seg = jnp.cumsum(new_run)
+    nb1 = jnp.int32(config.n_bins + 1)
+    valid = gbin != sent
     w = jnp.where(valid, 1.0, 0.0)
-    counts = jax.ops.segment_sum(w, seg, num_segments=n, indices_are_sorted=True)
-    inten_sum = jax.ops.segment_sum(
-        intensity * w, seg, num_segments=n, indices_are_sorted=True
-    )
-    mz_sum = jax.ops.segment_sum(
-        mz * w, seg, num_segments=n, indices_are_sorted=True
-    )
 
-    # row of each segment (empty segments -> -1 via the sentinel input)
-    row_of_elem = jnp.where(valid, gbin // nb1, -1)
-    row_of_seg = jax.ops.segment_max(
-        row_of_elem, seg, num_segments=n, indices_are_sorted=True
+    starts = sg.run_starts(gbin)
+    (counts, mz_sum, inten_sum), endpos = sg.run_sums(
+        starts, (w, mz * w, intensity * w), rcap, lcap
     )
-    real_seg = row_of_seg >= 0
+    rkey = gbin[endpos]
+    genuine = (jnp.arange(rcap, dtype=jnp.int32) < n_runs[0]) & (rkey != sent)
+    row_of_run = jnp.where(genuine, rkey // nb1, b_cap - 1)
 
     if config.apply_peak_quorum:
-        nm = n_members[jnp.clip(row_of_seg, 0, b_cap - 1)].astype(jnp.float32)
+        nm = n_members[jnp.clip(row_of_run, 0, b_cap - 1)].astype(jnp.float32)
         quorum = jnp.floor(nm * config.quorum_fraction) + 1.0
     else:
         quorum = jnp.float32(1.0)
-    keep = real_seg & (counts >= quorum)
+    keep = genuine & (counts >= quorum)
 
     safe = jnp.maximum(counts, 1.0)
     mz_mean = mz_sum / safe
     inten_mean = inten_sum / safe
 
-    n_out = jax.ops.segment_sum(
-        jnp.where(keep, 1.0, 0.0),
-        jnp.where(keep, row_of_seg, b_cap),
-        num_segments=b_cap + 1,
-    )[:b_cap]
+    # per-row surviving counts: int prefix over runs, diffed at the host's
+    # per-row run extents (exact, no scatter)
+    cs0 = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(keep.astype(jnp.int32))]
+    )
+    n_out = (cs0[run_offsets[1:]] - cs0[run_offsets[:-1]]).astype(jnp.float32)
 
-    (idx,) = jnp.nonzero(keep, size=total_cap, fill_value=n)
-    ok = idx < n
+    (idx,) = jnp.nonzero(keep, size=total_cap, fill_value=rcap)
+    ok = idx < rcap
     flat_mz = jnp.where(
         ok, mz_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0
     )

@@ -1,0 +1,118 @@
+"""Scatter-free sorted-run reductions (the shared core of every device kernel).
+
+Motivation (measured on a v5 lite chip, 4M elements): XLA lowers
+``jax.ops.segment_sum`` to a scatter-add, and TPU scatter-adds with
+duplicate indices serialize — ~140 ms per call, which made every kernel
+scatter-bound (the round-3 bench: bin-mean 1.4x, cosine pipeline 2.9x over
+a single-threaded numpy oracle).  Two classic fixes also lose on this
+hardware: ``lax.associative_scan``'s log-depth slice/concat program over 4M
+elements did not finish compiling in 10 minutes, and diff-of-global-cumsum
+costs ~3 decimal digits of f32 precision at realistic intensity scales
+(the prefix magnitude dwarfs small run totals).
+
+The structure of our data gives a cheaper exact formulation.  Every kernel
+reduces RUNS of equal keys in PRE-SORTED flat arrays (the host lexsorts at
+pack time), and a run is never longer than one cluster's member count
+(bin-mean dedup leaves <= n_members peaks per (cluster, bin); cosine runs
+are per-(spectrum, bin) duplicates).  With ``lcap`` a static power of two
+>= the longest REAL run (the packer knows it exactly), a flat segmented
+Hillis-Steele scan needs only log2(lcap) shift/select/add steps:
+
+    for d in 1, 2, 4, ..., lcap/2:
+        v[i] += v[i-d]   unless a run boundary lies in (i-d, i]
+
+After the scan each element holds the sum of its run from the run's start
+through itself — fp error is ~log2(run length) ulps of the RUN's own
+magnitude (measured 2e-7 relative at 4M elements), and the whole thing is
+dense shift/add work XLA fuses to ~0.03-0.04 ms for three value channels.
+Padding sentinels form one arbitrarily long tail run whose scan values
+saturate at ``lcap`` window sums — callers mask sentinel runs out by key,
+so the garbage never escapes.
+
+Run identification (start flags, run ids, bounds) is int32 cumsum +
+``nonzero(size=...)`` + gathers — exact by construction and equally cheap.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def run_starts(keys: jnp.ndarray) -> jnp.ndarray:
+    """(N,) bool: element begins a new run of equal ``keys`` (keys sorted)."""
+    return jnp.concatenate(
+        [jnp.ones((1,), bool), keys[1:] != keys[:-1]]
+    )
+
+
+def run_starts2(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Run starts of the composite key (a, b) — avoids materialising a
+    wider composite when two sorted channels are already at hand."""
+    return jnp.concatenate(
+        [jnp.ones((1,), bool), (a[1:] != a[:-1]) | (b[1:] != b[:-1])]
+    )
+
+
+def run_ids(starts: jnp.ndarray) -> jnp.ndarray:
+    """(N,) int32 0-based run index per element (int cumsum — exact)."""
+    return jnp.cumsum(starts.astype(jnp.int32)) - 1
+
+
+def run_ends(starts: jnp.ndarray) -> jnp.ndarray:
+    """(N,) bool: element is the last of its run."""
+    return jnp.concatenate([starts[1:], jnp.ones((1,), bool)])
+
+
+def run_end_positions(starts: jnp.ndarray, rcap: int) -> jnp.ndarray:
+    """(rcap,) int32 end element position of each run, in run order.
+
+    ``rcap`` (static) must be >= the true run count INCLUDING any sentinel
+    tail run; surplus entries replicate the fill position ``n - 1`` and
+    must be masked by the caller (by the key at the end position — callers
+    know both the exact run count and the sentinel host-side)."""
+    n = starts.shape[0]
+    (endpos,) = jnp.nonzero(run_ends(starts), size=rcap, fill_value=n - 1)
+    return endpos.astype(jnp.int32)
+
+
+def seg_scan(
+    starts: jnp.ndarray,  # (N,) bool run starts
+    values: tuple[jnp.ndarray, ...],  # each (N,)
+    lcap: int,  # static pow2 >= longest real run
+) -> tuple[jnp.ndarray, ...]:
+    """Segmented inclusive prefix per channel: element i gets the sum of
+    its run from the run start through i (runs longer than ``lcap`` — only
+    the padding sentinel run, per the packer's contract — get windowed
+    partial sums; callers mask those runs out).  Channels share one flag
+    evolution; log2(lcap) shift/select/add steps over the flat axis."""
+    f = starts
+    vs = list(values)
+    d = 1
+    while d < lcap:
+        fs = jnp.concatenate([jnp.ones((d,), bool), f[:-d]])
+        vs = [
+            jnp.where(
+                f, v,
+                v + jnp.concatenate([jnp.zeros((d,), v.dtype), v[:-d]]),
+            )
+            for v in vs
+        ]
+        f = f | fs
+        d *= 2
+    return tuple(vs)
+
+
+def run_sums(
+    starts: jnp.ndarray,  # (N,) bool run starts (sorted keys)
+    values: tuple[jnp.ndarray, ...],  # each (N,) f32
+    rcap: int,  # static pow2 >= run count (incl. sentinel run)
+    lcap: int,  # static pow2 >= longest real run
+) -> tuple[tuple[jnp.ndarray, ...], jnp.ndarray]:
+    """Per-run totals for several value channels at once.
+
+    Returns ``(totals_per_channel, endpos)`` — totals are (rcap,) in run
+    order; ``endpos`` indexes the flat element axis (use it to fetch each
+    run's key, e.g. for sentinel masking)."""
+    endpos = run_end_positions(starts, rcap)
+    prefixes = seg_scan(starts, values, lcap)
+    return tuple(cs[endpos] for cs in prefixes), endpos

@@ -225,141 +225,130 @@ def _cosine_packed_cluster(
     return mean, cos
 
 
-@functools.partial(jax.jit, static_argnames=("mcap", "shift"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("shift", "l_rep", "l_row", "l_spec", "l_mem", "l_members"),
+)
 def cosine_flat(
     rkey: jax.Array,  # (Nr,) i32 row*shift+bin, ascending; sentinel tail
     rint: jax.Array,  # (Nr,) f32, same order
+    mkey: jax.Array,  # (N,) i32 row*shift+bin per member peak, sorted by
+    #   (row, member, bin); sentinel tail
+    mint: jax.Array,  # (N,) f32, already 0 where the peak fails the pair's
+    #   edge cutoff (the host gates it — it knows both edge tables)
+    spec_elem: jax.Array,  # (N,) i32 chunk-local spectrum id per peak,
+    #   non-decreasing; padding tail maps to the fill spectrum
+    pos: jax.Array,  # (N,) i32 host searchsorted(rkey, mkey, right) - 1 —
+    #   the LAST element of the matching rep run (or a non-matching
+    #   element when the bin is absent); -1 clipped by the kernel
+    spec_offsets: jax.Array,  # (s_pad + 1,) i32 peak extents per spectrum;
+    #   fill entries repeat n_pad (zero-length extents)
+    spec_row: jax.Array,  # (s_pad,) i32 chunk-local row per spectrum,
+    #   non-decreasing; fill = rows_cap - 1
+    npos: jax.Array,  # (s_pad,) i32 host searchsorted of each spectrum's
+    #   rep-norm cutoff key into rkey
     rep_offsets: jax.Array,  # (rows_cap + 1,) i32 rep extents per row
-    rep_edges: jax.Array,  # (rows_cap,) i32
-    cbin: jax.Array,  # (N,) i32 cosine bins sorted by (row, member, bin)
-    mint: jax.Array,  # (N,) f32, same order
-    spec_offsets: jax.Array,  # (S + 1,) i32 peak extents per spectrum
-    spec_gmem: jax.Array,  # (S + 1,) i32 row*mcap+member per spectrum;
-    #   entry S is the rows_cap*mcap sentinel for the padding tail
-    mem_edges: jax.Array,  # (rows_cap * mcap,) i32 per-(row, member)
+    row_spec_offsets: jax.Array,  # (rows_cap + 1,) i32 spectrum extents/row
     n_members: jax.Array,  # (rows_cap,) i32
-    mcap: int,
     shift: int,
+    l_rep: int,  # pow2 >= longest same-bin run within one rep
+    l_row: int,  # pow2 >= longest rep row (peaks per representative)
+    l_spec: int,  # pow2 >= most peaks in one member spectrum
+    l_mem: int,  # pow2 >= longest same-(spectrum, bin) member run
+    l_members: int,  # pow2 >= most spectra in one row (cluster members)
 ):
     """Flat zero-padding rep-vs-members binned cosine (see
     ``cosine_packed`` for the per-bin algebra; this is the same math over
-    ONE flat peak axis for the whole batch).  Composite int32 keys
-    (``row * shift + bin``) make rep lookups a single global searchsorted
-    and member runs globally unique — no vmap, no per-row padding.  The
-    per-peak (row, member) channel is DERIVED on device from the tiny
-    per-spectrum extent table (H2D bytes are the bottleneck; shipping it
-    per peak would cost 4 B/peak).  The per-row rep-norm prefix is a
-    global cumsum differenced at row starts.  Returns the (rows_cap,)
-    mean cosines — the only D2H bytes."""
+    ONE flat peak axis for the whole batch), built entirely on
+    ``ops.segments`` scans — no scatter anywhere (TPU scatter-adds with
+    duplicate indices serialize; the segment_sum formulation this replaces
+    spent ~140 ms per call at 4M peaks).
+
+    Anything that would make XLA materialise quadratic traffic stays on
+    the host instead: gathers from small per-spectrum tables with
+    million-element index vectors lower to one-hot matmuls on TPU (a
+    measured 84 GB of HBM traffic for one chunk), and ``searchsorted``'s
+    scan loop serialises — so the host ships per-peak composite keys,
+    edge-gated intensities, spectrum ids and rep-lookup positions outright
+    (H2D runs at GB/s here; D2H at ~25 MB/s is the link to protect, and
+    this kernel returns one f32 per cluster).  Per-spectrum dot/norm
+    totals are segmented-scan values read at each spectrum's last element;
+    the rep-norm prefix is segmented per ROW (never a global f32 cumsum —
+    a 4M-element prefix costs ~3 decimal digits); per-row member sums are
+    one more scan over the spectrum axis."""
+    from specpride_tpu.ops import segments as sg
+
     sent = jnp.int32(2**31 - 1)
     nr = rkey.shape[0]
-    n = cbin.shape[0]
-    rows_cap = rep_edges.shape[0]
-    s = spec_gmem.shape[0] - 1
+    n = mkey.shape[0]
+    rows_cap = n_members.shape[0]
+    s_pad = spec_row.shape[0]
 
-    # derive per-peak (row, member) + composite bin key on device
-    spec_of_elem = (
-        jnp.searchsorted(
-            spec_offsets, jnp.arange(n, dtype=jnp.int32), side="right"
-        )
-        - 1
-    )
-    gmem = spec_gmem[jnp.clip(spec_of_elem, 0, s)]
-    valid0 = cbin < sent
-    mkey_row = jnp.clip(gmem // mcap, 0, rows_cap - 1)
-    # dead-branch overflow of the multiply is discarded by the where
-    mkey = jnp.where(
-        valid0, mkey_row * jnp.int32(shift) + cbin, sent
-    )
+    # --- rep side: per-bin run totals (short seg_scan: runs <= l_rep)
+    rvalid = rkey != sent
+    r_starts = sg.run_starts(rkey)
+    (r_scan,) = sg.seg_scan(r_starts, (jnp.where(rvalid, rint, 0.0),), l_rep)
+    r_ends = sg.run_ends(r_starts)
+    r_sq = jnp.where(r_ends & rvalid, r_scan * r_scan, 0.0)
+    # per-row squared-total prefix, segmented per ROW: a block-cumsum
+    # reconstruction here would subtract prefixes shared with other rows
+    # in the block and cancel catastrophically when rows differ in
+    # intensity scale (cosines wrong by up to 0.7 absolute in the
+    # mixed-scale repro) — scans confine fp error to the row itself
+    row_of_rep = jnp.clip(rkey // jnp.int32(shift), 0, rows_cap - 1)
+    row_starts_r = sg.run_starts(jnp.where(rvalid, row_of_rep, rows_cap))
+    (r_sq_scan,) = sg.seg_scan(row_starts_r, (r_sq,), l_row)
 
-    # --- rep side: per-bin sums + global prefix of squared run totals
-    rvalid = rkey < sent
-    r_new = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), (rkey[1:] != rkey[:-1]).astype(jnp.int32)]
-    )
-    r_seg = jnp.cumsum(r_new)
-    r_sum_per_seg = jax.ops.segment_sum(
-        jnp.where(rvalid, rint, 0.0), r_seg, num_segments=nr,
-        indices_are_sorted=True,
-    )
-    r_sum_at = r_sum_per_seg[r_seg]
-    r_last = jnp.concatenate([rkey[:-1] != rkey[1:], jnp.ones((1,), bool)])
-    r_sq_contrib = jnp.where(r_last & rvalid, r_sum_at * r_sum_at, 0.0)
-    r_sq_prefix = jnp.cumsum(r_sq_contrib)
+    # --- member side: (spectrum, bin) runs over host-shipped channels
+    valid = mkey != sent
+    m_starts = sg.run_starts2(spec_elem, mkey)
+    m_ends = sg.run_ends(m_starts)
+    (m_scan,) = sg.seg_scan(m_starts, (mint,), l_mem)
 
-    # --- member side: runs of (row, member, bin) = (gmem, mkey) pairs
-    valid = mkey < sent
-    row_of_elem = jnp.clip(gmem // mcap, 0, rows_cap - 1)
-    gm_c = jnp.clip(gmem, 0, rows_cap * mcap - 1)
-    cut = jnp.maximum(rep_edges[row_of_elem], mem_edges[gm_c]) - 2
-    cutkey = row_of_elem.astype(jnp.int32) * jnp.int32(shift) + cut
-    ok = valid & (mkey <= cutkey)
-
-    run_new = jnp.concatenate(
-        [
-            jnp.zeros((1,), jnp.int32),
-            ((mkey[1:] != mkey[:-1]) | (gmem[1:] != gmem[:-1])).astype(
-                jnp.int32
-            ),
-        ]
-    )
-    run_seg = jnp.cumsum(run_new)
-    run_sum = jax.ops.segment_sum(
-        jnp.where(ok, mint, 0.0), run_seg, num_segments=n,
-        indices_are_sorted=True,
-    )
-    run_sum_at = run_sum[run_seg]
-    is_last = jnp.concatenate(
-        [(mkey[:-1] != mkey[1:]) | (gmem[:-1] != gmem[1:]), jnp.ones((1,), bool)]
-    )
-
-    pos = jnp.searchsorted(rkey, mkey, side="left")
+    # rep per-bin total for each member peak: the host ships
+    # ``searchsorted(rkey, mkey, side='right') - 1`` — the LAST element of
+    # the matching rep run when the bin is present, where the segmented
+    # scan value IS the run total (exact for any run length, no walk)
     pos_c = jnp.clip(pos, 0, nr - 1)
     rep_hit = (rkey[pos_c] == mkey) & valid
-    rep_val = jnp.where(rep_hit, r_sum_per_seg[r_seg[pos_c]], 0.0)
+    rep_val = jnp.where(rep_hit, r_scan[pos_c], 0.0)
 
-    contrib = is_last & ok
-    seg_ids = jnp.where(valid, gm_c, rows_cap * mcap)
-    dots = jax.ops.segment_sum(
-        jnp.where(contrib, run_sum_at * rep_val, 0.0),
-        seg_ids,
-        num_segments=rows_cap * mcap + 1,
-        indices_are_sorted=True,
-    )[:-1]
-    norms = jax.ops.segment_sum(
-        jnp.where(contrib, run_sum_at * run_sum_at, 0.0),
-        seg_ids,
-        num_segments=rows_cap * mcap + 1,
-        indices_are_sorted=True,
-    )[:-1]
+    # per-spectrum dot/norm: contributions at member-run ends, summed by a
+    # spectrum-segmented scan (fp error confined to the spectrum — spectra
+    # of wildly different intensity scale share blocks in real data) and
+    # read at each spectrum's last element
+    run_sum_at_end = jnp.where(m_ends, m_scan, 0.0)
+    spec_starts = sg.run_starts(spec_elem)
+    (dot_scan, norm_scan) = sg.seg_scan(
+        spec_starts,
+        (run_sum_at_end * rep_val, run_sum_at_end * run_sum_at_end),
+        l_spec,
+    )
+    spec_last = jnp.clip(spec_offsets[1:] - 1, 0, n - 1)  # (s_pad,)
+    nonempty = spec_offsets[1:] > spec_offsets[:-1]
+    dots = jnp.where(nonempty, dot_scan[spec_last], 0.0)
+    norms = jnp.where(nonempty, norm_scan[spec_last], 0.0)
 
-    # rep norm per (row, member): prefix difference over the row's window
-    row_ids = jnp.repeat(
-        jnp.arange(rows_cap, dtype=jnp.int32), mcap
-    )  # (rows_cap*mcap,)
-    pair_cut = (
-        jnp.maximum(rep_edges[row_ids], mem_edges) - 2
-    )  # (rows_cap*mcap,)
-    npos = jnp.searchsorted(
-        rkey, row_ids * jnp.int32(shift) + pair_cut + 1, side="left"
+    # rep norm per spectrum: row-segmented squared prefix at the cutoff
+    row_start = rep_offsets[spec_row]
+    has_prefix = npos > row_start
+    rep_norm = jnp.where(
+        has_prefix, r_sq_scan[jnp.clip(npos - 1, 0, nr - 1)], 0.0
     )
-    upto = jnp.where(npos > 0, r_sq_prefix[jnp.clip(npos - 1, 0, nr - 1)], 0.0)
-    row_start = rep_offsets[row_ids]
-    base = jnp.where(
-        row_start > 0, r_sq_prefix[jnp.clip(row_start - 1, 0, nr - 1)], 0.0
-    )
-    rep_norm = jnp.maximum(upto - base, 0.0)
 
     okc = (norms > 0) & (rep_norm > 0)
     cos = jnp.where(
         okc, dots / jnp.sqrt(jnp.maximum(norms * rep_norm, 1e-30)), 0.0
     )
-    member_ids = jnp.tile(jnp.arange(mcap, dtype=jnp.int32), rows_cap)
-    mask = member_ids < n_members[row_ids]
-    cos = jnp.where(mask, cos, 0.0).reshape(rows_cap, mcap)
-    return jnp.sum(cos, axis=1) / jnp.maximum(
-        n_members.astype(jnp.float32), 1.0
-    )
+
+    # per-row mean over the spectrum axis (spectra sorted by row; member
+    # count from the host so zero-peak members still weigh the mean)
+    srow_starts = sg.run_starts(spec_row)
+    (cos_scan,) = sg.seg_scan(srow_starts, (cos,), min(l_members, s_pad))
+    row_last = jnp.clip(row_spec_offsets[1:] - 1, 0, s_pad - 1)
+    row_has = row_spec_offsets[1:] > row_spec_offsets[:-1]
+    row_sum = jnp.where(row_has, cos_scan[row_last], 0.0)
+    return row_sum / jnp.maximum(n_members.astype(jnp.float32), 1.0)
 
 
 @functools.partial(jax.jit, static_argnames=("m",))

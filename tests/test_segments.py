@@ -1,0 +1,93 @@
+"""Unit tests for ops.segments — the scatter-free sorted-run reductions
+every device kernel is built on (see the module docstring for why
+``segment_sum`` was abandoned)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from specpride_tpu.ops import segments as sg
+
+
+def make_runs(rng, n_runs, max_len, pad=0, sent=2**30):
+    lens = rng.integers(1, max_len + 1, n_runs)
+    keys = np.repeat(np.arange(n_runs, dtype=np.int64), lens)
+    keys = np.concatenate([keys, np.full(pad, sent, dtype=np.int64)])
+    vals = rng.uniform(0.5, 1e4, keys.size).astype(np.float32)
+    return keys, vals, lens
+
+
+@functools.partial(jax.jit, static_argnames=("rcap", "lcap"))
+def _sums(keys, vals, rcap, lcap):
+    starts = sg.run_starts(keys)
+    (tot, cnt), endpos = sg.run_sums(
+        starts, (vals, jnp.ones_like(vals)), rcap, lcap
+    )
+    return tot, cnt, endpos
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("pad", [0, 7, 64])
+def test_run_sums_match_reduceat(seed, pad):
+    rng = np.random.default_rng(seed)
+    keys, vals, lens = make_runs(rng, n_runs=rng.integers(1, 200), max_len=17, pad=pad)
+    lcap = 32
+    rcap = int(lens.size + 2)  # + sentinel run + slack
+    tot, cnt, endpos = _sums(jnp.asarray(keys), jnp.asarray(vals), rcap, lcap)
+    tot, cnt, endpos = map(np.asarray, (tot, cnt, endpos))
+
+    starts = np.concatenate([[True], keys[1:] != keys[:-1]])
+    want = np.add.reduceat(vals.astype(np.float64), np.flatnonzero(starts))
+    genuine = keys[endpos] != 2**30
+    n_real = lens.size
+    assert genuine[:n_real].all()
+    assert not genuine[n_real:].any() or pad == 0
+    np.testing.assert_allclose(tot[:n_real], want[:n_real], rtol=1e-5)
+    np.testing.assert_array_equal(cnt[:n_real].astype(int), lens)
+
+
+def test_precision_small_run_after_large_prefix():
+    """The reason diff-of-global-cumsum was rejected: a tiny run following
+    millions of large values must keep its own relative precision."""
+    rng = np.random.default_rng(0)
+    big = rng.uniform(1e3, 1e4, 2**17).astype(np.float32)
+    keys = np.concatenate([
+        np.repeat(np.arange(big.size // 8), 8), [10**7, 10**7]
+    ]).astype(np.int64)
+    vals = np.concatenate([big, [0.125, 0.25]]).astype(np.float32)
+    tot, cnt, endpos = _sums(jnp.asarray(keys), jnp.asarray(vals),
+                             rcap=big.size // 8 + 2, lcap=8)
+    got = float(np.asarray(tot)[big.size // 8])
+    assert got == pytest.approx(0.375, rel=1e-6)
+
+
+def test_run_ids_and_broadcast():
+    rng = np.random.default_rng(3)
+    keys, vals, lens = make_runs(rng, n_runs=50, max_len=9, pad=5)
+    starts = sg.run_starts(jnp.asarray(keys))
+    ids = np.asarray(sg.run_ids(starts))
+    want = np.cumsum(np.concatenate([[True], keys[1:] != keys[:-1]])) - 1
+    np.testing.assert_array_equal(ids, want)
+
+    # broadcast pattern: totals gathered back per element
+    (tot,), _ = sg.run_sums(starts, (jnp.asarray(vals),),
+                            rcap=int(want[-1] + 2), lcap=16)
+    per_elem = np.asarray(tot)[ids]
+    ref = np.add.reduceat(vals.astype(np.float64), np.flatnonzero(
+        np.concatenate([[True], keys[1:] != keys[:-1]])))
+    np.testing.assert_allclose(per_elem, ref[ids], rtol=1e-5)
+
+
+def test_runs_longer_than_lcap_are_windowed_not_crashing():
+    """Sentinel tail runs exceed lcap by contract; values are garbage but
+    the call must not fail and genuine runs stay exact."""
+    keys = np.concatenate([[0, 0, 1], np.full(100, 2**30)]).astype(np.int64)
+    vals = np.ones(keys.size, dtype=np.float32)
+    tot, cnt, endpos = _sums(jnp.asarray(keys), jnp.asarray(vals),
+                             rcap=4, lcap=2)
+    tot = np.asarray(tot)
+    assert tot[0] == 2.0 and tot[1] == 1.0
+    assert np.asarray(keys)[np.asarray(endpos)[2]] == 2**30  # sentinel run
