@@ -70,8 +70,8 @@ def _runners(backend, nb):
         return [nb.average_cosine(r, c.members) for r, c in zip(reps, cs)]
 
     def dev_pipeline(cs):
-        reps = backend.run_bin_mean(cs)
-        cos = backend.average_cosines(reps, cs)
+        # fused: overlaps cosine member prep with the bin-mean D2H stream
+        reps, cos = backend.run_bin_mean_with_cosines(cs)
         assert len(reps) == len(cos) == len(cs)
         return cos
 

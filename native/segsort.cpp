@@ -1,0 +1,55 @@
+// Multithreaded segmented stable argsort (C ABI, loaded via ctypes).
+//
+// The pack-time host passes sort peaks by bin WITHIN independent segments
+// (clusters for the flat bin-mean layout, spectra for the cosine layout).
+// numpy's global lexsort over millions of composite keys costs ~0.5 s
+// single-threaded and cannot exploit the segment structure; sorting each
+// segment independently is cache-friendly and embarrassingly parallel.
+// Stability matches np.argsort(kind="stable") / np.lexsort tie behavior
+// (equal keys keep input order), which the dedup and parity semantics
+// rely on.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <numeric>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// order_out[i] receives GLOBAL indices: for each segment s,
+// order_out[offsets[s]:offsets[s+1]] is offsets[s] + stable argsort of
+// keys[offsets[s]:offsets[s+1]].
+int seg_argsort_i64(
+    const int64_t* keys,
+    const int64_t* offsets,  // (n_segs + 1,)
+    int64_t n_segs,
+    int64_t* order_out,
+    int n_threads) {
+  if (n_threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    n_threads = hc ? static_cast<int>(hc) : 4;
+  }
+  n_threads = std::min<int64_t>(n_threads, std::max<int64_t>(n_segs, 1));
+
+  std::atomic<int64_t> next{0};
+  auto worker = [&]() {
+    for (;;) {
+      int64_t s = next.fetch_add(1);
+      if (s >= n_segs) return;
+      const int64_t lo = offsets[s], hi = offsets[s + 1];
+      std::iota(order_out + lo, order_out + hi, lo);
+      std::stable_sort(order_out + lo, order_out + hi,
+                       [&](int64_t a, int64_t b) { return keys[a] < keys[b]; });
+    }
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+  return 0;
+}
+
+}  // extern "C"

@@ -129,14 +129,15 @@ class BatchConfig:
     """
 
     # coarse: each distinct (k, m) bucket pair is a separate XLA compile of
-    # the medoid occupancy/gram kernel; fine m granularity (the old
-    # 2,4,8,...) multiplied compile count for negligible padding savings —
-    # the M axis only scales scatter/matmul FLOPs, which are nowhere near
-    # the bottleneck
-    member_buckets: tuple[int, ...] = (8, 32, 128)
+    # the medoid occupancy/gram kernel AND a dispatch round-trip (~0.1 s on
+    # tunneled hosts) — the round-4 medoid bench spent more time in bucket
+    # round-trips than in compute, so both axes stay very coarse: padding
+    # only costs H2D bytes (GB/s) and low-utilization matmul FLOPs
+    member_buckets: tuple[int, ...] = (32, 128)
     # total peaks per cluster (packed layout, data.packed) — one axis of
-    # bucket waste instead of two.  Few coarse buckets: on tunneled hosts
-    # each extra batch shape costs a full dispatch round-trip, which beats
-    # the padding bytes it saves.
-    total_peak_buckets: tuple[int, ...] = (512, 2048, 8192, 32768)
-    clusters_per_batch: int = 256
+    # bucket waste instead of two
+    total_peak_buckets: tuple[int, ...] = (2048, 8192, 32768)
+    # bounds transient host memory per packed batch (the widest bucket
+    # materializes (clusters_per_batch, K) f64 host arrays); benchmarks on
+    # big-memory hosts pass a larger value explicitly
+    clusters_per_batch: int = 1024

@@ -491,23 +491,30 @@ def pack_flat_bin_mean(
     table = _as_table(clusters_or_table)
     idx = table.cluster_order()
 
-    bins64, kept_src, _, _, kept_totals = _bin_quantize_dedup(
-        table, min_mz, max_mz, bin_size, n_bins
+    bins64, kept_src, kept_counts, kept_offsets, kept_totals = (
+        _bin_quantize_dedup(table, min_mz, max_mz, bin_size, n_bins)
     )
-    spec_of_peak = np.repeat(
-        np.arange(table.n_spectra, dtype=np.int64), table.peak_counts
-    )
-    row_of_kept = table.cluster_code[spec_of_peak[kept_src]]
-    kb = bins64[kept_src]
-    order = np.lexsort((kb, row_of_kept))
-    s_mz = table.mz[kept_src[order]].astype(np.float32)
-    s_int = table.intensity[kept_src[order]].astype(np.float32)
-    s_bin = kb[order]
-    s_row = row_of_kept[order]
 
     c = table.n_clusters
     row_peak_offsets = np.zeros(c + 1, dtype=np.int64)
     np.cumsum(kept_totals, out=row_peak_offsets[1:])
+
+    # group kept peaks by cluster, then sort each cluster's peaks by bin
+    # with the segmented sorter (multithreaded native when built; clusters
+    # are independent segments, so a global lexsort wastes the structure)
+    from specpride_tpu.ops.segsort import seg_argsort
+
+    cnt_kept = kept_counts[idx.order]
+    src2 = np.repeat(
+        kept_offsets[idx.order], cnt_kept
+    ) + _grouped_arange(cnt_kept)
+    orig = kept_src[src2]  # original peak ids, grouped by cluster
+    order_local = seg_argsort(bins64[orig], row_peak_offsets)
+    final = orig[order_local]
+    s_mz = table.mz[final].astype(np.float32)
+    s_int = table.intensity[final].astype(np.float32)
+    s_bin = bins64[final]
+    s_row = np.repeat(np.arange(c, dtype=np.int64), kept_totals)
 
     # distinct bins per row (exact compaction bound), from the sorted pass
     if s_bin.size:
@@ -563,24 +570,18 @@ def pack_flat_bin_mean(
 # ---------------------------------------------------------------------------
 
 
-def pack_bucketize_gap(
-    clusters_or_table,
-    config,
-    batch_config: BatchConfig = BatchConfig(),
-) -> list[GapPackedBatch]:
-    """Sort + f64 gap-segment every cluster in one vectorized pass (same
-    grouping semantics as ``ops.quantize.gap_segments`` — the numpy oracle's
-    code path — validated against it by the parity suite), then bucket by
-    total peak count for ``ops.gap_average.gap_average_compact``.
+def gap_global_segments(table, idx, config) -> dict:
+    """Sort + f64 gap-segment EVERY cluster in one vectorized global pass
+    (same grouping semantics as ``ops.quantize.gap_segments`` — the numpy
+    oracle's per-cluster code path — validated against it by the parity
+    suite).  Shared by the bucketized device packer and the vectorized
+    host consensus (``backends.tpu_backend.TpuBackend.run_gap_average``).
 
-    Vectorized formulation: one global lexsort groups peaks by cluster and
-    orders them by m/z (singleton clusters order by input position instead,
-    ref :88-90 passthrough); gap booleans, the reference's final-gap merge
+    One global lexsort groups peaks by cluster and orders them by m/z
+    (singleton clusters order by input position instead, ref :88-90
+    passthrough); gap booleans, the reference's final-gap merge
     (``tail_mode="reference"``), and segment ids all come from flat
     cumsum/bincount passes."""
-    table = _as_table(clusters_or_table)
-    idx = table.cluster_order()
-
     p_total = table.n_peaks
     spec_of_peak = np.repeat(
         np.arange(table.n_spectra, dtype=np.int64), table.peak_counts
@@ -640,6 +641,28 @@ def pack_bucketize_gap(
         last_peak[:-1] = ~same_cluster[1:]
         lidx = np.flatnonzero(last_peak)
         n_groups[s_cluster[lidx]] = seg[lidx] + 1
+
+    return dict(
+        order=order, s_cluster=s_cluster, s_mz=s_mz, gap=gap, seg=seg,
+        n_groups=n_groups, first_pos=first_pos,
+        cluster_first_peak=cluster_first_peak,
+    )
+
+
+def pack_bucketize_gap(
+    clusters_or_table,
+    config,
+    batch_config: BatchConfig = BatchConfig(),
+) -> list[GapPackedBatch]:
+    """Bucketize the global f64 gap segmentation (``gap_global_segments``)
+    by total peak count for ``ops.gap_average.gap_average_compact``."""
+    table = _as_table(clusters_or_table)
+    idx = table.cluster_order()
+
+    g = gap_global_segments(table, idx, config)
+    order, s_mz, seg, n_groups, first_pos = (
+        g["order"], g["s_mz"], g["seg"], g["n_groups"], g["first_pos"]
+    )
 
     quorum_all = np.ceil(
         config.min_fraction * idx.n_members.astype(np.float64)

@@ -1,0 +1,125 @@
+"""ctypes bindings for the multithreaded C++ gap-average consensus
+(native/gap_average.cpp — see its header for why this method is host work:
+the measured device path lost 14x to numpy over the host link, and a
+single numpy thread only ties the per-cluster oracle).
+
+Loading mirrors ``io.native``: lazy, soft-failing (``available()`` False
+when unbuilt), reusing the same one-shot ``make -C native`` bootstrap."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+_LIB_NAME = "libgap_average.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _candidate_paths() -> list[str]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(os.path.dirname(here))
+    paths = []
+    env = os.environ.get("SPECPRIDE_GAP_LIB")
+    if env:
+        paths.append(env)
+    paths.append(os.path.join(repo_root, "native", _LIB_NAME))
+    return paths
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    p = ctypes.POINTER
+    lib.gap_average_run.restype = ctypes.c_int
+    lib.gap_average_run.argtypes = [
+        p(ctypes.c_double),  # mz
+        p(ctypes.c_double),  # intensity
+        p(ctypes.c_int64),  # peak_offsets
+        p(ctypes.c_int64),  # n_members
+        ctypes.c_int64,  # n_clusters
+        ctypes.c_double,  # mz_accuracy
+        ctypes.c_int,  # tail_mode_reference
+        ctypes.c_double,  # min_fraction
+        ctypes.c_double,  # dyn_range
+        p(ctypes.c_double),  # out_mz
+        p(ctypes.c_double),  # out_intensity
+        p(ctypes.c_int64),  # out_counts
+        ctypes.c_int,  # n_threads
+    ]
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        # reuse the parser's one-shot in-tree build (make all builds both)
+        from specpride_tpu.io import native as _io_native
+
+        _io_native.ensure_built()
+        for path in _candidate_paths():
+            if os.path.exists(path):
+                try:
+                    _lib = _bind(ctypes.CDLL(path))
+                    return _lib
+                except OSError:
+                    continue
+        _load_failed = True
+        return None
+
+
+def available() -> bool:
+    """True when the C++ gap-average library is built and loadable."""
+    return _load() is not None
+
+
+def gap_average_groups(
+    mz: np.ndarray,  # (P,) f64, clusters contiguous
+    intensity: np.ndarray,  # (P,) f64, same order
+    peak_offsets: np.ndarray,  # (C + 1,) i64
+    n_members: np.ndarray,  # (C,) i64
+    mz_accuracy: float,
+    tail_mode_reference: bool,
+    min_fraction: float,
+    dyn_range: float,
+    n_threads: int = 0,  # 0 = hardware concurrency
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Kept (group m/z, group intensity, per-cluster counts).  Outputs for
+    cluster c occupy ``out[peak_offsets[c] : peak_offsets[c] + counts[c]]``
+    of the flat buffers.  Raises ``RuntimeError`` when the library is
+    unavailable (callers guard with ``available()``)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native gap-average not built (make -C native)")
+    mz = np.ascontiguousarray(mz, dtype=np.float64)
+    intensity = np.ascontiguousarray(intensity, dtype=np.float64)
+    peak_offsets = np.ascontiguousarray(peak_offsets, dtype=np.int64)
+    n_members = np.ascontiguousarray(n_members, dtype=np.int64)
+    c = peak_offsets.size - 1
+    out_mz = np.empty(mz.size, dtype=np.float64)
+    out_int = np.empty(mz.size, dtype=np.float64)
+    out_counts = np.zeros(c, dtype=np.int64)
+    dp = ctypes.POINTER(ctypes.c_double)
+    ip = ctypes.POINTER(ctypes.c_int64)
+    rc = lib.gap_average_run(
+        mz.ctypes.data_as(dp),
+        intensity.ctypes.data_as(dp),
+        peak_offsets.ctypes.data_as(ip),
+        n_members.ctypes.data_as(ip),
+        c,
+        float(mz_accuracy),
+        int(bool(tail_mode_reference)),
+        float(min_fraction),
+        float(dyn_range),
+        out_mz.ctypes.data_as(dp),
+        out_int.ctypes.data_as(dp),
+        out_counts.ctypes.data_as(ip),
+        int(n_threads),
+    )
+    if rc != 0:
+        raise RuntimeError(f"native gap-average failed (rc={rc})")
+    return out_mz, out_int, out_counts

@@ -75,16 +75,14 @@ def run_end_positions(starts: jnp.ndarray, rcap: int) -> jnp.ndarray:
     return endpos.astype(jnp.int32)
 
 
-def seg_scan(
+def _seg_scan_combine(
     starts: jnp.ndarray,  # (N,) bool run starts
     values: tuple[jnp.ndarray, ...],  # each (N,)
     lcap: int,  # static pow2 >= longest real run
+    combine,  # elementwise associative op (operator.add / operator.or_)
 ) -> tuple[jnp.ndarray, ...]:
-    """Segmented inclusive prefix per channel: element i gets the sum of
-    its run from the run start through i (runs longer than ``lcap`` — only
-    the padding sentinel run, per the packer's contract — get windowed
-    partial sums; callers mask those runs out).  Channels share one flag
-    evolution; log2(lcap) shift/select/add steps over the flat axis."""
+    """Shared Hillis-Steele core of ``seg_scan`` / ``seg_scan_or``: one
+    flag evolution, log2(lcap) shift/select/combine steps per channel."""
     f = starts
     vs = list(values)
     d = 1
@@ -93,13 +91,43 @@ def seg_scan(
         vs = [
             jnp.where(
                 f, v,
-                v + jnp.concatenate([jnp.zeros((d,), v.dtype), v[:-d]]),
+                combine(
+                    v, jnp.concatenate([jnp.zeros((d,), v.dtype), v[:-d]])
+                ),
             )
             for v in vs
         ]
         f = f | fs
         d *= 2
     return tuple(vs)
+
+
+def seg_scan(
+    starts: jnp.ndarray,  # (N,) bool run starts
+    values: tuple[jnp.ndarray, ...],  # each (N,)
+    lcap: int,  # static pow2 >= longest real run
+) -> tuple[jnp.ndarray, ...]:
+    """Segmented inclusive prefix per channel: element i gets the sum of
+    its run from the run start through i (runs longer than ``lcap`` — only
+    the padding sentinel run, per the packer's contract — get windowed
+    partial sums; callers mask those runs out)."""
+    import operator
+
+    return _seg_scan_combine(starts, values, lcap, operator.add)
+
+
+def seg_scan_or(
+    starts: jnp.ndarray,  # (N,) bool run starts
+    values: tuple[jnp.ndarray, ...],  # each (N,) integer bitmasks
+    lcap: int,  # static pow2 >= longest real run
+) -> tuple[jnp.ndarray, ...]:
+    """Segmented inclusive bitwise-OR prefix (OR is associative and
+    idempotent, so windowed saturation on over-long sentinel runs is
+    harmless).  Used to accumulate per-run member presence bitmasks
+    without a scatter."""
+    import operator
+
+    return _seg_scan_combine(starts, values, lcap, operator.or_)
 
 
 def run_sums(

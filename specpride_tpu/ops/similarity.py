@@ -40,51 +40,66 @@ from specpride_tpu.config import CosineConfig, MedoidConfig
 _SENT = jnp.int32(2**30)  # padding sentinel for global bin ids
 
 
-@functools.partial(jax.jit, static_argnames=("m",))
+@functools.partial(jax.jit, static_argnames=("m", "lcap"))
 def shared_bins_packed(
     bins: jax.Array,  # (B, K) i32 GLOBAL bins, PRE-SORTED (bin, member)
     member_id: jax.Array,  # (B, K) i32 in [0, m], same order, padding = m
     m: int,
+    lcap: int = 64,  # pow2 >= longest same-(row, bin) element run
 ) -> jax.Array:
     """(B, M, M) shared occupied-bin counts for every member pair.
 
-    Sort/segment formulation — no dense (M, grid) occupancy and no scatter
-    (TPU scatters serialize; the round-1 dense-grid kernel spent its time
-    there and its data-dependent ``grid`` static arg recompiled per batch).
-    Rows arrive PRE-SORTED by (bin, member) from the host (device sorts
-    were the dominant kernel cost); the first element of each
-    (bin, member) run contributes 1 to a runs×members occupancy ``V``
-    built with ONE sorted ``segment_sum`` (segment id = bin_run * m +
-    member, non-decreasing by construction), and all pairwise counts come
-    from the batched gram matmul ``Vᵀ @ V`` on the MXU.  Bin ids are
-    global grid positions (``floor(mz / bin_size)`` in f64 on the host) —
-    pairwise intersections don't care about a per-cluster origin, so no
-    span/rel-bin pass exists any more.  Counts return as uint16: D2H bytes
-    are the bottleneck on tunneled hosts, and counts are bounded by
+    Scatter-free bitmask formulation (every scatter flavor — add OR set —
+    serialized on TPU and dominated this kernel at ~600 ms/0.5M rows):
+    rows arrive PRE-SORTED by (bin, member) from the host, each bin run's
+    member-presence set accumulates as int32 BITMASKS via a segmented
+    OR-scan over the flattened batch (``ops.segments.seg_scan_or``,
+    ceil(m/32) lanes), masks are read at run ends, unpacked to a 0/1
+    occupancy tensor by shifts, and all pairwise counts come from one
+    batched gram einsum on the MXU.  Bin ids are global grid positions
+    (``floor(mz / bin_size)`` in f64 on the host) — pairwise intersections
+    don't care about a per-cluster origin.  Counts return as uint16: D2H
+    bytes are the bottleneck on tunneled hosts, and counts are bounded by
     per-member peak counts (the driver asserts < 2**16)."""
+    from specpride_tpu.ops import segments as sg
 
-    def one(sb, sm):
-        k = sb.shape[0]
-        ok = (sm < m) & (sb < _SENT)
-        new_bin = jnp.concatenate(
-            [jnp.ones((1,), jnp.int32), (sb[1:] != sb[:-1]).astype(jnp.int32)]
-        )
-        bin_run = jnp.cumsum(new_bin) - 1
-        first_of_mb = jnp.concatenate(
-            [
-                jnp.ones((1,), bool),
-                (sb[1:] != sb[:-1]) | (sm[1:] != sm[:-1]),
-            ]
-        )
-        val = jnp.where(ok & first_of_mb, 1.0, 0.0)
-        seg = bin_run * m + jnp.clip(sm, 0, m - 1)
-        occ = jax.ops.segment_sum(
-            val, seg, num_segments=k * m, indices_are_sorted=True
-        )
-        v = occ.reshape(k, m)
-        return (v.T @ v).astype(jnp.uint16)  # MXU
+    b, k = bins.shape
+    n = b * k
+    fb = bins.reshape(n)
+    fm = member_id.reshape(n)
+    ok = (fm < m) & (fb < _SENT)
 
-    return jax.vmap(one)(bins, member_id)
+    # run starts: new (row, bin) pair — row boundaries every k elements
+    row_start = (jnp.arange(n, dtype=jnp.int32) % k) == 0
+    starts = sg.run_starts(fb) | row_start
+    first_of_mb = starts | jnp.concatenate(
+        [jnp.ones((1,), bool), fm[1:] != fm[:-1]]
+    )
+    contrib = ok & first_of_mb
+    mm = jnp.clip(fm, 0, m - 1)
+
+    lanes = []
+    for lane in range((m + 31) // 32):
+        in_lane = contrib & (mm >= lane * 32) & (mm < (lane + 1) * 32)
+        lanes.append(
+            jnp.where(
+                in_lane, jnp.int32(1) << (mm - lane * 32), jnp.int32(0)
+            )
+        )
+    masks = sg.seg_scan_or(starts, tuple(lanes), lcap)
+
+    is_end = sg.run_ends(starts)
+    # unpack run-end masks to a 0/1 (B, K, M) occupancy, gram on the MXU
+    vs = []
+    for lane, mask in enumerate(masks):
+        end_mask = jnp.where(is_end, mask, 0)
+        width = min(32, m - lane * 32)
+        bits = (
+            (end_mask[:, None] >> jnp.arange(width, dtype=jnp.int32)) & 1
+        )
+        vs.append(bits)
+    v = jnp.concatenate(vs, axis=1).astype(jnp.float32).reshape(b, k, m)
+    return jnp.einsum("bkm,bkn->bmn", v, v).astype(jnp.uint16)
 
 
 def medoid_finalize(

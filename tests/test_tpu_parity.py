@@ -291,6 +291,74 @@ class TestGapAverageParity:
         )
         np.testing.assert_allclose(oracle[0].rt, device[0].rt)
 
+    @pytest.mark.parametrize("tail_mode", ["reference", "split"])
+    def test_numpy_fallback_host_path(self, rng, tail_mode, monkeypatch):
+        """The vectorized numpy branch of _run_gap_average_host (used when
+        the native lib is absent) must match the oracle too — CI builds
+        the lib, so force the fallback explicitly."""
+        from specpride_tpu.ops import gap_native
+
+        monkeypatch.setattr(gap_native, "available", lambda: False)
+        cfg = GapAverageConfig(tail_mode=tail_mode)
+        clusters = [
+            make_gap_safe_cluster(
+                rng, f"c{i}", n_members=int(rng.integers(1, 6)),
+                n_skeleton=int(rng.integers(4, 60)),
+            )
+            for i in range(8)
+        ]
+        clusters.append(Cluster("c-empty", [
+            Spectrum(mz=[], intensity=[], precursor_mz=500.0,
+                     precursor_charge=2, title="c-empty;u0"),
+            Spectrum(mz=[200.0, 200.02], intensity=[5.0, 7.0],
+                     precursor_mz=500.0, precursor_charge=2,
+                     title="c-empty;u1"),
+        ]))
+        oracle = nb.run_gap_average(clusters, cfg)
+        device = TpuBackend().run_gap_average(clusters, cfg)
+        for o, d in zip(oracle, device):
+            np.testing.assert_allclose(o.mz, d.mz, rtol=1e-12)
+            np.testing.assert_allclose(o.intensity, d.intensity, rtol=1e-12)
+
+    @pytest.mark.parametrize("tail_mode", ["reference", "split"])
+    def test_native_host_path_is_bit_exact(self, rng, tail_mode):
+        """The C++ multithreaded host path (ops.gap_native) must be
+        BIT-identical to the oracle — same stable sort, same f64
+        accumulation order — including near-threshold gaps, m/z ties
+        (stability), peakless members, and the tail-mode merge."""
+        from specpride_tpu.ops import gap_native
+
+        if not gap_native.available():
+            pytest.skip("native gap-average not built")
+        cfg = GapAverageConfig(tail_mode=tail_mode)
+        clusters = []
+        for i in range(12):
+            n = int(rng.integers(2, 120))
+            gaps = 0.01 + rng.uniform(-5e-5, 5e-5, size=n - 1)
+            base = 1500.0 + np.concatenate([[0.0], np.cumsum(gaps)])
+            members = []
+            for k in range(int(rng.integers(1, 6))):
+                mz = base.copy()  # exact ties across members
+                members.append(Spectrum(
+                    mz=mz, intensity=rng.uniform(1.0, 1e4, n),
+                    precursor_mz=700.0, precursor_charge=2, rt=float(k),
+                    title=f"c{i};mzspec:PXD1:r:scan:{i * 10 + k}",
+                ))
+            clusters.append(Cluster(f"c{i}", members))
+        # a cluster with a zero-peak member
+        clusters.append(Cluster("c-empty", [
+            Spectrum(mz=[], intensity=[], precursor_mz=500.0,
+                     precursor_charge=2, title="c-empty;u0"),
+            Spectrum(mz=[200.0, 200.02], intensity=[5.0, 7.0],
+                     precursor_mz=500.0, precursor_charge=2,
+                     title="c-empty;u1"),
+        ]))
+        oracle = nb.run_gap_average(clusters, cfg)
+        device = TpuBackend().run_gap_average(clusters, cfg)
+        for o, d in zip(oracle, device):
+            np.testing.assert_array_equal(o.mz, d.mz)
+            np.testing.assert_array_equal(o.intensity, d.intensity)
+
 
 # ---------------------------------------------------------------------------
 # K2: medoid representative
@@ -391,6 +459,19 @@ class TestCosineParity:
         )
         device = backend.average_cosines(reps, clusters)
         np.testing.assert_allclose(oracle, device, rtol=5e-5, atol=5e-5)
+
+    def test_fused_pipeline_matches_composition(self, rng, backend):
+        """run_bin_mean_with_cosines (the overlapped consensus+QC pass)
+        must equal run_bin_mean followed by average_cosines."""
+        clusters = random_clusters(rng, n=10)
+        reps_f, cos_f = backend.run_bin_mean_with_cosines(clusters)
+        reps = backend.run_bin_mean(clusters)
+        cos = backend.average_cosines(reps, clusters)
+        assert [s.title for s in reps_f] == [s.title for s in reps]
+        for a, b in zip(reps_f, reps):
+            np.testing.assert_array_equal(a.mz, b.mz)
+            np.testing.assert_array_equal(a.intensity, b.intensity)
+        np.testing.assert_allclose(cos_f, cos, rtol=1e-6, atol=1e-7)
 
     def test_multi_chunk_dispatch(self, rng):
         """Force >= 3 chunks through the flat cosine path so the
