@@ -169,6 +169,75 @@ def bench_method(
     }
 
 
+def pallas_ab(clusters) -> dict | None:
+    """On-chip A/B of the K1 segmented-scan core: XLA shift/select
+    formulation (ops.segments.seg_scan) vs the Pallas single-pass kernel
+    (ops.pallas_kernels.seg_scan_pallas), on this workload's real flat
+    bin-mean arrays.  Returns None off-TPU."""
+    import functools
+
+    import jax
+
+    from specpride_tpu.backends.tpu_backend import _pow2
+    from specpride_tpu.config import BinMeanConfig
+    from specpride_tpu.data.packed import pack_flat_bin_mean
+    from specpride_tpu.ops import pallas_kernels as pk
+    from specpride_tpu.ops import segments as sg
+
+    if not pk.available() or pk.pl is None:
+        return None
+    cfg = BinMeanConfig()
+    batch = pack_flat_bin_mean(
+        clusters, cfg.min_mz, cfg.max_mz, cfg.bin_size, cfg.n_bins,
+        max_elements=1 << 24,
+    )[0]
+    n = batch.gbin.size
+    n_pad = -(-n // pk.BLK) * pk.BLK
+    sent = np.int32(2**31 - 1)
+    gbin = jax.device_put(np.pad(batch.gbin, (0, n_pad - n),
+                                 constant_values=sent))
+    mz = jax.device_put(np.pad(batch.mz, (0, n_pad - n)))
+    inten = jax.device_put(np.pad(batch.intensity, (0, n_pad - n)))
+    w = jax.device_put(np.ones(n_pad, np.float32))
+    jax.block_until_ready([gbin, mz, inten, w])
+    lcap = _pow2(int(batch.n_members.max(initial=1)))
+
+    @functools.partial(jax.jit, static_argnames=("lcap",))
+    def xla(g, w, x, y, lcap):
+        return sg.seg_scan(sg.run_starts(g), (w, x, y), lcap)
+
+    pal = jax.jit(lambda g, w, x, y: pk.seg_scan_pallas(g, w, x, y))
+
+    def best(fn, *a, runs=5, **kw):
+        r = fn(*a, **kw)
+        jax.block_until_ready(r)
+        times = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            r = fn(*a, **kw)
+            jax.block_until_ready(r)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    t_xla = best(xla, gbin, w, mz, inten, lcap=lcap)
+    t_pal = best(pal, gbin, w, mz, inten)
+    a = np.asarray(xla(gbin, w, mz, inten, lcap=lcap)[2])
+    b = np.asarray(pal(gbin, w, mz, inten)[2])
+    real = np.asarray(batch.gbin) != sent
+    denom = np.maximum(np.abs(a[:n][real]), 1.0)
+    rel = float(np.abs((a[:n][real] - b[:n][real]) / denom).max())
+    eprint(
+        f"[pallas A/B] {n} peaks: XLA seg_scan {t_xla*1e3:.2f}ms, "
+        f"Pallas {t_pal*1e3:.2f}ms, max rel diff {rel:.1e}"
+    )
+    return {
+        "n_peaks": n,
+        "xla_seg_scan_ms": round(t_xla * 1e3, 3),
+        "pallas_seg_scan_ms": round(t_pal * 1e3, 3),
+        "max_rel_diff": rel,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-clusters", type=int, default=2000)
@@ -224,6 +293,8 @@ def main() -> None:
             "jax_devices": [str(d) for d in jax.devices()],
             "methods": [],
         }
+        import gc
+
         for method in ("bin_mean", "gap_average", "medoid", "pipeline"):
             report["methods"].append(
                 bench_method(
@@ -231,6 +302,14 @@ def main() -> None:
                     numpy_sample=len(clusters), seed=args.seed,
                 )
             )
+            # back-to-back methods in one process measurably degrade on
+            # tunneled hosts (leftover device buffers + queue state); a
+            # collection pass between methods keeps runs comparable to
+            # standalone --method invocations
+            gc.collect()
+        ab = pallas_ab(clusters)
+        if ab is not None:
+            report["pallas_ab"] = ab
         with open(args.report, "w") as f:
             json.dump(report, f, indent=2)
             f.write("\n")

@@ -52,4 +52,39 @@ int seg_argsort_i64(
   return 0;
 }
 
+// Threaded searchsorted (side='right'): out[i] = number of keys <= q[i].
+// numpy's searchsorted is single-threaded; the cosine prep queries ~3M
+// member keys against ~1M rep keys per batch, which is worth spreading
+// across cores.
+int searchsorted_right_i32(
+    const int32_t* keys,
+    int64_t n_keys,
+    const int32_t* queries,
+    int64_t n_queries,
+    int64_t* out,
+    int n_threads) {
+  if (n_threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    n_threads = hc ? static_cast<int>(hc) : 4;
+  }
+  n_threads = std::min<int64_t>(n_threads, std::max<int64_t>(n_queries, 1));
+  std::atomic<int64_t> next{0};
+  const int64_t block = 1 << 16;
+  auto worker = [&]() {
+    for (;;) {
+      int64_t lo = next.fetch_add(block);
+      if (lo >= n_queries) return;
+      int64_t hi = std::min(lo + block, n_queries);
+      for (int64_t i = lo; i < hi; ++i) {
+        out[i] = std::upper_bound(keys, keys + n_keys, queries[i]) - keys;
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+  return 0;
+}
+
 }  // extern "C"

@@ -65,8 +65,18 @@ def _ensure_compile_cache() -> None:
     if path == "":
         return
     if path is None:
+        # partition by platform: CPU AOT entries compiled inside a
+        # TPU-plugin process carry different machine-feature flags than a
+        # plain CPU process, and loading a mismatched entry risks SIGILL
+        try:
+            plat = jax.config.jax_platforms or os.environ.get(
+                "JAX_PLATFORMS", ""
+            )
+        except AttributeError:
+            plat = os.environ.get("JAX_PLATFORMS", "")
         path = os.path.join(
-            os.path.expanduser("~"), ".cache", "specpride_tpu", "jax_cache"
+            os.path.expanduser("~"), ".cache", "specpride_tpu",
+            f"jax_cache_{plat or 'default'}",
         )
     try:
         os.makedirs(path, exist_ok=True)
@@ -199,6 +209,12 @@ class TpuBackend:
     batch_config: BatchConfig = dataclasses.field(default_factory=BatchConfig)
     max_grid_elements: int = 64 * 1024 * 1024
     mesh: object | None = None  # jax.sharding.Mesh
+    # mesh-less layout selection: "auto" = flat zero-padding paths (and the
+    # host gap path); "bucketized" forces the (B, K) device paths that mesh
+    # runs use — the escape hatch if a flat path regresses (with a mesh the
+    # bucketized layout is always used: a flat peak axis cannot shard
+    # along clusters)
+    layout: str = "auto"  # "auto" | "flat" | "bucketized"
     # always-on phase timers (pack / dispatch / d2h / finalize; plus
     # "device" when ``sync_timing``).  One RunStats accumulates across calls;
     # bench.py reads and resets it per method run.
@@ -298,7 +314,7 @@ class TpuBackend:
         for c in clusters:
             numpy_backend.check_uniform_charge(c.members)
 
-        if self.mesh is None:
+        if self.mesh is None and self.layout != "bucketized":
             return self._run_bin_mean_flat(clusters, config)
 
         out: list[Spectrum | None] = [None] * len(clusters)
@@ -488,7 +504,7 @@ class TpuBackend:
         device path shards the segment reductions across devices
         (``ops.gap_average``), where interconnect bandwidth changes the
         trade-off."""
-        if self.mesh is None:
+        if self.mesh is None and self.layout != "bucketized":
             return self._run_gap_average_host(clusters, config)
         return self._run_gap_average_mesh(clusters, config)
 
@@ -807,7 +823,7 @@ class TpuBackend:
         if len(representatives) != len(clusters):
             raise ValueError("representatives and clusters must align")
         _check_no_empty(clusters)
-        if self.mesh is None:
+        if self.mesh is None and self.layout != "bucketized":
             return self._average_cosines_flat(representatives, clusters, config)
         space = config.mz_space
         out = np.zeros((len(clusters),), dtype=np.float64)
@@ -910,7 +926,7 @@ class TpuBackend:
         kernel and its D2H stream — on tunneled hosts the device->host
         link runs at ~25 MB/s, so the consensus transfer is the pipeline's
         critical path and the host would otherwise sit idle under it."""
-        if self.mesh is not None:
+        if self.mesh is not None or self.layout == "bucketized":
             reps = self.run_bin_mean(clusters, bin_config)
             return reps, self.average_cosines(reps, clusters, cos_config)
 
@@ -1213,9 +1229,12 @@ class TpuBackend:
                 spec_elem = np.full(n_pad, s_real, dtype=np.int32)
                 spec_elem[:n] = (spec_elem_all[p0:p1] - s0).astype(np.int32)
                 # rep lookup: last element of the matching rep run
-                pos = (
-                    np.searchsorted(rkey, mkey, side="right") - 1
-                ).astype(np.int32)
+                # (threaded native searchsorted — ~3M queries per batch)
+                from specpride_tpu.ops.segsort import searchsorted_right_i32
+
+                pos = (searchsorted_right_i32(rkey, mkey) - 1).astype(
+                    np.int32
+                )
                 # rep-norm cutoff position per spectrum
                 npos = np.zeros(s_pad, dtype=np.int32)
                 npos[:s_real] = np.searchsorted(

@@ -41,16 +41,86 @@ def _add_backend(p: argparse.ArgumentParser) -> None:
         "--backend", choices=["numpy", "tpu"], default="tpu",
         help="numpy oracle or batched device execution (default tpu)",
     )
+    p.add_argument(
+        "--layout", choices=["auto", "flat", "bucketized"], default="auto",
+        help="mesh-less device layout (escape hatch: 'bucketized' forces "
+        "the (B, K) paths mesh runs use)",
+    )
+    p.add_argument(
+        "--mesh", action="store_true",
+        help="shard device batches over ALL visible devices (single-host "
+        "multi-chip; implied by --coordinator)",
+    )
+    p.add_argument(
+        "--coordinator", metavar="HOST:PORT",
+        help="multi-host: jax.distributed coordinator address; every "
+        "process runs the same command with its own --process-id and "
+        "writes <output>.part<id> (merge with `specpride merge-parts`)",
+    )
+    p.add_argument("--num-processes", type=int,
+                   help="multi-host: total process count")
+    p.add_argument("--process-id", type=int,
+                   help="multi-host: this process's rank")
 
 
-def _get_backend(name: str):
-    if name == "numpy":
+def _get_backend(args):
+    if args.backend == "numpy":
         from specpride_tpu.backends import numpy_backend
 
         return numpy_backend
     from specpride_tpu.backends.tpu_backend import TpuBackend
 
-    return TpuBackend()
+    mesh = None
+    if getattr(args, "coordinator", None) or getattr(args, "mesh", False):
+        from specpride_tpu.parallel.mesh import (
+            cluster_mesh,
+            initialize_distributed,
+        )
+
+        initialize_distributed(
+            getattr(args, "coordinator", None),
+            getattr(args, "num_processes", None),
+            getattr(args, "process_id", None),
+        )
+        mesh = cluster_mesh()
+        logger.info(
+            "device mesh: %d devices, %d processes",
+            mesh.size, _process_count(),
+        )
+    return TpuBackend(mesh=mesh, layout=getattr(args, "layout", "auto"))
+
+
+def _process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def _shard_for_process(clusters: list, args) -> tuple[list, str]:
+    """Multi-host input sharding: each process takes a contiguous BLOCK of
+    clusters (block order keeps `merge-parts` output identical to a
+    single-host run) and writes ``<output>.part<id>``.  Single-process runs
+    pass through untouched (BASELINE config 5; survey §2 parallelism).
+
+    Also renames any ``--checkpoint`` to a per-rank manifest — the rank
+    comes from ``jax.process_index()`` (NOT ``--process-id``, which may be
+    absent when jax auto-detects ranks), so manifests never collide on a
+    shared filesystem."""
+    if not getattr(args, "coordinator", None):
+        return clusters, args.output
+    import jax
+
+    pid, nproc = jax.process_index(), jax.process_count()
+    chunk = -(-len(clusters) // max(nproc, 1))
+    mine = clusters[pid * chunk : (pid + 1) * chunk]
+    part = f"{args.output}.part{pid:05d}"
+    if getattr(args, "checkpoint", None):
+        args.checkpoint = f"{args.checkpoint}.part{pid:05d}"
+    logger.info(
+        "process %d/%d: %d of %d clusters -> %s",
+        pid, nproc, len(mine), len(clusters), part,
+    )
+    return mine, part
 
 
 def _load_scores(args) -> dict[str, float]:
@@ -216,7 +286,8 @@ def cmd_consensus(args) -> int:
         # would crash the backends.
         spectra = [s for c in clusters for s in c.members]
         clusters = [Cluster(args.output, spectra)] if spectra else []
-    backend = _get_backend(args.backend)
+    backend = _get_backend(args)
+    clusters, args.output = _shard_for_process(clusters, args)
     _checkpointed_run(backend, args.method, clusters, args, stats)
     logger.info(
         "consensus done: %.1f clusters/sec", stats.throughput("clusters")
@@ -228,10 +299,51 @@ def cmd_consensus(args) -> int:
 def cmd_select(args) -> int:
     stats = RunStats()
     clusters = _load_clusters(args.input, stats)
-    backend = _get_backend(args.backend)
+    backend = _get_backend(args)
     scores = _load_scores(args) if args.method == "best" else None
+    clusters, args.output = _shard_for_process(clusters, args)
     _checkpointed_run(backend, args.method, clusters, args, stats, scores)
     print(json.dumps(stats.summary()), file=sys.stderr)
+    return 0
+
+
+def cmd_merge_parts(args) -> int:
+    """Concatenate multi-host ``<output>.part<id>`` shards (block-sharded,
+    so part order == cluster order) into the final file.  Refuses on a
+    gap in the rank sequence — a missing part means a rank never finished
+    and a silent merge would drop a contiguous block of clusters."""
+    import glob
+    import shutil
+
+    parts = sorted(glob.glob(f"{args.output}.part*"))
+    if not parts:
+        print(f"no part files match {args.output}.part*", file=sys.stderr)
+        return 1
+    ranks = []
+    for p in parts:
+        suffix = p.rsplit(".part", 1)[1]
+        if not suffix.isdigit():
+            print(f"unrecognized part name {p}", file=sys.stderr)
+            return 1
+        ranks.append(int(suffix))
+    expected = args.num_processes or len(parts)
+    missing = sorted(set(range(expected)) - set(ranks))
+    if missing or len(ranks) != len(set(ranks)):
+        print(
+            f"incomplete part set for {args.output}: have ranks {ranks}, "
+            f"missing {missing} — refusing to merge a gapped sequence "
+            "(pass --num-processes to pin the expected count)",
+            file=sys.stderr,
+        )
+        return 1
+    with open(args.output, "wb") as out:
+        for p in parts:
+            with open(p, "rb") as fh:
+                shutil.copyfileobj(fh, out)  # streams: parts can be huge
+    if args.remove_parts:
+        for p in parts:
+            os.remove(p)
+    logger.info("merged %d parts -> %s", len(parts), args.output)
     return 0
 
 
@@ -269,7 +381,10 @@ def cmd_evaluate(args) -> int:
         results = metrics.evaluate(
             [p[0] for p in pairs],
             [p[1] for p in pairs],
-            backend=args.backend,
+            # a constructed backend so --mesh/--layout/--coordinator apply
+            backend=(
+                "numpy" if args.backend == "numpy" else _get_backend(args)
+            ),
             cosine_config=CosineConfig(),
         )
     summary = metrics.summarize(results)
@@ -388,6 +503,18 @@ def build_parser() -> argparse.ArgumentParser:
     pe.add_argument("--report", help="write per-cluster report to this path")
     pe.add_argument("--format", choices=["json", "csv"], default="json")
     pe.set_defaults(fn=cmd_evaluate)
+
+    pm = sub.add_parser(
+        "merge-parts",
+        help="concatenate multi-host <output>.part<id> shards in order",
+    )
+    pm.add_argument("output", help="final output path (parts are "
+                    "<output>.part00000, <output>.part00001, ...)")
+    pm.add_argument("--num-processes", type=int,
+                    help="expected part count (refuse to merge fewer)")
+    pm.add_argument("--remove-parts", action="store_true",
+                    help="delete the part files after a successful merge")
+    pm.set_defaults(fn=cmd_merge_parts)
 
     pp = sub.add_parser("plot", help="mirror plots for one cluster")
     pp.add_argument("clustered")

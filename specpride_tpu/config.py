@@ -14,7 +14,10 @@ import dataclasses
 from typing import Literal
 
 
-Backend = Literal["numpy", "tpu", "pallas"]
+# "pallas" is deliberately NOT a backend: Pallas is a kernel
+# implementation detail inside the tpu backend (ops.pallas_kernels),
+# selected per-kernel by measurement, not a user-facing execution mode
+Backend = Literal["numpy", "tpu"]
 
 
 @dataclasses.dataclass(frozen=True)

@@ -96,8 +96,28 @@ def available() -> bool:
     return _load() is not None
 
 
+# every artifact `make -C native` produces: ensure_built must not
+# short-circuit on the parser alone, or a tree that built the parser
+# before the other libraries existed never compiles them (and their
+# callers silently fall back to single-threaded numpy paths)
+_ALL_NATIVE_LIBS = (
+    "libmgf_parser.so", "libgap_average.so", "libsegsort.so"
+)
+
+
+def _native_dir() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(os.path.dirname(here)), "native")
+
+
+def _all_built() -> bool:
+    d = _native_dir()
+    return all(os.path.exists(os.path.join(d, n)) for n in _ALL_NATIVE_LIBS)
+
+
 def ensure_built(quiet: bool = True) -> bool:
-    """Build the native library in-tree if missing and a toolchain exists.
+    """Build the native libraries in-tree if missing and a toolchain
+    exists.
 
     Returns ``available()`` afterwards; never raises on build failure (the
     Python parser remains the fallback).  A failed build is attempted only
@@ -107,18 +127,15 @@ def ensure_built(quiet: bool = True) -> bool:
     ``.so`` (advisor r2); the build subprocess deliberately runs under its
     own lock, not ``_lock``, so loads already in flight aren't blocked."""
     global _load_failed, _build_attempted
-    if available():
-        return True
+    if _all_built():
+        return available()
     with _build_lock:
-        if available():
-            return True
+        if _all_built():
+            return available()
         if _build_attempted:
             return False
         _build_attempted = True
-        here = os.path.dirname(os.path.abspath(__file__))
-        native_dir = os.path.join(
-            os.path.dirname(os.path.dirname(here)), "native"
-        )
+        native_dir = _native_dir()
         if not os.path.exists(os.path.join(native_dir, "Makefile")):
             return False
         try:
@@ -133,6 +150,27 @@ def ensure_built(quiet: bool = True) -> bool:
         with _lock:
             _load_failed = False  # retry the load now that the build ran
     return available()
+
+
+def load_native(lib_name: str, env_var: str, bind) -> ctypes.CDLL | None:
+    """Shared soft-failing loader for the sibling native libraries
+    (``ops.gap_native``, ``ops.segsort``): ensure the in-tree build ran,
+    then dlopen+bind the named library from the env override or
+    ``native/``.  Returns None when unavailable — callers fall back to
+    their numpy paths."""
+    ensure_built()
+    paths = []
+    env = os.environ.get(env_var)
+    if env:
+        paths.append(env)
+    paths.append(os.path.join(_native_dir(), lib_name))
+    for path in paths:
+        if os.path.exists(path):
+            try:
+                return bind(ctypes.CDLL(path))
+            except (OSError, AttributeError):
+                continue
+    return None
 
 
 def _as_array(ptr, n: int, dtype) -> np.ndarray:

@@ -41,11 +41,14 @@ class ClusterQuality:
 def evaluate(
     representatives: Sequence[Spectrum],
     clusters: Sequence[Cluster],
-    backend: str = "tpu",
+    backend="tpu",
     cosine_config: CosineConfig = CosineConfig(),
     fragment_config: FragmentConfig = FragmentConfig(),
 ) -> list[ClusterQuality]:
-    """Score each representative against its cluster."""
+    """Score each representative against its cluster.
+
+    ``backend``: "numpy", "tpu", or a constructed ``TpuBackend`` (the CLI
+    passes one so --mesh/--layout take effect here too)."""
     if len(representatives) != len(clusters):
         raise ValueError("representatives and clusters must align")
 
@@ -59,9 +62,11 @@ def evaluate(
             ]
         )
     else:
-        from specpride_tpu.backends.tpu_backend import TpuBackend
+        if backend == "tpu":
+            from specpride_tpu.backends.tpu_backend import TpuBackend
 
-        cosines = TpuBackend().average_cosines(
+            backend = TpuBackend()
+        cosines = backend.average_cosines(
             list(representatives), list(clusters), cosine_config
         )
 

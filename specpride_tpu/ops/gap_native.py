@@ -9,26 +9,13 @@ when unbuilt), reusing the same one-shot ``make -C native`` bootstrap."""
 from __future__ import annotations
 
 import ctypes
-import os
 import threading
 
 import numpy as np
 
-_LIB_NAME = "libgap_average.so"
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _load_failed = False
-
-
-def _candidate_paths() -> list[str]:
-    here = os.path.dirname(os.path.abspath(__file__))
-    repo_root = os.path.dirname(os.path.dirname(here))
-    paths = []
-    env = os.environ.get("SPECPRIDE_GAP_LIB")
-    if env:
-        paths.append(env)
-    paths.append(os.path.join(repo_root, "native", _LIB_NAME))
-    return paths
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -57,19 +44,11 @@ def _load() -> ctypes.CDLL | None:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        # reuse the parser's one-shot in-tree build (make all builds both)
-        from specpride_tpu.io import native as _io_native
+        from specpride_tpu.io.native import load_native
 
-        _io_native.ensure_built()
-        for path in _candidate_paths():
-            if os.path.exists(path):
-                try:
-                    _lib = _bind(ctypes.CDLL(path))
-                    return _lib
-                except OSError:
-                    continue
-        _load_failed = True
-        return None
+        _lib = load_native("libgap_average.so", "SPECPRIDE_GAP_LIB", _bind)
+        _load_failed = _lib is None
+        return _lib
 
 
 def available() -> bool:

@@ -1,0 +1,155 @@
+"""Pallas TPU kernels (the hand-scheduled alternatives to the XLA
+formulations in ``ops.segments``).
+
+One kernel lives here: ``seg_scan_pallas``, a single-pass segmented
+inclusive scan over sorted run keys — the core primitive of the flat
+bin-mean consensus (K1).  The XLA formulation (``segments.seg_scan``)
+needs log2(lcap) full-array shift/select passes and a packer-guaranteed
+bound on run length; the Pallas version streams blocks through VMEM once,
+carrying the open run's partial sums across the sequential grid in SMEM —
+exact for ANY run length, one HBM read + one write per element.
+
+Measured A/B on the 2000-cluster bench workload (v5e, 4M peaks, 3 value
+channels) lives in ``BENCH_METHODS.json`` under ``pallas_ab``; the driver
+(``backends.tpu_backend``) keeps the XLA path as the default because the
+end-to-end flat bin-mean is device->host-transfer-bound, not scan-bound —
+the A/B exists to keep the claim honest either way (VERDICT r3 ask #4).
+
+Import is lazy and soft: ``available()`` is False off-TPU (tests run the
+kernel in interpreter mode explicitly).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLK_ROWS = 8  # sublane dim of one grid step's block (TPU: multiple of 8)
+BLK_LANES = 2048  # lane dim (TPU: multiple of 128)
+BLK = BLK_ROWS * BLK_LANES  # elements per grid step
+
+
+def _seg_scan_block_kernel(
+    key_ref, w_ref, x_ref, y_ref,  # inputs (BLK_ROWS, BLK_LANES)
+    ow_ref, ox_ref, oy_ref,  # outputs (BLK_ROWS, BLK_LANES)
+    carry_key, carry_sums,  # SMEM scratch: (1,) i32, (3,) f32
+):
+    """One grid step: within-block segmented scan + cross-block carry.
+
+    The (BLK_ROWS, BLK_LANES) tile is one contiguous row-major span of
+    the flat axis.  Mosaic has no 1-D reshape/cumsum lowerings, so the
+    scan is lane-axis Hillis-Steele per row followed by a statically
+    unrolled row chain (8 rows), and open-run prefixes are detected by
+    key equality (keys are sorted: a row's leading run is exactly
+    ``key == key[row, 0]``)."""
+    i = pl.program_id(0)
+
+    key = key_ref[:]
+    vs = [w_ref[:], x_ref[:], y_ref[:]]
+
+    # per-row lane scan: starts at lane 0 and at key changes.  Shifts use
+    # pltpu.roll + iota masks with INT32 flags — Mosaic has no lowering
+    # for concatenating or rolling bool vectors.
+    col = jax.lax.broadcasted_iota(
+        jnp.int32, (BLK_ROWS, BLK_LANES), 1
+    )
+    prev = jnp.where(col >= 1, pltpu.roll(key, 1, 1), key - 1)
+    f = jnp.where(
+        (col == 0) | (key != prev), jnp.int32(1), jnp.int32(0)
+    )
+    d = 1
+    while d < BLK_LANES:
+        fs = jnp.where(col >= d, pltpu.roll(f, d, 1), jnp.int32(1))
+        vs = [
+            jnp.where(
+                f == 1, v,
+                v + jnp.where(col >= d, pltpu.roll(v, d, 1), 0.0),
+            )
+            for v in vs
+        ]
+        f = f | fs
+        d *= 2
+
+    # chain rows (and the previous block into row 0) — static unroll
+    rows = [[v[r : r + 1, :] for r in range(BLK_ROWS)] for v in vs]
+    krows = [key[r : r + 1, :] for r in range(BLK_ROWS)]
+    cont0 = (
+        (krows[0] == krows[0][0, 0])
+        & (krows[0][0, 0] == carry_key[0])
+        & (i > 0)
+    )
+    carries = [carry_sums[0], carry_sums[1], carry_sums[2]]
+    for c in range(3):
+        rows[c][0] = rows[c][0] + jnp.where(cont0, carries[c], 0.0)
+    for r in range(1, BLK_ROWS):
+        ck = krows[r - 1][0, BLK_LANES - 1]
+        cont = (krows[r] == krows[r][0, 0]) & (krows[r][0, 0] == ck)
+        for c in range(3):
+            rows[c][r] = rows[c][r] + jnp.where(
+                cont, rows[c][r - 1][0, BLK_LANES - 1], 0.0
+            )
+
+    for ref, c in ((ow_ref, 0), (ox_ref, 1), (oy_ref, 2)):
+        ref[:] = jnp.concatenate(rows[c], axis=0)
+
+    carry_key[0] = key[BLK_ROWS - 1, BLK_LANES - 1]
+    for c in range(3):
+        carry_sums[c] = rows[c][BLK_ROWS - 1][0, BLK_LANES - 1]
+
+
+def seg_scan_pallas(
+    keys: jax.Array,  # (N,) i32 sorted run keys; N a multiple of BLK
+    w: jax.Array,  # (N,) f32
+    x: jax.Array,  # (N,) f32
+    y: jax.Array,  # (N,) f32
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Segmented inclusive prefix sums of (w, x, y) within runs of equal
+    ``keys`` — the Pallas single-pass equivalent of
+    ``segments.seg_scan(run_starts(keys), (w, x, y), lcap)`` with no run
+    length bound."""
+    n = keys.shape[0]
+    assert n % BLK == 0, n
+    nb = n // BLK
+    rows = nb * BLK_ROWS
+    spec = pl.BlockSpec((BLK_ROWS, BLK_LANES), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _seg_scan_block_kernel,
+        grid=(nb,),
+        in_specs=[spec] * 4,
+        out_specs=[spec] * 3,
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, BLK_LANES), jnp.float32)
+            for _ in range(3)
+        ],
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((3,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        keys.reshape(rows, BLK_LANES),
+        w.reshape(rows, BLK_LANES),
+        x.reshape(rows, BLK_LANES),
+        y.reshape(rows, BLK_LANES),
+    )
+    return tuple(o.reshape(n) for o in out)
+
+
+def available() -> bool:
+    """True when Pallas TPU lowering is usable on the default backend."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # backend init failure — no device path at all
+        return False
+
+
+try:  # pallas imports kept at module scope for the kernel body
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - pallas ships with jax on TPU
+    pl = None
+    pltpu = None

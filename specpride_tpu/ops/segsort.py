@@ -10,7 +10,6 @@ lexsort."""
 from __future__ import annotations
 
 import ctypes
-import os
 import threading
 
 import numpy as np
@@ -21,36 +20,32 @@ _lib: ctypes.CDLL | None = None
 _load_failed = False
 
 
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    p = ctypes.POINTER
+    lib.seg_argsort_i64.restype = ctypes.c_int
+    lib.seg_argsort_i64.argtypes = [
+        p(ctypes.c_int64), p(ctypes.c_int64),
+        ctypes.c_int64, p(ctypes.c_int64), ctypes.c_int,
+    ]
+    lib.searchsorted_right_i32.restype = ctypes.c_int
+    lib.searchsorted_right_i32.argtypes = [
+        p(ctypes.c_int32), ctypes.c_int64,
+        p(ctypes.c_int32), ctypes.c_int64,
+        p(ctypes.c_int64), ctypes.c_int,
+    ]
+    return lib
+
+
 def _load() -> ctypes.CDLL | None:
     global _lib, _load_failed
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        from specpride_tpu.io import native as _io_native
+        from specpride_tpu.io.native import load_native
 
-        _io_native.ensure_built()
-        here = os.path.dirname(os.path.abspath(__file__))
-        repo_root = os.path.dirname(os.path.dirname(here))
-        paths = [os.path.join(repo_root, "native", _LIB_NAME)]
-        env = os.environ.get("SPECPRIDE_SEGSORT_LIB")
-        if env:
-            paths.insert(0, env)
-        for path in paths:
-            if os.path.exists(path):
-                try:
-                    lib = ctypes.CDLL(path)
-                    p = ctypes.POINTER
-                    lib.seg_argsort_i64.restype = ctypes.c_int
-                    lib.seg_argsort_i64.argtypes = [
-                        p(ctypes.c_int64), p(ctypes.c_int64),
-                        ctypes.c_int64, p(ctypes.c_int64), ctypes.c_int,
-                    ]
-                    _lib = lib
-                    return _lib
-                except OSError:
-                    continue
-        _load_failed = True
-        return None
+        _lib = load_native(_LIB_NAME, "SPECPRIDE_SEGSORT_LIB", _bind)
+        _load_failed = _lib is None
+        return _lib
 
 
 def seg_argsort(
@@ -79,3 +74,23 @@ def seg_argsort(
             np.arange(offsets.size - 1, dtype=np.int64), np.diff(offsets)
         )
     return np.lexsort((keys, seg_of_elem))
+
+
+def searchsorted_right_i32(keys: np.ndarray, queries: np.ndarray) -> np.ndarray:
+    """Threaded ``np.searchsorted(keys, queries, side='right')`` for int32
+    arrays (numpy fallback when the native library is absent)."""
+    keys = np.ascontiguousarray(keys, dtype=np.int32)
+    queries = np.ascontiguousarray(queries, dtype=np.int32)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(queries.size, dtype=np.int64)
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        p64 = ctypes.POINTER(ctypes.c_int64)
+        rc = lib.searchsorted_right_i32(
+            keys.ctypes.data_as(p32), keys.size,
+            queries.ctypes.data_as(p32), queries.size,
+            out.ctypes.data_as(p64), 0,
+        )
+        if rc == 0:
+            return out
+    return np.searchsorted(keys, queries, side="right")

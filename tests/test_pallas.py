@@ -1,0 +1,56 @@
+"""Pallas segmented-scan kernel: interpreter-mode correctness vs the XLA
+formulation and a f64 reference (the on-chip A/B perf numbers live in
+BENCH_METHODS.json; CI has no TPU, so only semantics are checked here)."""
+
+import numpy as np
+import pytest
+
+from specpride_tpu.ops import pallas_kernels as pk
+
+
+def reference_seg_sums(keys, vals):
+    starts = np.concatenate([[True], keys[1:] != keys[:-1]])
+    out = np.zeros(vals.size)
+    acc = 0.0
+    for i in range(vals.size):
+        acc = vals[i] if starts[i] else acc + vals[i]
+        out[i] = acc
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_seg_scan_pallas_interpret(seed):
+    if pk.pl is None:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(seed)
+    n = 2 * pk.BLK  # two blocks: exercises the cross-block carry
+    # runs of widely varying length, including one spanning the block edge
+    lens = []
+    while sum(lens) < n:
+        lens.append(int(rng.integers(1, pk.BLK // 2)))
+    keys = np.repeat(np.arange(len(lens)), lens)[:n].astype(np.int32)
+    w = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    x = rng.uniform(0.0, 1e4, n).astype(np.float32)
+    y = rng.uniform(0.0, 1e4, n).astype(np.float32)
+
+    ow, ox, oy = pk.seg_scan_pallas(keys, w, x, y, interpret=True)
+    for got, vals in ((ow, w), (ox, x), (oy, y)):
+        np.testing.assert_allclose(
+            np.asarray(got), reference_seg_sums(keys, vals.astype(np.float64)),
+            rtol=1e-5,
+        )
+
+
+def test_seg_scan_pallas_run_spanning_many_blocks():
+    """A run longer than several blocks — the XLA path needs lcap >= run
+    length; the Pallas carry is exact for any length."""
+    if pk.pl is None:
+        pytest.skip("pallas unavailable")
+    n = 4 * pk.BLK
+    keys = np.zeros(n, dtype=np.int32)  # ONE run covering everything
+    keys[-pk.BLK // 2 :] = 7  # plus a tail run
+    w = np.ones(n, dtype=np.float32)
+    ow, _, _ = pk.seg_scan_pallas(keys, w, w, w, interpret=True)
+    ow = np.asarray(ow)
+    assert ow[n - pk.BLK // 2 - 1] == n - pk.BLK // 2  # long run's last
+    assert ow[-1] == pk.BLK // 2  # tail run restarts
