@@ -356,6 +356,8 @@ class TpuBackend:
                         ),
                         config=config,
                         total_cap=cap,
+                        # dedup bounds (row, bin) runs at the member count
+                        lcap=_pow2(int(batch.n_members.max(initial=1))),
                     )
                 pending.append((batch, lo, hi, cap, fused))
 

@@ -183,10 +183,12 @@ def _checkpointed_run(
     done: set[str] = set()
     output_bytes: int | None = None  # None: manifest predates offset tracking
     restarted = False  # a resume state was found unusable and discarded
+    prior_failed: list[str] = []  # failures recorded by an earlier attempt
     if args.checkpoint and os.path.exists(args.checkpoint):
         with open(args.checkpoint) as fh:
             manifest = json.load(fh)
         done = set(manifest.get("done", []))
+        prior_failed = list(manifest.get("failed", []))
         raw = manifest.get("output_bytes")
         output_bytes = None if raw is None else int(raw)
         out_size = (
@@ -242,10 +244,43 @@ def _checkpointed_run(
         first_write = False
     chunk = args.checkpoint_every if args.checkpoint else len(todo) or 1
 
+    # carry failures recorded by an interrupted earlier attempt — a resume
+    # must not silently erase the record of clusters it never produced
+    failed: list[str] = list(prior_failed)
+    on_error = getattr(args, "on_error", "abort")
     for start in range(0, len(todo), chunk):
         part = todo[start : start + chunk]
-        with stats.phase("compute"):
-            reps = _run_method(backend, method, part, args, scores=scores)
+        try:
+            with stats.phase("compute"):
+                reps = _run_method(backend, method, part, args, scores=scores)
+        except (ValueError, RuntimeError) as e:
+            # per-chunk failure isolation (survey §5 failure detection):
+            # with --on-error skip, a chunk whose input is bad (e.g. mixed
+            # charge states) is retried cluster-by-cluster so only the
+            # offending clusters are dropped — logged and recorded in the
+            # manifest, never silently
+            if on_error != "skip":
+                raise
+            logger.warning(
+                "chunk of %d clusters failed (%s); retrying one by one",
+                len(part), e,
+            )
+            reps, bad_part = [], []
+            with stats.phase("compute"):
+                for c in part:
+                    try:
+                        reps.extend(
+                            _run_method(
+                                backend, method, [c], args, scores=scores
+                            )
+                        )
+                    except (ValueError, RuntimeError) as ce:
+                        logger.warning(
+                            "skipping cluster %s: %s", c.cluster_id, ce
+                        )
+                        bad_part.append(c.cluster_id)
+            failed.extend(bad_part)
+            stats.count("clusters_failed", len(bad_part))
         with stats.phase("write"):
             write_mgf(reps, args.output, append=not first_write)
         first_write = False
@@ -257,9 +292,20 @@ def _checkpointed_run(
             tmp = args.checkpoint + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump(
-                    {"done": sorted(done), "output_bytes": output_bytes}, fh
+                    {
+                        "done": sorted(done),
+                        "output_bytes": output_bytes,
+                        **({"failed": sorted(failed)} if failed else {}),
+                    },
+                    fh,
                 )
             os.replace(tmp, args.checkpoint)
+    if failed:
+        logger.warning(
+            "%d clusters failed and were skipped: %s%s",
+            len(failed), ", ".join(failed[:5]),
+            "..." if len(failed) > 5 else "",
+        )
 
 
 def _load_clusters(path: str, stats: RunStats) -> list[Cluster]:
@@ -467,6 +513,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append to the output instead of replacing it")
     pc.add_argument("--checkpoint", help="resume manifest path")
     pc.add_argument("--checkpoint-every", type=int, default=512)
+    pc.add_argument(
+        "--on-error", choices=["abort", "skip"], default="abort",
+        help="chunk failure handling: abort (default) or retry the chunk "
+        "cluster-by-cluster, log + record failures, and continue",
+    )
     pc.set_defaults(fn=cmd_consensus)
 
     ps = sub.add_parser("select", help="pick an existing member per cluster")
@@ -485,6 +536,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append to the output instead of replacing it")
     ps.add_argument("--checkpoint", help="resume manifest path")
     ps.add_argument("--checkpoint-every", type=int, default=512)
+    ps.add_argument(
+        "--on-error", choices=["abort", "skip"], default="abort",
+        help="chunk failure handling: abort (default) or retry the chunk "
+        "cluster-by-cluster, log + record failures, and continue",
+    )
     ps.set_defaults(fn=cmd_select)
 
     pv = sub.add_parser("convert", help="build the clustered-MGF interchange file")

@@ -111,8 +111,25 @@ def _native_dir() -> str:
 
 
 def _all_built() -> bool:
+    """Every library exists and is no older than the sources — a stale
+    ``.so`` missing a newly added symbol would otherwise short-circuit the
+    build and silently drop its callers to their numpy fallbacks."""
     d = _native_dir()
-    return all(os.path.exists(os.path.join(d, n)) for n in _ALL_NATIVE_LIBS)
+    try:
+        newest_src = max(
+            os.path.getmtime(os.path.join(d, f))
+            for f in os.listdir(d)
+            if f.endswith(".cpp") or f == "Makefile"
+        )
+    except (OSError, ValueError):
+        return all(
+            os.path.exists(os.path.join(d, n)) for n in _ALL_NATIVE_LIBS
+        )
+    for n in _ALL_NATIVE_LIBS:
+        p = os.path.join(d, n)
+        if not os.path.exists(p) or os.path.getmtime(p) < newest_src:
+            return False
+    return True
 
 
 def ensure_built(quiet: bool = True) -> bool:

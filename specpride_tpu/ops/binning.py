@@ -25,46 +25,45 @@ from specpride_tpu.config import BinMeanConfig
 
 
 def _bin_mean_deduped_stats(
-    mz: jax.Array,  # (K,) f32, row PRE-SORTED by bin
-    intensity: jax.Array,  # (K,) f32, same order
-    bins: jax.Array,  # (K,) i32 NON-DECREASING, sentinel = n_bins (padding)
-    n_members: jax.Array,  # () i32
+    mz: jax.Array,  # (B, K) f32, rows PRE-SORTED by bin
+    intensity: jax.Array,  # (B, K) f32, same order
+    bins: jax.Array,  # (B, K) i32 NON-DECREASING, sentinel = n_bins
+    n_members: jax.Array,  # (B,) i32
     config: BinMeanConfig,
+    lcap: int | None = None,
 ):
-    """Per-cluster per-bin stats (mz mean, intensity mean, keep mask) in
-    segment-id positions — the vmappable core of ``bin_mean_deduped``.
+    """Per-cluster per-bin stats (mz mean, intensity mean, keep mask) at
+    RUN-END positions — the (B, K) core of ``bin_mean_deduped_compact``.
 
-    ``bins`` must be non-decreasing per row (the packer sorts on the host —
-    device-side stable sorts were the dominant kernel cost on TPU); the
-    kernel is pure segment detection + sorted segment sums."""
-    k = bins.shape[0]
+    ``bins`` must be non-decreasing per row (the packer sorts on the host
+    — device-side stable sorts were the dominant kernel cost on TPU); the
+    reductions are row-local segmented scans (``ops.segments.seg_scan2d``
+    — TPU scatter-adds with duplicate indices serialize, which made the
+    earlier vmapped ``segment_sum`` formulation the kernel's cost).
+    ``lcap`` bounds real run lengths (dedup caps a (row, bin) run at the
+    row's member count; K is always safe — the padding run may exceed
+    lcap, but its windowed sums are masked out by ``valid``)."""
+    from specpride_tpu.ops import segments as sg
+
+    k = bins.shape[1]
     n_bins = config.n_bins
 
-    sb = bins
-    valid = sb < n_bins
-
-    new_bin = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), (sb[1:] != sb[:-1]).astype(jnp.int32)]
-    )
-    seg = jnp.cumsum(new_bin)
-
+    valid = bins < n_bins
     w = jnp.where(valid, 1.0, 0.0)
-    counts = jax.ops.segment_sum(w, seg, num_segments=k, indices_are_sorted=True)
-    inten_sum = jax.ops.segment_sum(
-        intensity * w, seg, num_segments=k, indices_are_sorted=True
+    starts = sg.run_starts2d(bins)
+    counts, inten_sum, mz_sum = sg.seg_scan2d(
+        starts, (w, intensity * w, mz * w), lcap or k
     )
-    mz_sum = jax.ops.segment_sum(
-        mz * w, seg, num_segments=k, indices_are_sorted=True
-    )
+    is_end = sg.run_ends2d(starts)
 
     if config.apply_peak_quorum:
         quorum = jnp.floor(
             n_members.astype(jnp.float32) * config.quorum_fraction
         ) + 1.0
     else:
-        quorum = jnp.float32(1.0)
+        quorum = jnp.full(bins.shape[:1], 1.0, jnp.float32)
 
-    keep_bin = counts >= quorum
+    keep_bin = is_end & valid & (counts >= quorum[:, None])
     safe = jnp.maximum(counts, 1.0)
     return mz_sum / safe, inten_sum / safe, keep_bin
 
@@ -140,7 +139,9 @@ def bin_mean_flat_compact(
     return jnp.concatenate([flat_mz, flat_int, n_out])
 
 
-@functools.partial(jax.jit, static_argnames=("config", "total_cap"))
+@functools.partial(
+    jax.jit, static_argnames=("config", "total_cap", "lcap")
+)
 def bin_mean_deduped_compact(
     mz: jax.Array,  # (B, K) f32
     intensity: jax.Array,  # (B, K) f32
@@ -148,6 +149,7 @@ def bin_mean_deduped_compact(
     n_members: jax.Array,  # (B,) i32
     config: BinMeanConfig,
     total_cap: int,
+    lcap: int | None = None,  # pow2 >= max members (run bound); None = K
 ):
     """Globally-compacted deduped binned-mean: one fused 1-D output
     ``[flat_mz (total_cap) | flat_intensity (total_cap) | n_out (B)]``.
@@ -160,9 +162,9 @@ def bin_mean_deduped_compact(
     m/z within a cluster (the reference's grid order, ref src/binning.py:220).
     """
     b, k = mz.shape
-    mz_mean, inten_mean, keep = jax.vmap(
-        lambda a, c, d, e: _bin_mean_deduped_stats(a, c, d, e, config)
-    )(mz, intensity, bins, n_members)
+    mz_mean, inten_mean, keep = _bin_mean_deduped_stats(
+        mz, intensity, bins, n_members, config, lcap
+    )
 
     n_out = jnp.sum(keep, axis=1).astype(jnp.float32)
     flat_keep = keep.reshape(b * k)

@@ -51,34 +51,49 @@ from specpride_tpu.config import GapAverageConfig
 
 
 def _gap_average_segment_stats(
-    mz: jax.Array,  # (K,) f32, sorted ascending (singletons: input order)
-    intensity: jax.Array,  # (K,) f32
-    seg: jax.Array,  # (K,) i32 host-computed segment ids, non-decreasing
-    n_valid: jax.Array,  # () i32 — packed peaks are contiguous
-    quorum: jax.Array,  # () i32 — host-f64 ceil(min_fraction * n_members)
-    n_members: jax.Array,  # () i32
+    mz: jax.Array,  # (B, K) f32, rows sorted ascending (singletons: input)
+    intensity: jax.Array,  # (B, K) f32
+    seg: jax.Array,  # (B, K) i32 host-computed segment ids, non-decreasing
+    n_valid: jax.Array,  # (B,) i32 — packed peaks are contiguous
+    quorum: jax.Array,  # (B,) i32 — host-f64 ceil(min_fraction * n_members)
+    n_members: jax.Array,  # (B,) i32
     config: GapAverageConfig,
 ):
-    """Per-cluster per-group stats (mz mean, intensity, keep mask) in
-    segment-id positions — the vmappable core of ``gap_average_compact``."""
-    k = mz.shape[0]
-    valid = jnp.arange(k) < n_valid
+    """Per-cluster per-group stats (mz mean, intensity, keep mask) at
+    GROUP-END positions — the (B, K) core of ``gap_average_compact``.
+
+    Row-local segmented scans (``ops.segments.seg_scan2d``) replace the
+    vmapped ``segment_sum`` — TPU scatter-adds with duplicate indices
+    serialize — and stay shard-local under a cluster-axis mesh."""
+    from specpride_tpu.ops import segments as sg
+
+    b, k = mz.shape
+    valid = jnp.arange(k)[None, :] < n_valid[:, None]
     w = jnp.where(valid, 1.0, 0.0)
 
-    sizes = jax.ops.segment_sum(w, seg, num_segments=k, indices_are_sorted=True)
-    mz_sums = jax.ops.segment_sum(
-        mz * w, seg, num_segments=k, indices_are_sorted=True
+    # padding slots carry seg id 0 (the packer zero-fills, see
+    # data/packed.py pack_bucketize_gap), which would otherwise alias the
+    # row's FIRST group; remap the tail to its own out-of-range run id
+    key = jnp.where(valid, seg, jnp.int32(k + 1))
+    starts = sg.run_starts2d(key)
+    sizes, mz_sums, int_sums = sg.seg_scan2d(
+        starts, (w, mz * w, intensity * w), k
     )
-    int_sums = jax.ops.segment_sum(
-        intensity * w, seg, num_segments=k, indices_are_sorted=True
-    )
+    is_end = sg.run_ends2d(starts)
 
-    nm = n_members.astype(jnp.float32)
+    nm = n_members.astype(jnp.float32)[:, None]
     group_mz = mz_sums / jnp.maximum(sizes, 1.0)
     group_int = int_sums / jnp.maximum(nm, 1.0)
 
-    keep = (sizes > 0) & (sizes >= quorum.astype(jnp.float32))
-    kept_max = jnp.max(jnp.where(keep, group_int, -jnp.inf))
+    keep = (
+        is_end
+        & valid
+        & (sizes > 0)
+        & (sizes >= quorum.astype(jnp.float32)[:, None])
+    )
+    kept_max = jnp.max(
+        jnp.where(keep, group_int, -jnp.inf), axis=1, keepdims=True
+    )
     floor = kept_max / config.dyn_range
     keep &= group_int >= floor
     return group_mz, group_int, keep
@@ -105,11 +120,9 @@ def gap_average_compact(
     row-major: cluster order preserved, ascending m/z within a cluster
     (input order for singletons, matching ref :88-90)."""
     b, k = mz.shape
-    group_mz, group_int, keep = jax.vmap(
-        lambda a, c, d, e, f, g: _gap_average_segment_stats(
-            a, c, d, e, f, g, config
-        )
-    )(mz, intensity, seg, n_valid, quorum, n_members)
+    group_mz, group_int, keep = _gap_average_segment_stats(
+        mz, intensity, seg, n_valid, quorum, n_members, config
+    )
 
     n_out = jnp.sum(keep, axis=1).astype(jnp.float32)
     flat_keep = keep.reshape(b * k)

@@ -76,23 +76,31 @@ def run_end_positions(starts: jnp.ndarray, rcap: int) -> jnp.ndarray:
 
 
 def _seg_scan_combine(
-    starts: jnp.ndarray,  # (N,) bool run starts
-    values: tuple[jnp.ndarray, ...],  # each (N,)
+    starts: jnp.ndarray,  # (..., N) bool run starts, scan along LAST axis
+    values: tuple[jnp.ndarray, ...],  # each (..., N)
     lcap: int,  # static pow2 >= longest real run
     combine,  # elementwise associative op (operator.add / operator.or_)
 ) -> tuple[jnp.ndarray, ...]:
-    """Shared Hillis-Steele core of ``seg_scan`` / ``seg_scan_or``: one
-    flag evolution, log2(lcap) shift/select/combine steps per channel."""
+    """Shared Hillis-Steele core of every segmented scan: one flag
+    evolution, log2(lcap) shift/select/combine steps per channel, along
+    the last axis (1-D flat layouts and (B, K) bucketized rows alike)."""
+    lead = starts.shape[:-1]
     f = starts
     vs = list(values)
     d = 1
     while d < lcap:
-        fs = jnp.concatenate([jnp.ones((d,), bool), f[:-d]])
+        fs = jnp.concatenate(
+            [jnp.ones(lead + (d,), bool), f[..., :-d]], axis=-1
+        )
         vs = [
             jnp.where(
                 f, v,
                 combine(
-                    v, jnp.concatenate([jnp.zeros((d,), v.dtype), v[:-d]])
+                    v,
+                    jnp.concatenate(
+                        [jnp.zeros(lead + (d,), v.dtype), v[..., :-d]],
+                        axis=-1,
+                    ),
                 ),
             )
             for v in vs
@@ -111,6 +119,33 @@ def seg_scan(
     its run from the run start through i (runs longer than ``lcap`` — only
     the padding sentinel run, per the packer's contract — get windowed
     partial sums; callers mask those runs out)."""
+    import operator
+
+    return _seg_scan_combine(starts, values, lcap, operator.add)
+
+
+def run_starts2d(keys: jnp.ndarray) -> jnp.ndarray:
+    """(B, K) bool: element begins a new run within its ROW (keys sorted
+    per row; column 0 always starts)."""
+    first = jnp.ones((keys.shape[0], 1), bool)
+    return jnp.concatenate([first, keys[:, 1:] != keys[:, :-1]], axis=1)
+
+
+def run_ends2d(starts: jnp.ndarray) -> jnp.ndarray:
+    """(B, K) bool: element is the last of its within-row run."""
+    last = jnp.ones((starts.shape[0], 1), bool)
+    return jnp.concatenate([starts[:, 1:], last], axis=1)
+
+
+def seg_scan2d(
+    starts: jnp.ndarray,  # (B, K) bool within-row run starts
+    values: tuple[jnp.ndarray, ...],  # each (B, K)
+    lcap: int,  # static pow2 >= longest run (K always works)
+) -> tuple[jnp.ndarray, ...]:
+    """Row-local segmented inclusive prefix per channel — the (B, K)
+    bucketized layout's counterpart of ``seg_scan``.  Shifts stay within
+    rows, so under a cluster-axis mesh sharding every step is shard-local
+    (a flattened 1-D scan would halo-exchange at every shift)."""
     import operator
 
     return _seg_scan_combine(starts, values, lcap, operator.add)

@@ -161,24 +161,23 @@ def _cosine_packed_cluster(
     directly and to the rep norm via the prefix array.  Device output is
     just the (M,) cosines.
     """
+    from specpride_tpu.ops import segments as sg
+
     sent = jnp.int32(2**30)
     pr = rep_bins.shape[0]
     k = mem_bins.shape[0]
 
-    # --- rep side: per-bin sums + prefix of squared run totals
+    # --- rep side: per-bin run totals via segmented scan (scatter-free —
+    # TPU scatter-adds with duplicate indices serialize) + prefix of
+    # squared totals
     rb = rep_bins
     ri = rep_int
-    r_new = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), (rb[1:] != rb[:-1]).astype(jnp.int32)]
+    r_starts = sg.run_starts(rb)
+    (r_scan,) = sg.seg_scan(
+        r_starts, (jnp.where(rb < sent, ri, 0.0),), pr
     )
-    r_seg = jnp.cumsum(r_new)
-    r_sum_per_seg = jax.ops.segment_sum(
-        jnp.where(rb < sent, ri, 0.0), r_seg, num_segments=pr,
-        indices_are_sorted=True,
-    )
-    r_sum_at = r_sum_per_seg[r_seg]  # run total broadcast to every element
-    r_last = jnp.concatenate([rb[:-1] != rb[1:], jnp.ones((1,), bool)])
-    r_sq_contrib = jnp.where(r_last & (rb < sent), r_sum_at * r_sum_at, 0.0)
+    r_last = sg.run_ends(r_starts)
+    r_sq_contrib = jnp.where(r_last & (rb < sent), r_scan * r_scan, 0.0)
     r_sq_prefix = jnp.cumsum(r_sq_contrib)  # inclusive, in sorted-bin order
 
     # --- member side: already sorted by (member, bin) host-side
@@ -190,40 +189,39 @@ def _cosine_packed_cluster(
     cut_at = cutoff[jnp.clip(sm, 0, m - 1)]
     ok = (sm < m) & (sb < sent) & (sb <= cut_at)
 
-    run_new = jnp.concatenate(
-        [
-            jnp.zeros((1,), jnp.int32),
-            ((sb[1:] != sb[:-1]) | (sm[1:] != sm[:-1])).astype(jnp.int32),
-        ]
-    )
-    run_seg = jnp.cumsum(run_new)
-    run_sum = jax.ops.segment_sum(
-        jnp.where(ok, si, 0.0), run_seg, num_segments=k, indices_are_sorted=True
-    )
-    run_sum_at = run_sum[run_seg]
-    is_last = jnp.concatenate(
-        [(sb[:-1] != sb[1:]) | (sm[:-1] != sm[1:]), jnp.ones((1,), bool)]
-    )
+    m_starts = sg.run_starts2(sm, sb)
+    (m_scan,) = sg.seg_scan(m_starts, (jnp.where(ok, si, 0.0),), k)
+    is_last = sg.run_ends(m_starts)
 
-    # rep per-bin sum lookup for each member run
-    pos = jnp.searchsorted(rb, sb, side="left")
+    # rep per-bin sum lookup for each member run: the LAST element of the
+    # matching rep run holds the run total in the scan
+    pos = jnp.searchsorted(rb, sb, side="right") - 1
     pos_c = jnp.clip(pos, 0, pr - 1)
     rep_hit = (rb[pos_c] == sb) & (sb < sent)
-    rep_val = jnp.where(rep_hit, r_sum_per_seg[r_seg[pos_c]], 0.0)
+    rep_val = jnp.where(rep_hit, r_scan[pos_c], 0.0)
 
+    # per-member dot/norm: contributions at run ends, summed by a
+    # member-segmented scan and read at each member's last element, then
+    # placed densely by a tiny (M,)-unique scatter
     contrib_ok = is_last & ok
-    dots = jax.ops.segment_sum(
-        jnp.where(contrib_ok, run_sum_at * rep_val, 0.0),
-        sm,
-        num_segments=m + 1,
-        indices_are_sorted=True,
-    )[:m]
-    norms = jax.ops.segment_sum(
-        jnp.where(contrib_ok, run_sum_at * run_sum_at, 0.0),
-        sm,
-        num_segments=m + 1,
-        indices_are_sorted=True,
-    )[:m]
+    run_tot = jnp.where(is_last, m_scan, 0.0)
+    sm_starts = sg.run_starts(sm)
+    dot_scan, norm_scan = sg.seg_scan(
+        sm_starts,
+        (
+            jnp.where(contrib_ok, run_tot * rep_val, 0.0),
+            jnp.where(contrib_ok, run_tot * run_tot, 0.0),
+        ),
+        k,
+    )
+    # NOTE: midx is NOT sorted (the dropped m-slot interleaves with real
+    # member ids), so no indices_are_sorted hint — TPU miscompiles on a
+    # false claim.  Real indices are unique; the m-slot collisions are
+    # discarded by the [:m] slice.
+    mem_end = sg.run_ends(sm_starts)
+    midx = jnp.where(mem_end & (sm < m), sm, m)
+    dots = jnp.zeros((m + 1,), jnp.float32).at[midx].set(dot_scan)[:m]
+    norms = jnp.zeros((m + 1,), jnp.float32).at[midx].set(norm_scan)[:m]
 
     # rep norm per member: prefix of squared run totals up to the cutoff
     npos = jnp.searchsorted(rb, cutoff + 1, side="left")  # first bin > cutoff

@@ -361,6 +361,46 @@ class TestCli:
             "merge-parts", str(out3), "--num-processes", "3",
         ]) == 1
 
+    def test_on_error_skip_isolates_bad_clusters(self, tmp_path, rng):
+        """--on-error skip retries a failing chunk cluster-by-cluster and
+        drops only the offenders, logged and recorded in the manifest
+        (survey §5 failure detection; default remains abort)."""
+        good = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=15)
+            for i in range(3)
+        ]
+        # mixed charge states make bin-mean raise for this cluster
+        bad = make_cluster(rng, "cluster-bad", n_members=2, n_peaks=15)
+        bad.members[1].precursor_charge = bad.members[0].precursor_charge + 1
+        clusters = good[:2] + [bad] + good[2:]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out = tmp_path / "out.mgf"
+        ckpt = tmp_path / "ckpt.json"
+        # default: abort
+        with pytest.raises(ValueError):
+            cli_main([
+                "consensus", str(clustered), str(tmp_path / "x.mgf"),
+                "--backend", "numpy",
+            ])
+        # skip: the three good clusters come through, failure recorded
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--backend", "numpy",
+            "--on-error", "skip", "--checkpoint", str(ckpt),
+            "--checkpoint-every", "2",
+        ]) == 0
+        assert sorted(s.title for s in read_mgf(out)) == sorted(
+            c.cluster_id for c in good
+        )
+        assert json.loads(ckpt.read_text())["failed"] == ["cluster-bad"]
+        # a resume must not erase the failure record (advisor r4)
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--backend", "numpy",
+            "--on-error", "skip", "--checkpoint", str(ckpt),
+            "--checkpoint-every", "2",
+        ]) == 0
+        assert json.loads(ckpt.read_text())["failed"] == ["cluster-bad"]
+
     def test_select_best_requires_score_source(self, tmp_path, rng):
         cluster = make_cluster(rng, "cluster-0", n_members=2, n_peaks=15)
         clustered = tmp_path / "clustered.mgf"
