@@ -1,0 +1,45 @@
+#!/bin/sh
+# External search + rescoring pipeline (the reference's search.sh:1-7):
+# builds a peptide FASTA from MaxQuant peptides.txt, runs crux tide-index /
+# tide-search against the benchmark mzML, and rescoring with percolator.
+# The percolator PSM TSV it produces feeds straight into
+#
+#   specpride select clustered.mgf best.mgf --method best \
+#       --psms crux/crux-output/percolator.target.psms.txt
+#
+# Requires `crux` (https://crux.ms) on PATH — deliberately NOT vendored:
+# it is the reference's external ground-truth tool, not part of this
+# framework.  awk replaces the reference's gawk (same one-liner).
+#
+#   sh scripts/search.sh [DATA_DIR]     # default: ./data (fetch_data.sh)
+set -eu
+
+DATA="${1:-data}"
+MZML="$DATA/01650b_BA5-TUM_first_pool_75_01_01-3xHCD-1h-R2.mzML"
+PEPTIDES="$DATA/peptides.txt"
+
+command -v crux >/dev/null || {
+    echo "crux not found on PATH (https://crux.ms)" >&2; exit 1; }
+[ -f "$MZML" ] && [ -f "$PEPTIDES" ] || {
+    echo "missing $MZML or $PEPTIDES — run scripts/fetch_data.sh first" >&2
+    exit 1; }
+
+MZML_ABS=$(cd "$(dirname "$MZML")" && pwd)/$(basename "$MZML")
+
+mkdir -p crux
+# peptide sequences -> one-entry-per-peptide FASTA (ref search.sh:3)
+cut -f 1 "$PEPTIDES" | tail -n +2 \
+    | awk '{print ">" $0; print $0}' > crux/pept.fa
+cd crux
+crux tide-index --mods-spec 3M+15.9949 pept.fa pept.idx
+# absolute path: a relative "../$MZML" breaks for absolute DATA_DIRs
+crux tide-search "$MZML_ABS" pept.idx
+crux percolator --overwrite T \
+    crux-output/tide-search.target.txt crux-output/tide-search.decoy.txt
+
+cat <<EOF
+done. rescored PSMs: crux/crux-output/percolator.target.psms.txt
+next:
+  specpride select clustered.mgf best.mgf --method best \\
+      --psms crux/crux-output/percolator.target.psms.txt
+EOF

@@ -741,18 +741,10 @@ class TpuBackend:
                 )
                 sbins = np.take_along_axis(bins, order, axis=1)
                 smm = np.take_along_axis(mm.astype(np.int32), order, axis=1)
-                # OR-scan window: longest REAL same-(row, bin) element run
-                # (sentinel padding runs may saturate — OR is idempotent
-                # and they carry no bits — so break them up in the probe)
-                rowf = np.repeat(
-                    np.arange(sbins.shape[0], dtype=np.int64), k
-                )
-                keyf = rowf * np.int64(1 << 31) + sbins.reshape(-1)
-                posf = np.arange(keyf.size, dtype=np.int64)
-                keyf = np.where(
-                    sbins.reshape(-1) >= 2**30, -posf - 1, keyf
-                )
-                lcap = _pow2(_max_run_len(keyf), floor=16)
+                # OR-scan window: K always bounds a run, and the exact
+                # bound costs several full host passes over (B, K) int64
+                # to compute — a few extra device scan steps are cheaper
+                lcap = _pow2(k)
             # largest device intermediate is the (K*M,) run×member
             # occupancy; allow it 4x the element budget (1 GB of f32 on a
             # 16 GB chip) — every extra chunk is a dispatch round-trip,

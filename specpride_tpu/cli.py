@@ -204,6 +204,7 @@ def _checkpointed_run(
             # no output on disk -> nothing a redo could duplicate, so this
             # restart is safe even under --append
             done, output_bytes = set(), 0
+            prior_failed = []  # the redo retries them; stale records lie
         elif output_bytes is not None and out_size is not None and (
             out_size < output_bytes
         ):
@@ -215,6 +216,7 @@ def _checkpointed_run(
                 "restarting from scratch", args.output, out_size, output_bytes,
             )
             done, output_bytes, restarted = set(), 0, True
+            prior_failed = []  # the redo retries them; stale records lie
         elif output_bytes is not None and out_size is not None and (
             out_size > output_bytes
         ):
@@ -246,7 +248,8 @@ def _checkpointed_run(
 
     # carry failures recorded by an interrupted earlier attempt — a resume
     # must not silently erase the record of clusters it never produced
-    failed: list[str] = list(prior_failed)
+    # (dict-as-ordered-set: a cluster failing again must not double-count)
+    failed: dict[str, None] = dict.fromkeys(prior_failed)
     on_error = getattr(args, "on_error", "abort")
     for start in range(0, len(todo), chunk):
         part = todo[start : start + chunk]
@@ -279,7 +282,7 @@ def _checkpointed_run(
                             "skipping cluster %s: %s", c.cluster_id, ce
                         )
                         bad_part.append(c.cluster_id)
-            failed.extend(bad_part)
+            failed.update(dict.fromkeys(bad_part))
             stats.count("clusters_failed", len(bad_part))
         with stats.phase("write"):
             write_mgf(reps, args.output, append=not first_write)
@@ -303,7 +306,7 @@ def _checkpointed_run(
     if failed:
         logger.warning(
             "%d clusters failed and were skipped: %s%s",
-            len(failed), ", ".join(failed[:5]),
+            len(failed), ", ".join(list(failed)[:5]),
             "..." if len(failed) > 5 else "",
         )
 

@@ -37,7 +37,7 @@ def _bin_mean_deduped_stats(
 
     ``bins`` must be non-decreasing per row (the packer sorts on the host
     — device-side stable sorts were the dominant kernel cost on TPU); the
-    reductions are row-local segmented scans (``ops.segments.seg_scan2d``
+    reductions are row-local segmented scans (``ops.segments.seg_scan``
     — TPU scatter-adds with duplicate indices serialize, which made the
     earlier vmapped ``segment_sum`` formulation the kernel's cost).
     ``lcap`` bounds real run lengths (dedup caps a (row, bin) run at the
@@ -51,7 +51,7 @@ def _bin_mean_deduped_stats(
     valid = bins < n_bins
     w = jnp.where(valid, 1.0, 0.0)
     starts = sg.run_starts2d(bins)
-    counts, inten_sum, mz_sum = sg.seg_scan2d(
+    counts, inten_sum, mz_sum = sg.seg_scan(
         starts, (w, intensity * w, mz * w), lcap or k
     )
     is_end = sg.run_ends2d(starts)

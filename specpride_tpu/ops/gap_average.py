@@ -62,7 +62,7 @@ def _gap_average_segment_stats(
     """Per-cluster per-group stats (mz mean, intensity, keep mask) at
     GROUP-END positions — the (B, K) core of ``gap_average_compact``.
 
-    Row-local segmented scans (``ops.segments.seg_scan2d``) replace the
+    Row-local segmented scans (``ops.segments.seg_scan``) replace the
     vmapped ``segment_sum`` — TPU scatter-adds with duplicate indices
     serialize — and stay shard-local under a cluster-axis mesh."""
     from specpride_tpu.ops import segments as sg
@@ -76,7 +76,7 @@ def _gap_average_segment_stats(
     # row's FIRST group; remap the tail to its own out-of-range run id
     key = jnp.where(valid, seg, jnp.int32(k + 1))
     starts = sg.run_starts2d(key)
-    sizes, mz_sums, int_sums = sg.seg_scan2d(
+    sizes, mz_sums, int_sums = sg.seg_scan(
         starts, (w, mz * w, intensity * w), k
     )
     is_end = sg.run_ends2d(starts)

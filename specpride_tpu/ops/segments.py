@@ -111,14 +111,17 @@ def _seg_scan_combine(
 
 
 def seg_scan(
-    starts: jnp.ndarray,  # (N,) bool run starts
-    values: tuple[jnp.ndarray, ...],  # each (N,)
+    starts: jnp.ndarray,  # (..., N) bool run starts, scan along last axis
+    values: tuple[jnp.ndarray, ...],  # each (..., N)
     lcap: int,  # static pow2 >= longest real run
 ) -> tuple[jnp.ndarray, ...]:
     """Segmented inclusive prefix per channel: element i gets the sum of
     its run from the run start through i (runs longer than ``lcap`` — only
     the padding sentinel run, per the packer's contract — get windowed
-    partial sums; callers mask those runs out)."""
+    partial sums; callers mask those runs out).  Works on flat (N,) layouts
+    and (B, K) bucketized rows alike (scan along the last axis); row-local
+    shifts stay shard-local under a cluster-axis mesh, where a flattened
+    1-D scan would halo-exchange at every step."""
     import operator
 
     return _seg_scan_combine(starts, values, lcap, operator.add)
@@ -135,20 +138,6 @@ def run_ends2d(starts: jnp.ndarray) -> jnp.ndarray:
     """(B, K) bool: element is the last of its within-row run."""
     last = jnp.ones((starts.shape[0], 1), bool)
     return jnp.concatenate([starts[:, 1:], last], axis=1)
-
-
-def seg_scan2d(
-    starts: jnp.ndarray,  # (B, K) bool within-row run starts
-    values: tuple[jnp.ndarray, ...],  # each (B, K)
-    lcap: int,  # static pow2 >= longest run (K always works)
-) -> tuple[jnp.ndarray, ...]:
-    """Row-local segmented inclusive prefix per channel — the (B, K)
-    bucketized layout's counterpart of ``seg_scan``.  Shifts stay within
-    rows, so under a cluster-axis mesh sharding every step is shard-local
-    (a flattened 1-D scan would halo-exchange at every shift)."""
-    import operator
-
-    return _seg_scan_combine(starts, values, lcap, operator.add)
 
 
 def seg_scan_or(
