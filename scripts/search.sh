@@ -31,9 +31,9 @@ mkdir -p crux
 cut -f 1 "$PEPTIDES" | tail -n +2 \
     | awk '{print ">" $0; print $0}' > crux/pept.fa
 cd crux
-crux tide-index --mods-spec 3M+15.9949 pept.fa pept.idx
+crux tide-index --overwrite T --mods-spec 3M+15.9949 pept.fa pept.idx
 # absolute path: a relative "../$MZML" breaks for absolute DATA_DIRs
-crux tide-search "$MZML_ABS" pept.idx
+crux tide-search --overwrite T "$MZML_ABS" pept.idx
 crux percolator --overwrite T \
     crux-output/tide-search.target.txt crux-output/tide-search.decoy.txt
 
