@@ -23,7 +23,7 @@ Architecture (TPU-first, not a port):
 * ``specpride_tpu.metrics``  quality metrics on device
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 from specpride_tpu.config import (
     BinMeanConfig,
