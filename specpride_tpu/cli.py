@@ -77,23 +77,43 @@ def _get_backend(args):
             initialize_distributed,
         )
 
+        import jax
+
         initialize_distributed(
             getattr(args, "coordinator", None),
             getattr(args, "num_processes", None),
             getattr(args, "process_id", None),
         )
-        mesh = cluster_mesh()
+        # clusters are independent, so scale-out is pure data parallelism:
+        # each process owns a block of clusters and runs them on its OWN
+        # devices.  A global mesh would force every process to device_put
+        # identical global arrays (jax asserts it) — exactly wrong for
+        # sharded inputs, and it buys nothing when no collective ever
+        # crosses hosts.
+        # a silently failed bring-up (e.g. a PJRT plugin overriding the
+        # platform) leaves every process believing it is rank 0 of 1 —
+        # all would then compute the FULL input and overwrite the same
+        # part file, so fail loudly instead
+        want = getattr(args, "num_processes", None)
+        if (
+            getattr(args, "coordinator", None)
+            and want
+            and jax.process_count() != want
+        ):
+            raise SystemExit(
+                f"distributed bring-up failed: jax reports "
+                f"{jax.process_count()} process(es), --num-processes said "
+                f"{want} (is another PJRT plugin overriding the platform?)"
+            )
+        local = (
+            jax.local_devices() if jax.process_count() > 1 else None
+        )
+        mesh = cluster_mesh(local)
         logger.info(
-            "device mesh: %d devices, %d processes",
-            mesh.size, _process_count(),
+            "device mesh: %d local devices, %d processes",
+            mesh.size, jax.process_count(),
         )
     return TpuBackend(mesh=mesh, layout=getattr(args, "layout", "auto"))
-
-
-def _process_count() -> int:
-    import jax
-
-    return jax.process_count()
 
 
 def _shard_for_process(clusters: list, args) -> tuple[list, str]:

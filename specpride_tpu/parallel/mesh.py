@@ -7,10 +7,13 @@ with ``NamedSharding(mesh, P("clusters", None, ...))``; the jitted vmapped
 kernels then SPMD-partition with no collectives in the hot loop (XLA inserts
 only the final all-gather when the host fetches results).
 
-Multi-host: ``initialize_distributed`` wraps ``jax.distributed.initialize``;
-after it, ``cluster_mesh()`` spans the full pod (ICI within a slice, DCN
-across slices) and each host feeds its own file shard (BASELINE.json
-config 5).
+Multi-host: ``initialize_distributed`` wraps ``jax.distributed.initialize``
+for rank discovery; each process then builds ``cluster_mesh(jax.local_
+devices())`` over its OWN chips and runs its block of clusters — clusters
+are independent, so no collective ever crosses hosts, and a pod-global
+mesh would force every process to ``device_put`` identical global arrays
+(jax asserts exactly that), which block-sharded inputs violate by design
+(BASELINE.json config 5; see docs/distributed.md).
 """
 
 from __future__ import annotations
