@@ -279,7 +279,7 @@ class TpuBackend:
     def _collect(self, arrays):
         """Fetch all pending device results to host.  Every blocking read
         pays a full tunnel round trip (~0.1 s measured) and the D2H link is
-        the pipeline bottleneck (~25 MB/s vs ~220 MB/s H2D), so ALL copies
+        the pipeline bottleneck (~25 MB/s vs ~1.4 GB/s H2D), so ALL copies
         start asynchronously before the first blocking read — transfers
         overlap each other and the still-running kernels."""
         if self.sync_timing:
