@@ -136,6 +136,10 @@ def _shard_for_process(clusters: list, args) -> tuple[list, str]:
     part = f"{args.output}.part{pid:05d}"
     if getattr(args, "checkpoint", None):
         args.checkpoint = f"{args.checkpoint}.part{pid:05d}"
+    if getattr(args, "qc_report", None):
+        # per-rank QC shards too — every rank writing the same JSON path
+        # would leave a last-writer-wins report covering one shard
+        args.qc_report = f"{args.qc_report}.part{pid:05d}"
     logger.info(
         "process %d/%d: %d of %d clusters -> %s",
         pid, nproc, len(mine), len(clusters), part,
@@ -164,21 +168,53 @@ def _load_scores(args) -> dict[str, float]:
     )
 
 
-def _run_method(backend, method: str, clusters, args, scores=None):
+def _cosines_of(backend, reps, clusters):
+    """Mean member cosine per cluster on whichever backend is active."""
+    if hasattr(backend, "average_cosines"):  # device backend: one dispatch
+        return backend.average_cosines(reps, clusters)
+    return [
+        backend.average_cosine(r, c.members) for r, c in zip(reps, clusters)
+    ]
+
+
+def _append_qc_rows(qc: list, clusters, cosines) -> None:
+    qc.extend(
+        {"cluster_id": c.cluster_id, "n_members": c.n_members,
+         "avg_cosine": float(v)}
+        for c, v in zip(clusters, cosines)
+    )
+
+
+def _run_method(backend, method: str, clusters, args, scores=None,
+                qc: list | None = None):
     if method == "bin-mean":
         config = BinMeanConfig(
             min_mz=args.min_mz, max_mz=args.max_mz, bin_size=args.bin_size,
             apply_peak_quorum=not args.no_quorum,
             quorum_fraction=args.quorum_fraction,
         )
-        return backend.run_bin_mean(clusters, config)
+        if qc is not None and hasattr(backend, "run_bin_mean_with_cosines"):
+            # fused consensus + QC: the cosine member prep overlaps the
+            # consensus D2H stream (see TpuBackend.run_bin_mean_with_cosines)
+            reps, cosines = backend.run_bin_mean_with_cosines(
+                clusters, config, CosineConfig()
+            )
+            _append_qc_rows(qc, clusters, cosines)
+            return reps
+        reps = backend.run_bin_mean(clusters, config)
+        if qc is not None:
+            _append_qc_rows(qc, clusters, _cosines_of(backend, reps, clusters))
+        return reps
     if method == "gap-average":
         config = GapAverageConfig(
             mz_accuracy=args.mz_accuracy, dyn_range=args.dyn_range,
             min_fraction=args.min_fraction, tail_mode=args.tail_mode,
             pepmass=args.pepmass, rt=args.rt,
         )
-        return backend.run_gap_average(clusters, config)
+        reps = backend.run_gap_average(clusters, config)
+        if qc is not None:
+            _append_qc_rows(qc, clusters, _cosines_of(backend, reps, clusters))
+        return reps
     if method == "medoid":
         return backend.run_medoid(clusters, MedoidConfig(bin_size=args.xcorr_bin))
     if method == "best":
@@ -191,7 +227,8 @@ def _run_method(backend, method: str, clusters, args, scores=None):
 
 
 def _checkpointed_run(
-    backend, method, clusters, args, stats: RunStats, scores=None
+    backend, method, clusters, args, stats: RunStats, scores=None,
+    qc: list | None = None,
 ):
     """Chunked execution with a resume manifest (survey §5).
 
@@ -275,7 +312,9 @@ def _checkpointed_run(
         part = todo[start : start + chunk]
         try:
             with stats.phase("compute"):
-                reps = _run_method(backend, method, part, args, scores=scores)
+                reps = _run_method(
+                    backend, method, part, args, scores=scores, qc=qc
+                )
         except (ValueError, RuntimeError) as e:
             # per-chunk failure isolation (survey §5 failure detection):
             # with --on-error skip, a chunk whose input is bad (e.g. mixed
@@ -294,7 +333,8 @@ def _checkpointed_run(
                     try:
                         reps.extend(
                             _run_method(
-                                backend, method, [c], args, scores=scores
+                                backend, method, [c], args,
+                                scores=scores, qc=qc,
                             )
                         )
                     except (ValueError, RuntimeError) as ce:
@@ -357,7 +397,57 @@ def cmd_consensus(args) -> int:
         clusters = [Cluster(args.output, spectra)] if spectra else []
     backend = _get_backend(args)
     clusters, args.output = _shard_for_process(clusters, args)
-    _checkpointed_run(backend, args.method, clusters, args, stats)
+    qc = [] if getattr(args, "qc_report", None) else None
+    _checkpointed_run(backend, args.method, clusters, args, stats, qc=qc)
+    if qc is not None:
+        # a resume skips clusters already in the manifest, so their cosines
+        # were never computed this run — recompute them from the reps
+        # already in the output so the report always covers the full input
+        have = {row["cluster_id"] for row in qc}
+        missing = [
+            c for c in clusters
+            if c.cluster_id not in have and c.n_members > 0
+        ]
+        if missing:
+            reps_by_id = {
+                s.cluster_id: s for s in read_mgf(args.output)
+            }
+            pairs = [
+                (reps_by_id[c.cluster_id], c)
+                for c in missing
+                if c.cluster_id in reps_by_id
+            ]
+            if pairs:
+                with stats.phase("compute"):
+                    _append_qc_rows(
+                        qc,
+                        [c for _, c in pairs],
+                        _cosines_of(
+                            backend, [r for r, _ in pairs],
+                            [c for _, c in pairs],
+                        ),
+                    )
+        order = {c.cluster_id: i for i, c in enumerate(clusters)}
+        qc.sort(key=lambda row: order.get(row["cluster_id"], len(order)))
+        cosines = [row["avg_cosine"] for row in qc]
+        import statistics
+
+        report = {
+            "summary": {
+                "n_clusters": len(qc),
+                "mean_cosine": (
+                    statistics.fmean(cosines) if cosines else None
+                ),
+                "median_cosine": (
+                    statistics.median(cosines) if cosines else None
+                ),
+            },
+            "clusters": qc,
+        }
+        with open(args.qc_report, "w") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+        logger.info("QC report -> %s", args.qc_report)
     logger.info(
         "consensus done: %.1f clusters/sec", stats.throughput("clusters")
     )
@@ -540,6 +630,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--on-error", choices=["abort", "skip"], default="abort",
         help="chunk failure handling: abort (default) or retry the chunk "
         "cluster-by-cluster, log + record failures, and continue",
+    )
+    pc.add_argument(
+        "--qc-report", metavar="FILE",
+        help="also compute each representative's mean member cosine in the "
+        "same pass (bin-mean: fused with the consensus dispatch) and write "
+        "the per-cluster QC report here",
     )
     pc.set_defaults(fn=cmd_consensus)
 

@@ -361,6 +361,72 @@ class TestCli:
             "merge-parts", str(out3), "--num-processes", "3",
         ]) == 1
 
+    @pytest.mark.parametrize("method,backend", [
+        ("bin-mean", "tpu"), ("bin-mean", "numpy"), ("gap-average", "tpu"),
+    ])
+    def test_consensus_qc_report(self, tmp_path, rng, method, backend):
+        """--qc-report computes each representative's mean member cosine in
+        the same run (fused with the consensus dispatch on the device
+        bin-mean path) and must match `evaluate` on the written reps."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=25)
+            for i in range(5)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out = tmp_path / "reps.mgf"
+        qc = tmp_path / "qc.json"
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--method", method,
+            "--backend", backend, "--qc-report", str(qc),
+        ]) == 0
+        report = json.loads(qc.read_text())
+        assert [r["cluster_id"] for r in report["clusters"]] == [
+            c.cluster_id for c in clusters
+        ]
+        # cross-check against the evaluate flow (numpy oracle cosines)
+        from specpride_tpu.backends import numpy_backend as nb
+
+        reps = read_mgf(out)
+        want = [
+            nb.average_cosine(r, c.members) for r, c in zip(reps, clusters)
+        ]
+        got = [r["avg_cosine"] for r in report["clusters"]]
+        np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-5)
+        assert report["summary"]["n_clusters"] == 5
+        assert 0 < report["summary"]["mean_cosine"] <= 1.0
+
+    def test_qc_report_complete_after_resume(self, tmp_path, rng):
+        """A resumed --qc-report run must still cover EVERY cluster: the
+        manifest skips done clusters, so their cosines are recomputed from
+        the reps already in the output (advisor r4: a silent half-report)."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=20)
+            for i in range(6)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out, ckpt, qc = (
+            tmp_path / "o.mgf", tmp_path / "ck.json", tmp_path / "qc.json"
+        )
+        # simulate a crash after 4 clusters: run them, keep the manifest
+        from specpride_tpu.backends import numpy_backend as nb
+
+        write_mgf(nb.run_bin_mean(clusters[:4]), out)
+        ckpt.write_text(json.dumps({
+            "done": [c.cluster_id for c in clusters[:4]],
+            "output_bytes": out.stat().st_size,
+        }))
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--backend", "numpy",
+            "--checkpoint", str(ckpt), "--qc-report", str(qc),
+        ]) == 0
+        report = json.loads(qc.read_text())
+        assert report["summary"]["n_clusters"] == 6
+        assert [r["cluster_id"] for r in report["clusters"]] == [
+            c.cluster_id for c in clusters
+        ]
+
     def test_on_error_skip_isolates_bad_clusters(self, tmp_path, rng):
         """--on-error skip retries a failing chunk cluster-by-cluster and
         drops only the offenders, logged and recorded in the manifest
