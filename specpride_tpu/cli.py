@@ -303,6 +303,12 @@ def _checkpointed_run(
         first_write = False
     chunk = args.checkpoint_every if args.checkpoint else len(todo) or 1
 
+    if not todo:
+        # zero clusters (empty input / empty shard): still produce an
+        # output file so downstream steps see a result, not ENOENT
+        # (append mode opens 'a' — creates without truncating user content)
+        write_mgf([], args.output, append=not first_write)
+
     # carry failures recorded by an interrupted earlier attempt — a resume
     # must not silently erase the record of clusters it never produced
     # (dict-as-ordered-set: a cluster failing again must not double-count)

@@ -396,6 +396,25 @@ class TestCli:
         assert report["summary"]["n_clusters"] == 5
         assert 0 < report["summary"]["mean_cosine"] <= 1.0
 
+    def test_empty_input_writes_empty_output(self, tmp_path):
+        """Zero clusters still produce an (empty) output file, so
+        downstream steps see a result instead of ENOENT."""
+        clustered = tmp_path / "empty.mgf"
+        clustered.write_text("")
+        out = tmp_path / "out.mgf"
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--backend", "numpy",
+        ]) == 0
+        assert out.exists() and out.stat().st_size == 0
+        assert read_mgf(out) == []
+        # --append on a fresh path also creates the file ('a' mode)
+        out2 = tmp_path / "out2.mgf"
+        assert cli_main([
+            "consensus", str(clustered), str(out2), "--backend", "numpy",
+            "--append",
+        ]) == 0
+        assert out2.exists() and out2.stat().st_size == 0
+
     def test_qc_report_complete_after_resume(self, tmp_path, rng):
         """A resumed --qc-report run must still cover EVERY cluster: the
         manifest skips done clusters, so their cosines are recomputed from
