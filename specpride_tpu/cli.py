@@ -502,7 +502,9 @@ def cmd_merge_parts(args) -> int:
         )
         return 1
     with open(args.output, "wb") as out:
-        for p in parts:
+        # order by parsed rank, not lexically: hand-renamed mixed-width
+        # names (part2 vs part00010) would otherwise merge out of order
+        for _, p in sorted(zip(ranks, parts)):
             with open(p, "rb") as fh:
                 shutil.copyfileobj(fh, out)  # streams: parts can be huge
     if args.remove_parts:

@@ -434,8 +434,9 @@ class TestCosineParity:
         device = backend.average_cosines([s], [Cluster("c1", [s])])
         np.testing.assert_allclose(device, [1.0], rtol=1e-5)
 
+    @pytest.mark.parametrize("layout", ["auto", "bucketized"])
     @pytest.mark.parametrize("ratio", [1e2, 1e3, 1e6])
-    def test_mixed_intensity_scales(self, rng, backend, ratio):
+    def test_mixed_intensity_scales(self, rng, layout, ratio):
         """Members (and clusters) whose intensity scales differ by orders
         of magnitude share device blocks; per-spectrum sums must not lose
         the small spectrum's bits to a large block-mate (the advisor's r4
@@ -457,7 +458,7 @@ class TestCosineParity:
         oracle = np.array(
             [nb.average_cosine(r, c.members) for r, c in zip(reps, clusters)]
         )
-        device = backend.average_cosines(reps, clusters)
+        device = TpuBackend(layout=layout).average_cosines(reps, clusters)
         np.testing.assert_allclose(oracle, device, rtol=5e-5, atol=5e-5)
 
     def test_fused_pipeline_matches_composition(self, rng, backend):
