@@ -461,6 +461,30 @@ class TestCosineParity:
         device = TpuBackend(layout=layout).average_cosines(reps, clusters)
         np.testing.assert_allclose(oracle, device, rtol=5e-5, atol=5e-5)
 
+    @pytest.mark.parametrize("layout", ["auto", "bucketized"])
+    def test_zero_peak_reps_and_members(self, rng, layout):
+        """Representatives or members with zero peaks (quorum can wipe a
+        consensus; converters can emit empty spectra) must yield cosine 0
+        for the affected pairs, matching the oracle, not crash."""
+        full = make_cluster(rng, "c-full", n_members=3, n_peaks=20)
+        empty_rep = Spectrum(
+            mz=[], intensity=[], precursor_mz=500.0, precursor_charge=2,
+            title="c-full",
+        )
+        mixed = Cluster("c-mixed", [
+            Spectrum(mz=[], intensity=[], precursor_mz=500.0,
+                     precursor_charge=2, title="c-mixed;u0"),
+            full.members[0],
+        ])
+        clusters = [full, mixed, full]
+        reps = [empty_rep, nb.run_bin_mean([mixed])[0], nb.run_bin_mean([full])[0]]
+        oracle = np.array(
+            [nb.average_cosine(r, c.members) for r, c in zip(reps, clusters)]
+        )
+        device = TpuBackend(layout=layout).average_cosines(reps, clusters)
+        np.testing.assert_allclose(device, oracle, rtol=5e-5, atol=1e-5)
+        assert device[0] == 0.0  # empty rep -> no shared signal
+
     def test_fused_pipeline_matches_composition(self, rng, backend):
         """run_bin_mean_with_cosines (the overlapped consensus+QC pass)
         must equal run_bin_mean followed by average_cosines."""
