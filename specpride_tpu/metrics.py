@@ -132,13 +132,22 @@ def write_report(
                 indent=1,
             )
     elif fmt == "csv":
-        with open(path, "w", encoding="utf-8") as fh:
-            fh.write("cluster_id,n_members,n_peaks,avg_cosine,by_fraction\n")
+        import csv
+
+        with open(path, "w", encoding="utf-8", newline="") as fh:
+            # quotes ids containing commas/quotes; LF terminator (csv's
+            # default CRLF would make every report diff against older
+            # LF-only output and confuse line-oriented tools)
+            w = csv.writer(fh, lineterminator="\n")
+            w.writerow(
+                ["cluster_id", "n_members", "n_peaks", "avg_cosine",
+                 "by_fraction"]
+            )
             for r in results:
                 frac = "" if r.by_fraction is None else f"{r.by_fraction:.6f}"
-                fh.write(
-                    f"{r.cluster_id},{r.n_members},{r.n_peaks},"
-                    f"{r.avg_cosine:.6f},{frac}\n"
+                w.writerow(
+                    [r.cluster_id, r.n_members, r.n_peaks,
+                     f"{r.avg_cosine:.6f}", frac]
                 )
     else:
         raise ValueError(f"unknown report format {fmt!r}")

@@ -1,5 +1,6 @@
 """End-to-end pipeline tests: mzML I/O, converter, metrics, viz, CLI."""
 
+import dataclasses
 import json
 import os
 
@@ -160,6 +161,17 @@ class TestMetrics:
         metrics.write_report(results, str(report))
         data = json.loads(report.read_text())
         assert len(data["clusters"]) == 3
+        # CSV format, including a cluster id that needs quoting
+        results[0] = dataclasses.replace(results[0], cluster_id='a,"b"')
+        csv_path = tmp_path / "report.csv"
+        metrics.write_report(results, str(csv_path), fmt="csv")
+        import csv as _csv
+
+        rows = list(_csv.reader(csv_path.open()))
+        assert rows[0][0] == "cluster_id" and len(rows) == 4
+        assert rows[1][0] == 'a,"b"'  # round-trips through quoting
+        with pytest.raises(ValueError):
+            metrics.write_report(results, str(csv_path), fmt="xml")
 
     def test_by_fraction_with_peptide(self, rng):
         c = make_cluster(rng, n_members=2, n_peaks=30)
