@@ -185,6 +185,59 @@ def _append_qc_rows(qc: list, clusters, cosines) -> None:
     )
 
 
+def _write_qc_report(
+    args, backend, clusters, qc: list, stats, resumed_ids: set[str]
+) -> None:
+    """Finalize and write the per-cluster QC report.
+
+    A resume skips clusters already in the manifest, so their cosines were
+    never computed this run — recompute them from the representatives
+    already in the output, so the report always covers the full input.
+    Only resume-skipped ids are candidates: clusters a method deliberately
+    dropped (scoreless best-spectrum, --on-error skip) must not trigger a
+    futile re-parse of the whole output."""
+    have = {row["cluster_id"] for row in qc}
+    missing = [
+        c for c in clusters
+        if c.cluster_id in resumed_ids
+        and c.cluster_id not in have
+        and c.n_members > 0
+    ]
+    if missing:
+        reps_by_id = {s.cluster_id: s for s in read_mgf(args.output)}
+        pairs = [
+            (reps_by_id[c.cluster_id], c)
+            for c in missing
+            if c.cluster_id in reps_by_id
+        ]
+        if pairs:
+            with stats.phase("compute"):
+                _append_qc_rows(
+                    qc,
+                    [c for _, c in pairs],
+                    _cosines_of(
+                        backend, [r for r, _ in pairs], [c for _, c in pairs]
+                    ),
+                )
+    order = {c.cluster_id: i for i, c in enumerate(clusters)}
+    qc.sort(key=lambda row: order.get(row["cluster_id"], len(order)))
+    cosines = [row["avg_cosine"] for row in qc]
+    import statistics
+
+    report = {
+        "summary": {
+            "n_clusters": len(qc),
+            "mean_cosine": statistics.fmean(cosines) if cosines else None,
+            "median_cosine": statistics.median(cosines) if cosines else None,
+        },
+        "clusters": qc,
+    }
+    with open(args.qc_report, "w") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    logger.info("QC report -> %s", args.qc_report)
+
+
 def _run_method(backend, method: str, clusters, args, scores=None,
                 qc: list | None = None):
     if method == "bin-mean":
@@ -201,22 +254,18 @@ def _run_method(backend, method: str, clusters, args, scores=None,
             )
             _append_qc_rows(qc, clusters, cosines)
             return reps
-        reps = backend.run_bin_mean(clusters, config)
-        if qc is not None:
-            _append_qc_rows(qc, clusters, _cosines_of(backend, reps, clusters))
-        return reps
+        return backend.run_bin_mean(clusters, config)
     if method == "gap-average":
         config = GapAverageConfig(
             mz_accuracy=args.mz_accuracy, dyn_range=args.dyn_range,
             min_fraction=args.min_fraction, tail_mode=args.tail_mode,
             pepmass=args.pepmass, rt=args.rt,
         )
-        reps = backend.run_gap_average(clusters, config)
-        if qc is not None:
-            _append_qc_rows(qc, clusters, _cosines_of(backend, reps, clusters))
-        return reps
+        return backend.run_gap_average(clusters, config)
     if method == "medoid":
-        return backend.run_medoid(clusters, MedoidConfig(bin_size=args.xcorr_bin))
+        return backend.run_medoid(
+            clusters, MedoidConfig(bin_size=args.xcorr_bin)
+        )
     if method == "best":
         if scores is None:
             scores = _load_scores(args)
@@ -286,6 +335,7 @@ def _checkpointed_run(
         logger.info("resuming: %d clusters already done", len(done))
 
     todo = [c for c in clusters if c.cluster_id not in done]
+    resumed_ids = set(done)  # skipped THIS run (QC recomputes only these)
     stats.count("clusters_skipped_done", len(clusters) - len(todo))
     first_write = not done if output_bytes is None else output_bytes == 0
     if getattr(args, "append", False):
@@ -316,6 +366,7 @@ def _checkpointed_run(
     on_error = getattr(args, "on_error", "abort")
     for start in range(0, len(todo), chunk):
         part = todo[start : start + chunk]
+        n_qc_before = len(qc) if qc is not None else 0
         try:
             with stats.phase("compute"):
                 reps = _run_method(
@@ -350,6 +401,28 @@ def _checkpointed_run(
                         bad_part.append(c.cluster_id)
             failed.update(dict.fromkeys(bad_part))
             stats.count("clusters_failed", len(bad_part))
+        if qc is not None and len(qc) == n_qc_before and reps:
+            # ONE QC site for every non-fused method (the fused bin-mean
+            # path appends inside _run_method, detected by len(qc)):
+            # align reps to clusters by id — best-spectrum may drop
+            # scoreless clusters — and never let a QC failure veto the
+            # representatives the method already produced
+            try:
+                by_id = {r.cluster_id: r for r in reps}
+                kept = [c for c in part if c.cluster_id in by_id]
+                with stats.phase("compute"):
+                    _append_qc_rows(
+                        qc, kept,
+                        _cosines_of(
+                            backend,
+                            [by_id[c.cluster_id] for c in kept], kept,
+                        ),
+                    )
+            except (ValueError, RuntimeError) as e:
+                logger.warning(
+                    "QC cosines failed for a %d-cluster chunk (%s); "
+                    "their rows are omitted from the report", len(part), e,
+                )
         with stats.phase("write"):
             write_mgf(reps, args.output, append=not first_write)
         first_write = False
@@ -375,6 +448,7 @@ def _checkpointed_run(
             len(failed), ", ".join(list(failed)[:5]),
             "..." if len(failed) > 5 else "",
         )
+    return resumed_ids
 
 
 def _load_clusters(path: str, stats: RunStats) -> list[Cluster]:
@@ -404,56 +478,11 @@ def cmd_consensus(args) -> int:
     backend = _get_backend(args)
     clusters, args.output = _shard_for_process(clusters, args)
     qc = [] if getattr(args, "qc_report", None) else None
-    _checkpointed_run(backend, args.method, clusters, args, stats, qc=qc)
+    resumed = _checkpointed_run(
+        backend, args.method, clusters, args, stats, qc=qc
+    )
     if qc is not None:
-        # a resume skips clusters already in the manifest, so their cosines
-        # were never computed this run — recompute them from the reps
-        # already in the output so the report always covers the full input
-        have = {row["cluster_id"] for row in qc}
-        missing = [
-            c for c in clusters
-            if c.cluster_id not in have and c.n_members > 0
-        ]
-        if missing:
-            reps_by_id = {
-                s.cluster_id: s for s in read_mgf(args.output)
-            }
-            pairs = [
-                (reps_by_id[c.cluster_id], c)
-                for c in missing
-                if c.cluster_id in reps_by_id
-            ]
-            if pairs:
-                with stats.phase("compute"):
-                    _append_qc_rows(
-                        qc,
-                        [c for _, c in pairs],
-                        _cosines_of(
-                            backend, [r for r, _ in pairs],
-                            [c for _, c in pairs],
-                        ),
-                    )
-        order = {c.cluster_id: i for i, c in enumerate(clusters)}
-        qc.sort(key=lambda row: order.get(row["cluster_id"], len(order)))
-        cosines = [row["avg_cosine"] for row in qc]
-        import statistics
-
-        report = {
-            "summary": {
-                "n_clusters": len(qc),
-                "mean_cosine": (
-                    statistics.fmean(cosines) if cosines else None
-                ),
-                "median_cosine": (
-                    statistics.median(cosines) if cosines else None
-                ),
-            },
-            "clusters": qc,
-        }
-        with open(args.qc_report, "w") as fh:
-            json.dump(report, fh, indent=1)
-            fh.write("\n")
-        logger.info("QC report -> %s", args.qc_report)
+        _write_qc_report(args, backend, clusters, qc, stats, resumed)
     logger.info(
         "consensus done: %.1f clusters/sec", stats.throughput("clusters")
     )
@@ -467,7 +496,12 @@ def cmd_select(args) -> int:
     backend = _get_backend(args)
     scores = _load_scores(args) if args.method == "best" else None
     clusters, args.output = _shard_for_process(clusters, args)
-    _checkpointed_run(backend, args.method, clusters, args, stats, scores)
+    qc = [] if getattr(args, "qc_report", None) else None
+    resumed = _checkpointed_run(
+        backend, args.method, clusters, args, stats, scores, qc=qc
+    )
+    if qc is not None:
+        _write_qc_report(args, backend, clusters, qc, stats, resumed)
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0
 
@@ -667,6 +701,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--on-error", choices=["abort", "skip"], default="abort",
         help="chunk failure handling: abort (default) or retry the chunk "
         "cluster-by-cluster, log + record failures, and continue",
+    )
+    ps.add_argument(
+        "--qc-report", metavar="FILE",
+        help="also compute each representative's mean member cosine and "
+        "write the per-cluster QC report here",
     )
     ps.set_defaults(fn=cmd_select)
 

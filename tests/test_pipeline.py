@@ -427,6 +427,58 @@ class TestCli:
         ]) == 0
         assert out2.exists() and out2.stat().st_size == 0
 
+    def test_select_medoid_qc_report(self, tmp_path, rng):
+        """select --qc-report: the medoid's mean member cosine per cluster
+        (a medoid IS a member, so cosines are high for tight clusters)."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=25,
+                         jitter=0.001)
+            for i in range(4)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out, qc = tmp_path / "med.mgf", tmp_path / "qc.json"
+        assert cli_main([
+            "select", str(clustered), str(out), "--method", "medoid",
+            "--qc-report", str(qc),
+        ]) == 0
+        report = json.loads(qc.read_text())
+        assert report["summary"]["n_clusters"] == 4
+        assert all(0 < r["avg_cosine"] <= 1.0 for r in report["clusters"])
+
+    def test_select_best_qc_report_skips_scoreless(self, tmp_path, rng,
+                                                   raw_spectra):
+        """select --method best --qc-report: scoreless clusters are DROPPED
+        by the method (ref src/best_spectrum.py:170-174), so the QC report
+        covers exactly the produced representatives — no phantom rows, no
+        re-parse of the output hunting for them."""
+        mgf, msms, tsv = write_inputs(tmp_path, raw_spectra)
+        clustered = tmp_path / "clustered.mgf"
+        assert cli_main([
+            "convert", str(mgf), str(clustered),
+            "--msms", str(msms), "--clusters", str(tsv),
+            "--raw-name", "run1.raw",
+        ]) == 0
+        # msms scores cover only SOME scans: drop rows for cluster 2's
+        # scans so that cluster is scoreless
+        lines = msms.read_text().splitlines()
+        kept = [lines[0]] + [
+            ln for ln in lines[1:] if ln.split("\t")[1] in
+            {"100", "101", "102", "103"}
+        ]
+        msms.write_text("\n".join(kept) + "\n")
+        out, qc = tmp_path / "best.mgf", tmp_path / "qc.json"
+        assert cli_main([
+            "select", str(clustered), str(out), "--method", "best",
+            "--backend", "numpy", "--msms", str(msms), "--qc-report", str(qc),
+        ]) == 0
+        reps = read_mgf(out)
+        report = json.loads(qc.read_text())
+        assert len(report["clusters"]) == len(reps) >= 1
+        assert {r["cluster_id"] for r in report["clusters"]} == {
+            s.cluster_id for s in reps
+        }
+
     def test_qc_report_complete_after_resume(self, tmp_path, rng):
         """A resumed --qc-report run must still cover EVERY cluster: the
         manifest skips done clusters, so their cosines are recomputed from
