@@ -1,0 +1,185 @@
+// Multithreaded binned-cosine QC metric (C ABI, loaded via ctypes).
+//
+// Mean binned cosine of each cluster representative to the cluster's
+// members (ref src/benchmark.py:11-38).  Like the gap-average
+// (gap_average.cpp), this is memory-bound group-by work the measured
+// single-chip reality favours on the host: the device kernel
+// (ops/similarity.py:cosine_flat) must ship ~16 bytes per member peak over
+// a ~90 MB/s tunneled H2D link to compute a handful of FLOPs per byte,
+// while this path walks the same peaks in cache at memory speed — so the
+// mesh-less backend calls this when built, keeping the device kernels for
+// sharded mesh runs where the link economics differ.  Exact oracle
+// semantics (backends/numpy_backend.py:binned_cosine), all float64:
+//
+//  * pair grid: edges = arange(-space/2, max(a.mz[-1], b.mz[-1]), space);
+//    fewer than 2 edges -> cosine 0; either spectrum empty -> 0
+//  * bin index floor((mz - edges[0]) / space); peaks outside
+//    [edges[0], edges[-1]] are excluded; a peak exactly at the last edge
+//    folds into the final bin (scipy binned_statistic's right-closed
+//    last bin, idx == n_edges-1 -> n_edges-2)
+//  * per-bin sums accumulate in input order (== ascending m/z for sorted
+//    spectra; unsorted input is stable-sorted by bin, preserving the
+//    oracle's np.add.at accumulation order within each bin)
+//  * cosine = dot / sqrt(na * nb) over the dense grid vectors — computed
+//    sparsely as a sorted-run merge (bins occupied by only one side
+//    contribute zero to the dot); na == 0.0 or nb == 0.0 -> 0 (exact
+//    float compare, as the oracle)
+//
+// Build: make -C native (produces libcosine.so).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <thread>
+#include <utility>
+#include <vector>
+
+namespace {
+
+// Compact per-bin runs of one spectrum on the pair's grid.  Returns runs in
+// ascending-bin order; the accumulation order within a bin is input order
+// (matches np.add.at).
+void build_runs(const double* mz, const double* inten, int64_t n, double e0,
+                double space, int64_t n_edges, double e_last,
+                std::vector<int64_t>& bins, std::vector<double>& sums,
+                std::vector<std::pair<int64_t, double>>& scratch) {
+  bins.clear();
+  sums.clear();
+  bool sorted = true;
+  for (int64_t i = 0; i < n; ++i) {
+    const double m = mz[i];
+    if (!(m >= e0 && m <= e_last)) continue;
+    int64_t b = static_cast<int64_t>(std::floor((m - e0) / space));
+    if (b == n_edges - 1) b = n_edges - 2;  // right-closed last bin
+    if (!bins.empty() && b < bins.back()) {
+      sorted = false;
+      break;
+    }
+    if (!bins.empty() && bins.back() == b) {
+      sums.back() += inten[i];
+    } else {
+      bins.push_back(b);
+      sums.push_back(inten[i]);
+    }
+  }
+  if (sorted) return;
+
+  // unsorted spectrum (the oracle's scatter-add does not care): stable-sort
+  // (bin, intensity) pairs by bin, then merge — input order survives within
+  // each bin, so the per-bin accumulation order still matches np.add.at
+  scratch.clear();
+  for (int64_t i = 0; i < n; ++i) {
+    const double m = mz[i];
+    if (!(m >= e0 && m <= e_last)) continue;
+    int64_t b = static_cast<int64_t>(std::floor((m - e0) / space));
+    if (b == n_edges - 1) b = n_edges - 2;
+    scratch.emplace_back(b, inten[i]);
+  }
+  std::stable_sort(scratch.begin(), scratch.end(),
+                   [](const std::pair<int64_t, double>& a,
+                      const std::pair<int64_t, double>& b) {
+                     return a.first < b.first;
+                   });
+  bins.clear();
+  sums.clear();
+  for (const auto& p : scratch) {
+    if (!bins.empty() && bins.back() == p.first) {
+      sums.back() += p.second;
+    } else {
+      bins.push_back(p.first);
+      sums.push_back(p.second);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out_cos[s] = binned cosine of spectrum s to its cluster's representative.
+// Spectra of cluster c are [cluster_spec_offsets[c], cluster_spec_offsets
+// [c+1]); spectrum s's peaks are [spec_offsets[s], spec_offsets[s+1]);
+// representative c's peaks are [rep_offsets[c], rep_offsets[c+1]).
+int pair_cosines_run(
+    const double* rep_mz,
+    const double* rep_int,
+    const int64_t* rep_offsets,           // (n_clusters + 1,)
+    const double* mem_mz,
+    const double* mem_int,
+    const int64_t* spec_offsets,          // (n_spectra + 1,)
+    const int64_t* cluster_spec_offsets,  // (n_clusters + 1,)
+    int64_t n_clusters,
+    double space,
+    double* out_cos,  // (n_spectra,)
+    int n_threads) {
+  if (space <= 0.0) return 1;
+  if (n_threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    n_threads = hc ? static_cast<int>(hc) : 4;
+  }
+  n_threads = std::min<int64_t>(n_threads, std::max<int64_t>(n_clusters, 1));
+  const double e0 = -space / 2.0;
+
+  std::atomic<int64_t> next{0};
+  auto worker = [&]() {
+    std::vector<int64_t> rb, mb;
+    std::vector<double> rs, ms;
+    std::vector<std::pair<int64_t, double>> scratch;
+    for (;;) {
+      const int64_t c = next.fetch_add(1);
+      if (c >= n_clusters) return;
+      const int64_t r0 = rep_offsets[c], r1 = rep_offsets[c + 1];
+      const int64_t nr = r1 - r0;
+      const double rep_last = nr ? rep_mz[r1 - 1] : 0.0;
+      for (int64_t s = cluster_spec_offsets[c]; s < cluster_spec_offsets[c + 1];
+           ++s) {
+        const int64_t p0 = spec_offsets[s], p1 = spec_offsets[s + 1];
+        const int64_t np_ = p1 - p0;
+        out_cos[s] = 0.0;
+        if (nr == 0 || np_ == 0) continue;
+        // pair grid from the LAST peak of each side (ref src/benchmark.py:20
+        // assumes sorted spectra — the last element, not the max)
+        const double max_mz = std::max(rep_last, mem_mz[p1 - 1]);
+        const double len_d = std::ceil((max_mz - e0) / space);
+        if (!(len_d >= 2.0)) continue;  // <2 edges (also rejects NaN)
+        const int64_t n_edges = static_cast<int64_t>(len_d);
+        // np.arange element i = start + i*step, both rounded once — same
+        // double expression here, so the boundary tests match bitwise
+        const double e_last =
+            e0 + static_cast<double>(n_edges - 1) * space;
+
+        build_runs(rep_mz + r0, rep_int + r0, nr, e0, space, n_edges, e_last,
+                   rb, rs, scratch);
+        build_runs(mem_mz + p0, mem_int + p0, np_, e0, space, n_edges, e_last,
+                   mb, ms, scratch);
+
+        double na = 0.0, nb = 0.0, dot = 0.0;
+        for (double v : rs) na += v * v;  // ascending-bin order, as va @ va
+        for (double v : ms) nb += v * v;
+        if (na == 0.0 || nb == 0.0) continue;  // oracle's exact-zero test
+        size_t i = 0, j = 0;
+        while (i < rb.size() && j < mb.size()) {
+          if (rb[i] == mb[j]) {
+            dot += rs[i] * ms[j];
+            ++i;
+            ++j;
+          } else if (rb[i] < mb[j]) {
+            ++i;
+          } else {
+            ++j;
+          }
+        }
+        out_cos[s] = dot / std::sqrt(na * nb);
+      }
+    }
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+  return 0;
+}
+
+}  // extern "C"

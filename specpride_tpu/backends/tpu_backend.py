@@ -389,16 +389,57 @@ class TpuBackend:
         pending = self._bin_mean_flat_dispatch(clusters, config)
         return self._bin_mean_flat_finish(pending, clusters)
 
+    def _flat_chunk_dispatch(self, batch, config: BinMeanConfig):
+        """Pad one ``FlatBinBatch`` to its size classes and dispatch the
+        fused kernel (one batched H2D put + one jit call); returns
+        ``(device_array, cap, rows)``.  Shared by the serial flat path and
+        the pipelined native path so the argument packing lives once.
+
+        Input padding uses the half-octave classes like the output caps:
+        the measured tunneled H2D link (~90 MB/s with multi-second jitter,
+        round-5 profile) makes input bytes the pipeline's largest single
+        cost — worth one extra XLA compile class per octave."""
+        from specpride_tpu.ops.binning import bin_mean_flat_compact
+
+        sent = np.int32(2**31 - 1)
+        n = batch.gbin.size
+        n_pad = _cap_class(n, floor=1024)
+        rows = len(batch.source_indices)
+        b_cap = _pow2(rows, floor=64)
+        cap = _cap_class(batch.n_distinct_total, floor=1024)
+        rcap = _cap_class(batch.n_distinct_total + 1, floor=1024)
+        # dedup bounds every (row, bin) run at the row's member count
+        lcap = _pow2(int(batch.n_members.max(initial=1)))
+        n_runs = batch.n_distinct_total + (1 if n_pad > n else 0)
+        # padded rows own zero runs: repeat the final extent
+        run_offsets = np.full(b_cap + 1, batch.run_offsets[-1],
+                              dtype=np.int32)
+        run_offsets[: rows + 1] = batch.run_offsets
+        fused = bin_mean_flat_compact(
+            *self._put_batch([
+                np.pad(batch.mz, (0, n_pad - n)),
+                np.pad(batch.intensity, (0, n_pad - n)),
+                np.pad(batch.gbin, (0, n_pad - n), constant_values=sent),
+                np.pad(batch.n_members, (0, b_cap - rows)),
+                run_offsets,
+                np.array([n_runs], dtype=np.int32),
+            ]),
+            config=config,
+            total_cap=cap,
+            b_cap=b_cap,
+            rcap=rcap,
+            lcap=lcap,
+        )
+        return fused, cap, rows
+
     def _bin_mean_flat_dispatch(
         self, clusters: list[Cluster], config: BinMeanConfig
     ):
         """Pack + dispatch all chunks asynchronously and start their D2H
         copies; returns the pending list for ``_bin_mean_flat_finish``."""
         from specpride_tpu.data.packed import pack_flat_bin_mean
-        from specpride_tpu.ops.binning import bin_mean_flat_compact
 
         pending = []
-        sent = np.int32(2**31 - 1)
         st = self.stats
         # the pack call is eager (one vectorized pass over all clusters), so
         # time the call itself, not just iteration
@@ -412,40 +453,8 @@ class TpuBackend:
                 max_elements=self.max_grid_elements // 4,
             )
         for batch in batches:
-            n = batch.gbin.size
-            n_pad = _pow2(n, floor=1024)
-            rows = len(batch.source_indices)
-            b_cap = _pow2(rows, floor=64)
-            # output caps use the finer half-octave classes: these buffers
-            # cross the slow D2H link (inputs at pow2 ride the fast H2D)
-            cap = _cap_class(batch.n_distinct_total, floor=1024)
-            with st.phase("pack"):
-                rcap = _cap_class(batch.n_distinct_total + 1, floor=1024)
-                # dedup bounds every (row, bin) run at the row's member count
-                lcap = _pow2(int(batch.n_members.max(initial=1)))
-                n_runs = batch.n_distinct_total + (1 if n_pad > n else 0)
-                # padded rows own zero runs: repeat the final extent
-                run_offsets = np.full(b_cap + 1, batch.run_offsets[-1],
-                                      dtype=np.int32)
-                run_offsets[: rows + 1] = batch.run_offsets
             with st.phase("dispatch"):
-                fused = bin_mean_flat_compact(
-                    *self._put_batch([
-                        np.pad(batch.mz, (0, n_pad - n)),
-                        np.pad(batch.intensity, (0, n_pad - n)),
-                        np.pad(
-                            batch.gbin, (0, n_pad - n), constant_values=sent
-                        ),
-                        np.pad(batch.n_members, (0, b_cap - rows)),
-                        run_offsets,
-                        np.array([n_runs], dtype=np.int32),
-                    ]),
-                    config=config,
-                    total_cap=cap,
-                    b_cap=b_cap,
-                    rcap=rcap,
-                    lcap=lcap,
-                )
+                fused, cap, rows = self._flat_chunk_dispatch(batch, config)
             # fetch in a background thread now — on the slow device->host
             # link the copy is the critical path, and the caller has host
             # work (the fused pipeline's cosine prep; the next chunk's
@@ -467,20 +476,8 @@ class TpuBackend:
                 fuseds = [p[-1].get() for p in pending]
         with st.phase("finalize"):
             for (batch, rows, cap, _), fused in zip(pending, fuseds):
-                for ci, r_mz, r_int in _iter_compacted(fused, cap, rows):
-                    gi = batch.source_indices[ci]
-                    members = clusters[gi].members
-                    out[gi] = Spectrum(
-                        mz=r_mz,
-                        intensity=r_int,
-                        # exact f64 mean, as the oracle (ref
-                        # src/binning.py:224)
-                        precursor_mz=float(
-                            np.mean([s.precursor_mz for s in members])
-                        ),
-                        precursor_charge=members[0].precursor_charge,
-                        title=batch.cluster_ids[ci],
-                    )
+                self._emit_bin_mean_rows(batch, fused, cap, rows, clusters,
+                                         out)
         return [s for s in out if s is not None]
 
     # -- gap-average consensus (K3) -------------------------------------
@@ -817,6 +814,13 @@ class TpuBackend:
         if len(representatives) != len(clusters):
             raise ValueError("representatives and clusters must align")
         _check_no_empty(clusters)
+        if self.mesh is None and self.layout == "auto":
+            from specpride_tpu.ops import cosine_native
+
+            if cosine_native.available():
+                return self._average_cosines_native(
+                    representatives, clusters, config
+                )
         if self.mesh is None and self.layout != "bucketized":
             return self._average_cosines_flat(representatives, clusters, config)
         space = config.mz_space
@@ -928,6 +932,14 @@ class TpuBackend:
         for c in clusters:
             numpy_backend.check_uniform_charge(c.members)
 
+        if self.layout == "auto":
+            from specpride_tpu.ops import cosine_native
+
+            if cosine_native.available():
+                return self._run_pipeline_native(
+                    clusters, bin_config, cos_config
+                )
+
         st = self.stats
         pending = self._bin_mean_flat_dispatch(clusters, bin_config)
         with st.phase("pack"):
@@ -937,6 +949,206 @@ class TpuBackend:
             prep = self._prep_cosine_reps(reps, mprep, cos_config)
         cosines = self._dispatch_cosine_flat(prep)
         return reps, cosines
+
+    def _run_pipeline_native(
+        self,
+        clusters: list[Cluster],
+        bin_config: BinMeanConfig,
+        cos_config: CosineConfig,
+    ) -> tuple[list[Spectrum], np.ndarray]:
+        """Chunk-pipelined consensus+QC: device bin-mean chunks stream
+        through a 2-worker dispatch pool (chunk i+1's H2D overlaps chunk
+        i's kernel/D2H; workers hold the link, not the GIL) while the host
+        finalizes each arrived chunk and scores its member cosines with the
+        native threaded kernel (``native/cosine.cpp``).  The cluster axis
+        is split into ~6 device chunks so host and device work interleave
+        instead of serializing on one monolithic transfer (the round-4
+        profile: one 50 MB H2D + one fused kernel left the host idle for
+        ~1 s per run)."""
+        import concurrent.futures
+
+        from specpride_tpu.data.packed import _as_table, pack_flat_bin_mean
+
+        st = self.stats
+        with st.phase("pack"):
+            table = _as_table(clusters)
+            total = int(table.mz.size)
+            max_el = min(
+                self.max_grid_elements // 4, max(total // 6 + 1, 1 << 19)
+            )
+            batches = pack_flat_bin_mean(
+                table,
+                bin_config.min_mz,
+                bin_config.max_mz,
+                bin_config.bin_size,
+                bin_config.n_bins,
+                max_elements=max_el,
+            )
+
+        out: list[Spectrum | None] = [None] * len(clusters)
+        cosines = np.zeros(len(clusters), dtype=np.float64)
+
+        def finish_chunk(batch, fused, cap, rows):
+            lo = batch.source_indices[0]
+            hi = batch.source_indices[-1] + 1
+            with st.phase("finalize"):
+                self._emit_bin_mean_rows(batch, fused, cap, rows, clusters,
+                                         out)
+            with st.phase("compute"):
+                cosines[lo:hi] = self._cosine_native_rows(
+                    out[lo:hi], mprep, cos_config, lo, hi
+                )
+
+        if self.sync_timing:
+            # diagnostics mode: serial chunks so the phase split stays
+            # attributable (dispatch = H2D+call, device = kernel, d2h =
+            # pure transfer) — overlap is deliberately given up
+            with st.phase("pack"):
+                mprep = self._prep_cosine_native(table)
+            for batch in batches:
+                with st.phase("dispatch"):
+                    fused, cap, rows = self._flat_chunk_dispatch(
+                        batch, bin_config
+                    )
+                with st.phase("device"):
+                    fused.block_until_ready()
+                with st.phase("d2h"):
+                    fused = np.asarray(fused)
+                finish_chunk(batch, fused, cap, rows)
+        else:
+            def run_chunk(batch):
+                # dispatch-worker job: one batched H2D put + kernel call +
+                # blocking host fetch (transfers release the GIL, so two
+                # workers pipeline the link while the main thread
+                # packs/finalizes/scores)
+                fused, cap, rows = self._flat_chunk_dispatch(
+                    batch, bin_config
+                )
+                return np.asarray(fused), cap, rows
+
+            with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
+                with st.phase("dispatch"):
+                    futs = [ex.submit(run_chunk, b) for b in batches]
+                with st.phase("pack"):
+                    mprep = self._prep_cosine_native(table)
+                for batch, fut in zip(batches, futs):
+                    with st.phase("d2h"):
+                        fused, cap, rows = fut.result()
+                    finish_chunk(batch, fused, cap, rows)
+        st.count("clusters", len(clusters))
+        return [s for s in out if s is not None], cosines
+
+    def _emit_bin_mean_rows(
+        self, batch, fused, cap: int, rows: int, clusters, out
+    ) -> None:
+        """Unpack one flat-chunk fused buffer into ``out`` Spectrum slots
+        (shared by the serial flat finish and the pipelined native path)."""
+        for ci, r_mz, r_int in _iter_compacted(fused, cap, rows):
+            gi = batch.source_indices[ci]
+            members = clusters[gi].members
+            out[gi] = Spectrum(
+                mz=r_mz,
+                intensity=r_int,
+                # exact f64 mean, as the oracle (ref src/binning.py:224)
+                precursor_mz=float(
+                    np.mean([s.precursor_mz for s in members])
+                ),
+                precursor_charge=members[0].precursor_charge,
+                title=batch.cluster_ids[ci],
+            )
+
+    def _prep_cosine_native(self, clusters):
+        """Representative-independent half of the NATIVE cosine path: the
+        flat member layout (one gather off the columnar table — no
+        quantization, no sort: the C++ kernel bins on the fly in cache).
+        Split out so the fused pipeline can run it while the consensus
+        kernel and its D2H stream are in flight."""
+        from specpride_tpu.data.packed import _as_table, _grouped_arange
+
+        table = _as_table(clusters)
+        idx = table.cluster_order()
+        cnt = table.peak_counts[idx.order]
+        src = np.repeat(table.peak_offsets[idx.order], cnt) + _grouped_arange(
+            cnt
+        )
+        spec_offsets = np.zeros(idx.order.size + 1, dtype=np.int64)
+        np.cumsum(cnt, out=spec_offsets[1:])
+        cso = np.zeros(table.n_clusters + 1, dtype=np.int64)
+        np.cumsum(idx.n_members, out=cso[1:])
+        return dict(
+            mem_mz=table.mz[src],
+            mem_int=table.intensity[src],
+            spec_offsets=spec_offsets,
+            cluster_spec_offsets=cso,
+            n_members=idx.n_members,
+        )
+
+    def _cosine_native_rows(
+        self, representatives, mprep, config, lo: int, hi: int
+    ) -> np.ndarray:
+        """Mean member cosine for cluster rows [lo, hi) via the native
+        kernel (``native/cosine.cpp``); ``representatives`` is the
+        (hi - lo)-length slice for exactly those rows; ``mprep`` from
+        ``_prep_cosine_native``."""
+        from specpride_tpu.ops import cosine_native
+
+        reps = representatives
+        if len(reps) != hi - lo:
+            raise ValueError("representatives slice must match [lo, hi)")
+        rep_offsets = np.zeros(hi - lo + 1, dtype=np.int64)
+        np.cumsum([r.n_peaks for r in reps], out=rep_offsets[1:])
+        rep_mz = (
+            np.concatenate([np.asarray(r.mz, np.float64) for r in reps])
+            if rep_offsets[-1]
+            else np.zeros(0, np.float64)
+        )
+        rep_int = (
+            np.concatenate([np.asarray(r.intensity, np.float64) for r in reps])
+            if rep_offsets[-1]
+            else np.zeros(0, np.float64)
+        )
+        cso = mprep["cluster_spec_offsets"]
+        s0, s1 = int(cso[lo]), int(cso[hi])
+        p0 = int(mprep["spec_offsets"][s0])
+        p1 = int(mprep["spec_offsets"][s1])
+        cos = cosine_native.pair_cosines(
+            rep_mz,
+            rep_int,
+            rep_offsets,
+            mprep["mem_mz"][p0:p1],
+            mprep["mem_int"][p0:p1],
+            mprep["spec_offsets"][s0 : s1 + 1] - p0,
+            cso[lo : hi + 1] - s0,
+            config.mz_space,
+        )
+        # mean over members; summation-order difference vs the oracle's
+        # np.mean (pairwise) is ~1e-16 relative
+        nm = mprep["n_members"][lo:hi].astype(np.float64)
+        sums = np.add.reduceat(
+            np.concatenate([cos, [0.0]]), cso[lo:hi] - s0
+        )[: hi - lo]
+        return sums / np.maximum(nm, 1.0)
+
+    def _average_cosines_native(
+        self,
+        representatives: list[Spectrum],
+        clusters: list[Cluster],
+        config: CosineConfig,
+    ) -> np.ndarray:
+        """Host-native K2b path (``native/cosine.cpp``): exact-f64 oracle
+        semantics, threaded over clusters, no packing/padding and no device
+        round trip — the measured winner mesh-less (see the kernel header
+        for the link economics; the flat/bucketized device paths remain for
+        mesh runs)."""
+        st = self.stats
+        with st.phase("pack"):
+            mprep = self._prep_cosine_native(clusters)
+        with st.phase("compute"):
+            out = self._cosine_native_rows(
+                representatives, mprep, config, 0, len(clusters)
+            )
+        st.count("clusters", len(clusters))
+        return out
 
     def _average_cosines_flat(
         self,

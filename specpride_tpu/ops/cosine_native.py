@@ -1,0 +1,103 @@
+"""ctypes bindings for the multithreaded C++ binned-cosine QC metric
+(native/cosine.cpp — see its header for why the mesh-less backend prefers
+host work here: the device kernel ships ~16 B per member peak over a
+~90 MB/s tunneled link for a handful of FLOPs per byte).
+
+Loading mirrors ``ops.gap_native``: lazy, soft-failing (``available()``
+False when unbuilt), reusing the one-shot ``make -C native`` bootstrap."""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    p = ctypes.POINTER
+    lib.pair_cosines_run.restype = ctypes.c_int
+    lib.pair_cosines_run.argtypes = [
+        p(ctypes.c_double),  # rep_mz
+        p(ctypes.c_double),  # rep_int
+        p(ctypes.c_int64),  # rep_offsets
+        p(ctypes.c_double),  # mem_mz
+        p(ctypes.c_double),  # mem_int
+        p(ctypes.c_int64),  # spec_offsets
+        p(ctypes.c_int64),  # cluster_spec_offsets
+        ctypes.c_int64,  # n_clusters
+        ctypes.c_double,  # space
+        p(ctypes.c_double),  # out_cos
+        ctypes.c_int,  # n_threads
+    ]
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        from specpride_tpu.io.native import load_native
+
+        _lib = load_native("libcosine.so", "SPECPRIDE_COSINE_LIB", _bind)
+        _load_failed = _lib is None
+        return _lib
+
+
+def available() -> bool:
+    """True when the C++ cosine library is built and loadable."""
+    return _load() is not None
+
+
+def pair_cosines(
+    rep_mz: np.ndarray,  # (Pr,) f64, reps contiguous per cluster
+    rep_int: np.ndarray,  # (Pr,) f64, same order
+    rep_offsets: np.ndarray,  # (C + 1,) i64
+    mem_mz: np.ndarray,  # (P,) f64, spectra contiguous, clusters contiguous
+    mem_int: np.ndarray,  # (P,) f64, same order
+    spec_offsets: np.ndarray,  # (S + 1,) i64 peak extents per spectrum
+    cluster_spec_offsets: np.ndarray,  # (C + 1,) i64 spectrum extents/cluster
+    space: float,
+    n_threads: int = 0,  # 0 = hardware concurrency
+) -> np.ndarray:
+    """(S,) binned cosine of every member spectrum to its cluster's
+    representative (threads released from the GIL — callers may run this
+    concurrently with device fetches).  Raises ``RuntimeError`` when the
+    library is unavailable (callers guard with ``available()``)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native cosine not built (make -C native)")
+    rep_mz = np.ascontiguousarray(rep_mz, dtype=np.float64)
+    rep_int = np.ascontiguousarray(rep_int, dtype=np.float64)
+    rep_offsets = np.ascontiguousarray(rep_offsets, dtype=np.int64)
+    mem_mz = np.ascontiguousarray(mem_mz, dtype=np.float64)
+    mem_int = np.ascontiguousarray(mem_int, dtype=np.float64)
+    spec_offsets = np.ascontiguousarray(spec_offsets, dtype=np.int64)
+    cluster_spec_offsets = np.ascontiguousarray(
+        cluster_spec_offsets, dtype=np.int64
+    )
+    c = cluster_spec_offsets.size - 1
+    out = np.zeros(spec_offsets.size - 1, dtype=np.float64)
+    dp = ctypes.POINTER(ctypes.c_double)
+    ip = ctypes.POINTER(ctypes.c_int64)
+    rc = lib.pair_cosines_run(
+        rep_mz.ctypes.data_as(dp),
+        rep_int.ctypes.data_as(dp),
+        rep_offsets.ctypes.data_as(ip),
+        mem_mz.ctypes.data_as(dp),
+        mem_int.ctypes.data_as(dp),
+        spec_offsets.ctypes.data_as(ip),
+        cluster_spec_offsets.ctypes.data_as(ip),
+        c,
+        float(space),
+        out.ctypes.data_as(dp),
+        int(n_threads),
+    )
+    if rc != 0:
+        raise RuntimeError(f"native cosine failed (rc={rc})")
+    return out

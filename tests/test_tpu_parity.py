@@ -434,7 +434,7 @@ class TestCosineParity:
         device = backend.average_cosines([s], [Cluster("c1", [s])])
         np.testing.assert_allclose(device, [1.0], rtol=1e-5)
 
-    @pytest.mark.parametrize("layout", ["auto", "bucketized"])
+    @pytest.mark.parametrize("layout", ["auto", "flat", "bucketized"])
     @pytest.mark.parametrize("ratio", [1e2, 1e3, 1e6])
     def test_mixed_intensity_scales(self, rng, layout, ratio):
         """Members (and clusters) whose intensity scales differ by orders
@@ -461,7 +461,7 @@ class TestCosineParity:
         device = TpuBackend(layout=layout).average_cosines(reps, clusters)
         np.testing.assert_allclose(oracle, device, rtol=5e-5, atol=5e-5)
 
-    @pytest.mark.parametrize("layout", ["auto", "bucketized"])
+    @pytest.mark.parametrize("layout", ["auto", "flat", "bucketized"])
     def test_zero_peak_reps_and_members(self, rng, layout):
         """Representatives or members with zero peaks (quorum can wipe a
         consensus; converters can emit empty spectra) must yield cosine 0
@@ -485,9 +485,13 @@ class TestCosineParity:
         np.testing.assert_allclose(device, oracle, rtol=5e-5, atol=1e-5)
         assert device[0] == 0.0  # empty rep -> no shared signal
 
-    def test_fused_pipeline_matches_composition(self, rng, backend):
-        """run_bin_mean_with_cosines (the overlapped consensus+QC pass)
-        must equal run_bin_mean followed by average_cosines."""
+    @pytest.mark.parametrize("layout", ["auto", "flat"])
+    def test_fused_pipeline_matches_composition(self, rng, layout):
+        """run_bin_mean_with_cosines (the overlapped consensus+QC pass —
+        chunk-pipelined native cosine under "auto" when the C++ kernel is
+        built, device cosine under "flat") must equal run_bin_mean followed
+        by average_cosines."""
+        backend = TpuBackend(layout=layout)
         clusters = random_clusters(rng, n=10)
         reps_f, cos_f = backend.run_bin_mean_with_cosines(clusters)
         reps = backend.run_bin_mean(clusters)
@@ -502,7 +506,7 @@ class TestCosineParity:
         """Force >= 3 chunks through the flat cosine path so the
         chunk-offset rebasing (s0/p0/r0, fill spectra, per-chunk pos/npos)
         is exercised (advisor r4: the parity suite fit in one chunk)."""
-        backend = TpuBackend(max_grid_elements=4096)  # budget // 4 peaks
+        backend = TpuBackend(max_grid_elements=4096, layout="flat")
         clusters = random_clusters(rng, n=14)
         reps = nb.run_bin_mean(clusters)
         oracle = np.array(
@@ -510,6 +514,102 @@ class TestCosineParity:
         )
         device = backend.average_cosines(reps, clusters)
         np.testing.assert_allclose(oracle, device, rtol=5e-5, atol=1e-5)
+
+    def test_pipelined_native_multi_chunk(self, rng):
+        """The chunk-pipelined native path (2-worker dispatch pool, per-
+        chunk finalize + native cosine) must survive multi-chunk splits
+        with outputs in input order."""
+        from specpride_tpu.ops import cosine_native
+
+        if not cosine_native.available():
+            pytest.skip("native cosine not built")
+        backend = TpuBackend(max_grid_elements=4096)
+        clusters = random_clusters(rng, n=14)
+        reps_f, cos_f = backend.run_bin_mean_with_cosines(clusters)
+        assert [s.title for s in reps_f] == [c.cluster_id for c in clusters]
+        reps = TpuBackend().run_bin_mean(clusters)
+        cos = TpuBackend().average_cosines(reps, clusters)
+        np.testing.assert_allclose(cos_f, cos, rtol=1e-6, atol=1e-7)
+
+
+class TestNativeCosine:
+    """The C++ threaded cosine (native/cosine.cpp) against the oracle —
+    near-f64-exact (same accumulation order; only the final dot/norm
+    reductions differ from BLAS pairwise summation)."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from specpride_tpu.ops import cosine_native
+
+        if not cosine_native.available():
+            pytest.skip("native cosine not built (make -C native)")
+
+    def test_exact_parity(self, rng, backend):
+        clusters = random_clusters(rng, n=12)
+        reps = nb.run_bin_mean(clusters)
+        oracle = np.array(
+            [nb.average_cosine(r, c.members) for r, c in zip(reps, clusters)]
+        )
+        native = backend._average_cosines_native(
+            reps, clusters, CosineConfig()
+        )
+        np.testing.assert_allclose(native, oracle, rtol=1e-12, atol=1e-14)
+
+    def test_unsorted_member_matches_oracle(self, backend):
+        """np.add.at accumulation order must survive the stable-sort
+        fallback for unsorted spectra."""
+        rep = Spectrum(
+            mz=[200.0, 300.0], intensity=[10.0, 20.0],
+            precursor_mz=400.0, precursor_charge=2, title="c1",
+        )
+        member = Spectrum(
+            mz=[200.0, 900.0, 950.0, 300.0],
+            intensity=[10.0, 300.0, 1.0, 20.0],
+            precursor_mz=400.0, precursor_charge=2, title="c1;u1",
+        )
+        oracle = nb.average_cosine(rep, [member])
+        native = backend._average_cosines_native(
+            [rep], [Cluster("c1", [member])], CosineConfig()
+        )
+        np.testing.assert_allclose(native, [oracle], rtol=1e-12)
+
+    def test_last_edge_fold(self, backend):
+        """A peak exactly at the pair's last grid edge folds into the final
+        bin (scipy binned_statistic's right-closed last bin), not out."""
+        space = CosineConfig().mz_space
+        # last edge of the grid ending at this spectrum's last peak
+        n = int(np.ceil((500.0 + space / 2.0) / space))
+        last_edge = -space / 2.0 + (n - 1) * space
+        s = Spectrum(
+            mz=[100.0, last_edge], intensity=[5.0, 7.0],
+            precursor_mz=400.0, precursor_charge=2, title="c1",
+        )
+        oracle = nb.average_cosine(s, [s])
+        native = backend._average_cosines_native(
+            [s], [Cluster("c1", [s])], CosineConfig()
+        )
+        np.testing.assert_allclose(native, [oracle], rtol=1e-12)
+        assert native[0] == pytest.approx(1.0)
+
+    def test_empty_and_zero_norm(self, rng, backend):
+        full = make_cluster(rng, "c-full", n_members=3, n_peaks=20)
+        empty_rep = Spectrum(
+            mz=[], intensity=[], precursor_mz=500.0, precursor_charge=2,
+            title="c-full",
+        )
+        zero_int = Cluster("c-z", [Spectrum(
+            mz=[100.0, 200.0], intensity=[0.0, 0.0], precursor_mz=500.0,
+            precursor_charge=2, title="c-z;u0",
+        )])
+        clusters = [full, zero_int]
+        reps = [empty_rep, nb.run_bin_mean([zero_int])[0]]
+        oracle = np.array(
+            [nb.average_cosine(r, c.members) for r, c in zip(reps, clusters)]
+        )
+        native = backend._average_cosines_native(
+            reps, clusters, CosineConfig()
+        )
+        np.testing.assert_allclose(native, oracle, rtol=1e-12, atol=0)
 
 
 # ---------------------------------------------------------------------------
