@@ -19,11 +19,29 @@ for f in $FILES; do
     # Always run curl: -C - resumes a partial file and is a cheap no-op
     # when the file is already complete (a size-only "skip if non-empty"
     # guard would treat an interrupted download as done and pin its
-    # truncated checksum below).  rc 33 = server refused the resume range,
-    # which also happens when the file is already complete.
+    # truncated checksum below).  rc 33 = server refused the resume range
+    # — which happens when the file is already complete, but ALSO when a
+    # server simply doesn't honor ranges on a genuinely truncated partial
+    # file, so verify the local size against the remote before trusting it
+    # (otherwise a first fetch with no committed manifest would pin the
+    # truncated file's checksum as ground truth below).
     curl --fail -C - -o "$DEST/$f" "$BASE/$f" || {
         rc=$?
-        [ "$rc" -eq 33 ] && echo "  (server refused resume; file assumed complete)" || exit "$rc"
+        [ "$rc" -eq 33 ] || exit "$rc"
+        remote_size=$(curl --fail -sI "$BASE/$f" | tr -d '\r' \
+            | awk 'tolower($1)=="content-length:" {print $2}' | tail -n 1)
+        local_size=$(wc -c < "$DEST/$f" | tr -d ' ')
+        if [ -n "$remote_size" ] && [ "$remote_size" != "$local_size" ]; then
+            echo "  ERROR: server refused resume but $f is incomplete" >&2
+            echo "  ($local_size of $remote_size bytes) — delete it and retry" >&2
+            exit 33
+        fi
+        if [ -z "$remote_size" ]; then
+            echo "  WARNING: server refused resume and reports no size;" >&2
+            echo "  $f may be partial — a recorded manifest could pin it" >&2
+        else
+            echo "  (resume refused; size matches remote: complete)"
+        fi
     }
 done
 

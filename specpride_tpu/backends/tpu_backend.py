@@ -20,6 +20,7 @@ never read back.
 from __future__ import annotations
 
 import dataclasses
+import threading
 
 import numpy as np
 
@@ -59,8 +60,11 @@ def _ensure_compile_cache() -> None:
 
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return
-    if jax.config.jax_compilation_cache_dir:
-        return
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return
+    except AttributeError:
+        pass  # older jax without the attribute: treat as not configured
     path = os.environ.get("SPECPRIDE_JAX_CACHE")
     if path == "":
         return
@@ -132,35 +136,40 @@ def _iter_compacted(fused, cap: int, n_rows: int):
         )
 
 
+_fetch_pool = None
+_fetch_pool_lock = threading.Lock()
+
+
+def _get_fetch_pool():
+    """Process-wide bounded fetch pool (3 workers): the D2H link carries
+    one transfer at a time anyway, so per-chunk threads only add
+    contention — a many-chunk run used to spawn one thread per chunk all
+    fighting for the same link."""
+    global _fetch_pool
+    with _fetch_pool_lock:
+        if _fetch_pool is None:
+            import concurrent.futures
+
+            _fetch_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=3, thread_name_prefix="specpride-fetch"
+            )
+        return _fetch_pool
+
+
 class _AsyncFetch:
-    """Device->host fetch driven by a background thread.
+    """Device->host fetch driven by the bounded background pool.
 
     ``copy_to_host_async`` alone does NOT stream on tunneled hosts — the
     transfer only progresses inside the blocking ``np.asarray`` — but that
-    block releases the GIL, so a thread hides the ~25 MB/s copy behind
-    host pack work (measured: a 16 MB fetch fully disappears behind 1 s of
+    block releases the GIL, so a pool worker hides the copy behind host
+    pack work (measured: a 16 MB fetch fully disappears behind 1 s of
     numpy work).  Exceptions re-raise on ``get()``."""
 
     def __init__(self, device_array):
-        import threading
-
-        self._arr = device_array
-        self._out = None
-        self._err: BaseException | None = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-
-    def _run(self):
-        try:
-            self._out = np.asarray(self._arr)
-        except BaseException as e:  # re-raised on get()
-            self._err = e
+        self._fut = _get_fetch_pool().submit(np.asarray, device_array)
 
     def get(self) -> np.ndarray:
-        self._thread.join()
-        if self._err is not None:
-            raise self._err
-        return self._out
+        return self._fut.result()
 
 
 def _cap_class(n: int, floor: int = 1) -> int:

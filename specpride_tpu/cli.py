@@ -186,7 +186,8 @@ def _append_qc_rows(qc: list, clusters, cosines) -> None:
 
 
 def _write_qc_report(
-    args, backend, clusters, qc: list, stats, resumed_ids: set[str]
+    args, backend, clusters, qc: list, stats, resumed_ids: set[str],
+    failed_ids: list[str] = (), qc_failed_ids: list[str] = (),
 ) -> None:
     """Finalize and write the per-cluster QC report.
 
@@ -224,11 +225,27 @@ def _write_qc_report(
     cosines = [row["avg_cosine"] for row in qc]
     import statistics
 
+    # rows can be missing for two distinct reasons consumers must be able
+    # to tell apart: the METHOD dropped/failed the cluster (failed_ids,
+    # scoreless best-spectrum) vs the QC cosine pass itself failed
+    # (qc_failed_ids) — n_clusters shrinking alone is ambiguous
+    have = {row["cluster_id"] for row in qc}
+    qc_failed = sorted(i for i in qc_failed_ids if i not in have)
     report = {
         "summary": {
             "n_clusters": len(qc),
             "mean_cosine": statistics.fmean(cosines) if cosines else None,
             "median_cosine": statistics.median(cosines) if cosines else None,
+            "n_input_clusters": len(clusters),
+            "n_method_failed": len(failed_ids),
+            "n_qc_failed": len(qc_failed),
+            **(
+                {"method_failed_cluster_ids": sorted(failed_ids)}
+                if failed_ids else {}
+            ),
+            **(
+                {"qc_failed_cluster_ids": qc_failed} if qc_failed else {}
+            ),
         },
         "clusters": qc,
     }
@@ -363,6 +380,7 @@ def _checkpointed_run(
     # must not silently erase the record of clusters it never produced
     # (dict-as-ordered-set: a cluster failing again must not double-count)
     failed: dict[str, None] = dict.fromkeys(prior_failed)
+    qc_failed: dict[str, None] = {}
     on_error = getattr(args, "on_error", "abort")
     for start in range(0, len(todo), chunk):
         part = todo[start : start + chunk]
@@ -423,6 +441,10 @@ def _checkpointed_run(
                     "QC cosines failed for a %d-cluster chunk (%s); "
                     "their rows are omitted from the report", len(part), e,
                 )
+                # machine-readable trace for the report summary: consumers
+                # must be able to tell "row dropped by the method" from
+                # "QC itself failed" (advisor r4)
+                qc_failed.update(dict.fromkeys(c.cluster_id for c in part))
         with stats.phase("write"):
             write_mgf(reps, args.output, append=not first_write)
         first_write = False
@@ -448,7 +470,7 @@ def _checkpointed_run(
             len(failed), ", ".join(list(failed)[:5]),
             "..." if len(failed) > 5 else "",
         )
-    return resumed_ids
+    return resumed_ids, list(failed), list(qc_failed)
 
 
 def _load_clusters(path: str, stats: RunStats) -> list[Cluster]:
@@ -478,11 +500,12 @@ def cmd_consensus(args) -> int:
     backend = _get_backend(args)
     clusters, args.output = _shard_for_process(clusters, args)
     qc = [] if getattr(args, "qc_report", None) else None
-    resumed = _checkpointed_run(
+    resumed, failed, qc_failed = _checkpointed_run(
         backend, args.method, clusters, args, stats, qc=qc
     )
     if qc is not None:
-        _write_qc_report(args, backend, clusters, qc, stats, resumed)
+        _write_qc_report(args, backend, clusters, qc, stats, resumed,
+                         failed, qc_failed)
     logger.info(
         "consensus done: %.1f clusters/sec", stats.throughput("clusters")
     )
@@ -497,11 +520,12 @@ def cmd_select(args) -> int:
     scores = _load_scores(args) if args.method == "best" else None
     clusters, args.output = _shard_for_process(clusters, args)
     qc = [] if getattr(args, "qc_report", None) else None
-    resumed = _checkpointed_run(
+    resumed, failed, qc_failed = _checkpointed_run(
         backend, args.method, clusters, args, stats, scores, qc=qc
     )
     if qc is not None:
-        _write_qc_report(args, backend, clusters, qc, stats, resumed)
+        _write_qc_report(args, backend, clusters, qc, stats, resumed,
+                         failed, qc_failed)
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0
 

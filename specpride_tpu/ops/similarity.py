@@ -45,7 +45,10 @@ def shared_bins_packed(
     bins: jax.Array,  # (B, K) i32 GLOBAL bins, PRE-SORTED (bin, member)
     member_id: jax.Array,  # (B, K) i32 in [0, m], same order, padding = m
     m: int,
-    lcap: int = 64,  # pow2 >= longest same-(row, bin) element run
+    # pow2 >= longest same-(row, bin) element run; None = K (always safe —
+    # a run can never exceed the row width).  A too-small lcap would
+    # silently drop occupancy bits, so there is no small default.
+    lcap: int | None = None,
 ) -> jax.Array:
     """(B, M, M) shared occupied-bin counts for every member pair.
 
@@ -64,6 +67,8 @@ def shared_bins_packed(
     from specpride_tpu.ops import segments as sg
 
     b, k = bins.shape
+    if lcap is None:
+        lcap = k
     n = b * k
     fb = bins.reshape(n)
     fm = member_id.reshape(n)

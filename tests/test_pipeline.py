@@ -550,6 +550,34 @@ class TestCli:
         ]) == 0
         assert json.loads(ckpt.read_text())["failed"] == ["cluster-bad"]
 
+    def test_on_error_skip_flags_failures_in_qc_report(self, tmp_path, rng):
+        """Missing QC rows must be machine-readably attributed: the report
+        summary distinguishes method-failed clusters from QC failures
+        instead of just shrinking n_clusters (advisor r4)."""
+        good = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=15)
+            for i in range(2)
+        ]
+        bad = make_cluster(rng, "cluster-bad", n_members=2, n_peaks=15)
+        bad.members[1].precursor_charge = bad.members[0].precursor_charge + 1
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf(
+            [s for c in good[:1] + [bad] + good[1:] for s in c.members],
+            clustered,
+        )
+        report_path = tmp_path / "qc.json"
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "out.mgf"),
+            "--backend", "numpy", "--on-error", "skip",
+            "--qc-report", str(report_path),
+        ]) == 0
+        summary = json.loads(report_path.read_text())["summary"]
+        assert summary["n_clusters"] == 2
+        assert summary["n_input_clusters"] == 3
+        assert summary["n_method_failed"] == 1
+        assert summary["method_failed_cluster_ids"] == ["cluster-bad"]
+        assert summary["n_qc_failed"] == 0
+
     def test_select_best_requires_score_source(self, tmp_path, rng):
         cluster = make_cluster(rng, "cluster-0", n_members=2, n_peaks=15)
         clustered = tmp_path / "clustered.mgf"
