@@ -23,7 +23,7 @@ import numpy as np
 
 from specpride_tpu.config import CosineConfig, FragmentConfig
 from specpride_tpu.data.peaks import Cluster, Spectrum, peptide_from_usi
-from specpride_tpu.ops.fragments import fraction_of_by
+from specpride_tpu.ops.fragments import fraction_of_by_batch
 
 
 @dataclasses.dataclass
@@ -70,37 +70,40 @@ def evaluate(
             list(representatives), list(clusters), cosine_config
         )
 
-    out: list[ClusterQuality] = []
-    for rep, cluster, cos in zip(representatives, clusters, cosines):
+    peptides: list[str | None] = []
+    for rep, cluster in zip(representatives, clusters):
         peptide = None
         for s in [rep, *cluster.members]:
             pep, _ = peptide_from_usi(s.usi)
             if pep:
                 peptide = pep
                 break
-        frac = None
-        if peptide is not None:
-            frac = fraction_of_by(
-                peptide,
-                rep.precursor_mz,
-                rep.precursor_charge,
-                rep.mz,
-                rep.intensity,
-                tol=fragment_config.tol,
-                tol_mode=fragment_config.tol_mode,
-                min_mz=fragment_config.min_mz,
-                max_mz=fragment_config.max_mz,
-            )
-        out.append(
-            ClusterQuality(
-                cluster_id=cluster.cluster_id,
-                n_members=cluster.n_members,
-                n_peaks=rep.n_peaks,
-                avg_cosine=float(cos),
-                by_fraction=frac,
-            )
+        peptides.append(peptide)
+    # one fragment-table build per unique peptide/charge, not per cluster
+    # (ops.fragments.fraction_of_by_batch); NaN = no peptide -> None
+    fracs = fraction_of_by_batch(
+        peptides,
+        np.array([r.precursor_mz for r in representatives]),
+        np.array([r.precursor_charge for r in representatives]),
+        [r.mz for r in representatives],
+        [r.intensity for r in representatives],
+        tol=fragment_config.tol,
+        tol_mode=fragment_config.tol_mode,
+        min_mz=fragment_config.min_mz,
+        max_mz=fragment_config.max_mz,
+    )
+    return [
+        ClusterQuality(
+            cluster_id=cluster.cluster_id,
+            n_members=cluster.n_members,
+            n_peaks=rep.n_peaks,
+            avg_cosine=float(cos),
+            by_fraction=None if np.isnan(frac) else float(frac),
         )
-    return out
+        for rep, cluster, cos, frac in zip(
+            representatives, clusters, cosines, fracs
+        )
+    ]
 
 
 def summarize(results: Sequence[ClusterQuality]) -> dict:

@@ -17,7 +17,11 @@ import numpy as np
 
 from specpride_tpu.config import FragmentConfig
 from specpride_tpu.data.peaks import Spectrum
-from specpride_tpu.ops.fragments import fragment_mzs, match_fragments
+from specpride_tpu.ops.fragments import (
+    fragment_annotations,
+    fragment_mzs,
+    match_fragments,
+)
 
 
 def _normalized(intensity: np.ndarray, mode: str = "root") -> np.ndarray:
@@ -90,8 +94,9 @@ def mirror_plot(
     """Mirror plot: ``top`` upward, ``bottom`` downward.
 
     Peaks within the fragment tolerance of the annotated peptide's b/y ions
-    are coloured (the annotate('aby'-minus-a) capability of ref
-    src/plot_cluster.py:33-34).  Returns the matplotlib Axes.
+    are coloured AND labelled with the matching ion (``b3``, ``y5^2+`` —
+    the visible output of the spectrum_utils plots the reference wraps,
+    ref src/plot_cluster.py:33-45).  Returns the matplotlib Axes.
     """
     import matplotlib
 
@@ -101,11 +106,12 @@ def mirror_plot(
     if ax is None:
         _, ax = plt.subplots(figsize=(10, 5))
 
-    frags = (
-        fragment_mzs(annotate_peptide, config.ion_types, 2)
-        if annotate_peptide
-        else np.zeros((0,))
-    )
+    if annotate_peptide:
+        frags, frag_labels = fragment_annotations(
+            annotate_peptide, config.ion_types, 2
+        )
+    else:
+        frags, frag_labels = np.zeros((0,)), []
 
     for spec, sign in ((top, 1.0), (bottom, -1.0)):
         inten = _normalized(spec.intensity, normalize) * sign
@@ -114,6 +120,26 @@ def mirror_plot(
             if np.any(sel):
                 ax.vlines(
                     spec.mz[sel], 0, inten[sel], color=color, linewidth=1.0
+                )
+        if frags.size and np.any(matched):
+            # label each matched peak with its nearest fragment's identity
+            pos = np.clip(
+                np.searchsorted(frags, spec.mz[matched]), 1, frags.size - 1
+            )
+            left, right = frags[pos - 1], frags[pos]
+            nearest = np.where(
+                np.abs(spec.mz[matched] - left)
+                <= np.abs(spec.mz[matched] - right),
+                pos - 1,
+                pos,
+            )
+            va = "bottom" if sign > 0 else "top"
+            for x, y, fi in zip(spec.mz[matched], inten[matched], nearest):
+                ax.annotate(
+                    frag_labels[int(fi)], (x, y), ha="center", va=va,
+                    fontsize=7, color="#d62728", rotation=90,
+                    textcoords="offset points",
+                    xytext=(0, 2 if sign > 0 else -2),
                 )
 
     ax.axhline(0.0, color="black", linewidth=0.8)

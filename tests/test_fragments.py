@@ -106,3 +106,23 @@ def test_fraction_of_by_precursor_removed():
     pmz = (fr.peptide_mass(seq) + 2 * fr.PROTON_MASS) / 2
     mz = np.array([pmz])  # only the precursor peak, removed in preprocessing
     assert fr.fraction_of_by(seq, pmz, 2, mz, np.array([100.0])) == 0.0
+
+
+def test_fraction_of_by_batch_matches_scalar():
+    """The batched form must equal per-call fraction_of_by bit for bit
+    (it shares the window-match body; only the fragment-table build is
+    cached), with NaN marking absent peptides."""
+    rng = np.random.default_rng(3)
+    seqs = ["VLHPLEGAVVIIFK", "PEPTIDEK", None, "XX1", "PEPTIDEK"]
+    pmz = np.array([779.48, 450.2, 300.0, 500.0, 451.0])
+    pz = np.array([2, 2, 2, 2, 3])
+    mzs = [np.sort(rng.uniform(100, 1300, 80)) for _ in seqs]
+    ints = [rng.uniform(1, 100, 80) for _ in seqs]
+    batch = fr.fraction_of_by_batch(seqs, pmz, pz, mzs, ints)
+    for i, s in enumerate(seqs):
+        if s is None:
+            assert np.isnan(batch[i])
+        else:
+            assert batch[i] == fr.fraction_of_by(
+                s, float(pmz[i]), int(pz[i]), mzs[i], ints[i]
+            )

@@ -202,6 +202,50 @@ class TestViz:
         )
         assert os.path.getsize(paths[0]) > 1000
 
+    def test_mirror_plot_labels_matched_ions(self):
+        """Matched peaks carry b/y ion labels (the identity text the
+        spectrum_utils plots the reference wraps show, ref
+        src/plot_cluster.py:33-45)."""
+        import numpy as np
+
+        from specpride_tpu import viz
+        from specpride_tpu.ops import fragments as fr
+
+        peptide = "PEPTIDEK"
+        theo = viz.theoretical_spectrum(peptide, 2)
+        # a 'measured' spectrum sitting exactly on the fragment mzs
+        spec = viz.Spectrum(
+            mz=theo.mz, intensity=np.ones_like(theo.mz) * 50.0,
+            precursor_mz=900.0, precursor_charge=2, title="m",
+        )
+        ax = viz.mirror_plot(spec, theo, annotate_peptide=peptide)
+        labels = {t.get_text() for t in ax.texts}
+        mzs, frag_labels = fr.fragment_annotations(peptide, "by", 1)
+        assert labels  # annotations rendered
+        assert labels & set(frag_labels)  # real ion names, e.g. b3/y5
+        assert any(lab.startswith("b") for lab in labels)
+        assert any(lab.startswith("y") for lab in labels)
+        import matplotlib.pyplot as plt
+
+        plt.close(ax.figure)
+
+    def test_fragment_annotations_align_with_mzs(self):
+        from specpride_tpu.ops import fragments as fr
+        import numpy as np
+
+        mzs, labels = fr.fragment_annotations("PEPTIDEK", "by", 2)
+        np.testing.assert_allclose(
+            mzs, fr.fragment_mzs("PEPTIDEK", "by", 2)
+        )
+        assert len(labels) == mzs.size
+        # each label decodes back to the right mass
+        residues, _ = fr.parse_peptide("PEPTIDEK")
+        b3 = (
+            sum(fr.RESIDUE_MASSES[r] for r in residues[:3]) + fr.PROTON_MASS
+        )
+        i = labels.index("b3")
+        assert mzs[i] == pytest.approx(b3)
+
 
 class TestCli:
     def test_full_pipeline(self, tmp_path, rng, raw_spectra):
