@@ -198,29 +198,38 @@ def _write_qc_report(
     dropped (scoreless best-spectrum, --on-error skip) must not trigger a
     futile re-parse of the whole output."""
     have = {row["cluster_id"] for row in qc}
-    missing = [
-        c for c in clusters
-        if c.cluster_id in resumed_ids
-        and c.cluster_id not in have
-        and c.n_members > 0
+    all_ids = (
+        clusters.cluster_ids
+        if hasattr(clusters, "cluster_ids")
+        else [c.cluster_id for c in clusters]
+    )
+    missing_idx = [
+        i for i, cid in enumerate(all_ids)
+        if cid in resumed_ids and cid not in have
     ]
-    if missing:
+    if missing_idx:
         reps_by_id = {s.cluster_id: s for s in read_mgf(args.output)}
-        pairs = [
-            (reps_by_id[c.cluster_id], c)
-            for c in missing
-            if c.cluster_id in reps_by_id
-        ]
-        if pairs:
-            with stats.phase("compute"):
-                _append_qc_rows(
-                    qc,
-                    [c for _, c in pairs],
-                    _cosines_of(
-                        backend, [r for r, _ in pairs], [c for _, c in pairs]
-                    ),
-                )
-    order = {c.cluster_id: i for i, c in enumerate(clusters)}
+        # windowed so a streamed input stays memory-bounded during the
+        # resume recompute (clusters[i] materialises one window at a time)
+        w = getattr(clusters, "window", 0) or len(missing_idx)
+        for b0 in range(0, len(missing_idx), w):
+            batch = [clusters[i] for i in missing_idx[b0 : b0 + w]]
+            pairs = [
+                (reps_by_id[c.cluster_id], c)
+                for c in batch
+                if c.cluster_id in reps_by_id and c.n_members > 0
+            ]
+            if pairs:
+                with stats.phase("compute"):
+                    _append_qc_rows(
+                        qc,
+                        [c for _, c in pairs],
+                        _cosines_of(
+                            backend, [r for r, _ in pairs],
+                            [c for _, c in pairs],
+                        ),
+                    )
+    order = {cid: i for i, cid in enumerate(all_ids)}
     qc.sort(key=lambda row: order.get(row["cluster_id"], len(order)))
     cosines = [row["avg_cosine"] for row in qc]
     import statistics
@@ -351,9 +360,16 @@ def _checkpointed_run(
                 fh.truncate(output_bytes)
         logger.info("resuming: %d clusters already done", len(done))
 
-    todo = [c for c in clusters if c.cluster_id not in done]
+    # index-based filtering: a StreamedClusters input exposes ids from its
+    # byte index, so resume filtering never materialises member spectra
+    ids = (
+        clusters.cluster_ids
+        if hasattr(clusters, "cluster_ids")
+        else [c.cluster_id for c in clusters]
+    )
+    todo_idx = [i for i, cid in enumerate(ids) if cid not in done]
     resumed_ids = set(done)  # skipped THIS run (QC recomputes only these)
-    stats.count("clusters_skipped_done", len(clusters) - len(todo))
+    stats.count("clusters_skipped_done", len(ids) - len(todo_idx))
     first_write = not done if output_bytes is None else output_bytes == 0
     if getattr(args, "append", False):
         if restarted:
@@ -368,9 +384,16 @@ def _checkpointed_run(
             )
         # ref average_spectrum_clustering.py:183-184,198: mode 'wa'[append]
         first_write = False
-    chunk = args.checkpoint_every if args.checkpoint else len(todo) or 1
+    # chunk size: the checkpoint interval, else the stream window (so a
+    # streamed run stays memory-bounded even without --checkpoint), else
+    # everything at once
+    chunk = (
+        args.checkpoint_every
+        if args.checkpoint
+        else getattr(clusters, "window", 0) or len(todo_idx) or 1
+    )
 
-    if not todo:
+    if not todo_idx:
         # zero clusters (empty input / empty shard): still produce an
         # output file so downstream steps see a result, not ENOENT
         # (append mode opens 'a' — creates without truncating user content)
@@ -382,8 +405,8 @@ def _checkpointed_run(
     failed: dict[str, None] = dict.fromkeys(prior_failed)
     qc_failed: dict[str, None] = {}
     on_error = getattr(args, "on_error", "abort")
-    for start in range(0, len(todo), chunk):
-        part = todo[start : start + chunk]
+    for start in range(0, len(todo_idx), chunk):
+        part = [clusters[i] for i in todo_idx[start : start + chunk]]
         n_qc_before = len(qc) if qc is not None else 0
         try:
             with stats.phase("compute"):
@@ -473,23 +496,62 @@ def _checkpointed_run(
     return resumed_ids, list(failed), list(qc_failed)
 
 
-def _load_clusters(path: str, stats: RunStats) -> list[Cluster]:
+# eager-load ceiling for --stream-clusters auto: above this input size the
+# CLI switches to windowed streaming so host RAM stops capping input size
+_STREAM_AUTO_BYTES = 256 * 1024 * 1024
+
+
+def _load_clusters(path: str, stats: RunStats, stream: str = "off"):
+    """Clusters from a clustered MGF: eager list, or a bounded-memory
+    ``StreamedClusters`` view (``--stream-clusters``: "off", "auto" = only
+    for inputs over 256 MB, or an explicit window size in clusters).
+    Streaming needs a plain (non-gz) file; otherwise it falls back to
+    eager with a warning."""
+    mode = (stream or "off").lower()
+    window = 0
+    if mode not in ("off", "auto"):
+        window = int(mode)
+    eager = window <= 0 and (
+        mode == "off"
+        or os.path.getsize(path) < _STREAM_AUTO_BYTES
+    )
+    if not eager and path.endswith(".gz"):
+        logger.warning(
+            "--stream-clusters needs a plain MGF (gz has no byte index); "
+            "loading eagerly"
+        )
+        eager = True
+
     # explicit opt-in site for the C++ fast parser: the CLI (unlike
     # library reads) may spawn the one-shot in-tree build
     from specpride_tpu.io import native
 
     native.ensure_built()
+    if eager:
+        with stats.phase("parse"):
+            spectra = read_mgf(path)
+            clusters = group_into_clusters(spectra)
+        stats.count("spectra_in", len(spectra))
+        stats.count("peaks_in", sum(s.n_peaks for s in spectra))
+        return clusters
+
+    from specpride_tpu.io.mgf import StreamedClusters
+
     with stats.phase("parse"):
-        spectra = read_mgf(path)
-        clusters = group_into_clusters(spectra)
-    stats.count("spectra_in", len(spectra))
-    stats.count("peaks_in", sum(s.n_peaks for s in spectra))
+        clusters = StreamedClusters(path, window=window or 512)
+    logger.info(
+        "streaming %d clusters (%d spectra) in windows of %d",
+        len(clusters), clusters.n_spectra, clusters.window,
+    )
+    stats.count("spectra_in", clusters.n_spectra)
     return clusters
 
 
 def cmd_consensus(args) -> int:
     stats = RunStats()
-    clusters = _load_clusters(args.input, stats)
+    clusters = _load_clusters(
+        args.input, stats, getattr(args, "stream_clusters", "off")
+    )
     if args.single:
         # whole file = one cluster; the reference titles the result with
         # the output filename (ref average_spectrum_clustering.py:203-205).
@@ -515,7 +577,9 @@ def cmd_consensus(args) -> int:
 
 def cmd_select(args) -> int:
     stats = RunStats()
-    clusters = _load_clusters(args.input, stats)
+    clusters = _load_clusters(
+        args.input, stats, getattr(args, "stream_clusters", "off")
+    )
     backend = _get_backend(args)
     scores = _load_scores(args) if args.method == "best" else None
     clusters, args.output = _shard_for_process(clusters, args)
@@ -703,6 +767,12 @@ def build_parser() -> argparse.ArgumentParser:
         "same pass (bin-mean: fused with the consensus dispatch) and write "
         "the per-cluster QC report here",
     )
+    pc.add_argument(
+        "--stream-clusters", default="auto", metavar="N|auto|off",
+        help="bounded-memory ingest: parse member spectra in windows of N "
+        "clusters off a byte index instead of loading the whole MGF "
+        "(default auto: streams inputs over 256 MB)",
+    )
     pc.set_defaults(fn=cmd_consensus)
 
     ps = sub.add_parser("select", help="pick an existing member per cluster")
@@ -730,6 +800,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--qc-report", metavar="FILE",
         help="also compute each representative's mean member cosine and "
         "write the per-cluster QC report here",
+    )
+    ps.add_argument(
+        "--stream-clusters", default="auto", metavar="N|auto|off",
+        help="bounded-memory ingest: parse member spectra in windows of N "
+        "clusters off a byte index instead of loading the whole MGF "
+        "(default auto: streams inputs over 256 MB)",
     )
     ps.set_defaults(fn=cmd_select)
 

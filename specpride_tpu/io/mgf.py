@@ -27,7 +27,7 @@ from typing import IO, Iterator, Sequence
 
 import numpy as np
 
-from specpride_tpu.data.peaks import Spectrum
+from specpride_tpu.data.peaks import Cluster, Spectrum, parse_title
 
 
 def _open_text(path: str | os.PathLike) -> IO[str]:
@@ -193,6 +193,121 @@ class IndexedMGF:
             fh.seek(begin)
             chunk = fh.read(end - begin).decode("utf-8")
         return next(parse_mgf_stream(io.StringIO(chunk)))
+
+
+class StreamedClusters:
+    """Bounded-memory, list-like cluster access over a clustered MGF.
+
+    The reference streams clusters from an indexed MGF instead of loading
+    the file (ref src/average_spectrum_clustering.py:151-160); whole-file
+    ``read_mgf`` caps input size at host RAM.  One byte-offset index pass
+    records every record's (title, range) WITHOUT parsing peaks; member
+    spectra then parse lazily in WINDOWS of clusters, and only the current
+    window stays cached — peak RSS is O(index + window), flat in file size.
+
+    Order parity with ``read_mgf`` + ``group_into_clusters``: first-seen
+    cluster order, in-file member order (scattered members supported).
+    Integer indexing materialises the window containing the cluster;
+    slicing returns a sub-view sharing the index.  Plain files only
+    (callers fall back to eager loading for ``.gz``).
+    """
+
+    def __init__(self, path: str | os.PathLike, window: int = 512,
+                 _groups=None):
+        self.path = os.fspath(path)
+        self.window = max(int(window), 1)
+        if _groups is not None:
+            self._groups = _groups
+        else:
+            records = self._scan()
+            by_id: dict[str, list[tuple[int, int]]] = {}
+            for title, begin, end in records:
+                cid, _ = parse_title(title)
+                by_id.setdefault(cid, []).append((begin, end))
+            self._groups = list(by_id.items())
+        self._cache_lo = -1
+        self._cache: list[Cluster] = []
+
+    def _scan(self) -> list[tuple[str, int, int]]:
+        records = []
+        with open(self.path, "rb") as fh:
+            offset = 0
+            begin = -1
+            title = None
+            for line in fh:
+                stripped = line.strip()
+                if stripped == b"BEGIN IONS":
+                    begin = offset
+                    title = None
+                elif stripped.startswith(b"TITLE="):
+                    title = stripped[6:].decode("utf-8")
+                elif stripped == b"END IONS" and begin >= 0:
+                    records.append((
+                        title if title is not None
+                        else f"index={len(records)}",
+                        begin, offset + len(line),
+                    ))
+                    begin = -1
+                offset += len(line)
+        return records
+
+    @property
+    def cluster_ids(self) -> list[str]:
+        return [cid for cid, _ in self._groups]
+
+    @property
+    def n_spectra(self) -> int:
+        return sum(len(r) for _, r in self._groups)
+
+    def __len__(self) -> int:
+        return len(self._groups)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return StreamedClusters(
+                self.path, self.window, _groups=self._groups[key]
+            )
+        i = int(key)
+        if i < 0:
+            i += len(self._groups)
+        if not 0 <= i < len(self._groups):
+            raise IndexError(key)
+        lo = (i // self.window) * self.window
+        if lo != self._cache_lo:
+            self._cache_lo = lo
+            self._cache = self._materialize(
+                self._groups[lo : lo + self.window]
+            )
+        return self._cache[i - lo]
+
+    def __iter__(self):
+        for i in range(len(self._groups)):
+            yield self[i]
+
+    def _materialize(self, groups) -> list[Cluster]:
+        # merge exactly-adjacent byte ranges so a cluster-contiguous file
+        # (the common convert output) reads as a handful of large spans
+        ranges = sorted(
+            (begin, end, cid)
+            for cid, recs in groups
+            for begin, end in recs
+        )
+        spans: list[list[int]] = []
+        for begin, end, _ in ranges:
+            if spans and begin == spans[-1][1]:
+                spans[-1][1] = end
+            else:
+                spans.append([begin, end])
+        members: dict[str, list[Spectrum]] = {cid: [] for cid, _ in groups}
+        wanted = set(members)
+        with open(self.path, "rb") as fh:
+            for begin, end in spans:
+                fh.seek(begin)
+                chunk = fh.read(end - begin).decode("utf-8")
+                for s in parse_mgf_stream(io.StringIO(chunk)):
+                    if s.cluster_id in wanted:
+                        members[s.cluster_id].append(s)
+        return [Cluster(cid, members[cid]) for cid, _ in groups]
 
 
 def format_spectrum(spectrum: Spectrum, skip_nan: bool = True) -> str:

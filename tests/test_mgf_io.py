@@ -174,3 +174,85 @@ def test_percolator_unrecognized_header_raises(tmp_path):
     empty = tmp_path / "empty.tsv"
     empty.write_text("file\tscan\tpercolator score\n")
     assert read_percolator_scores(empty) == {}
+
+
+class TestStreamedClusters:
+    """Bounded-memory windowed cluster access (the reference's IndexedMGF
+    streaming, ref src/average_spectrum_clustering.py:151-160)."""
+
+    def _write(self, tmp_path, rng, n_clusters=9, scatter=False):
+        from specpride_tpu.data.peaks import Spectrum, build_title
+
+        spectra = []
+        for ci in range(n_clusters):
+            for m in range(2 + ci % 3):
+                mz = np.sort(rng.uniform(100, 1500, 25))
+                spectra.append(Spectrum(
+                    mz=mz, intensity=rng.uniform(1, 100, 25),
+                    precursor_mz=400.0 + ci, precursor_charge=2,
+                    rt=float(m),
+                    title=build_title(f"cluster-{ci}", "PXD1", "r.raw",
+                                      ci * 100 + m),
+                ))
+        if scatter:
+            # interleave members of different clusters through the file
+            order = rng.permutation(len(spectra))
+            spectra = [spectra[i] for i in order]
+        path = tmp_path / "clustered.mgf"
+        write_mgf(spectra, path)
+        return path, spectra
+
+    def test_matches_eager_grouping(self, tmp_path, rng):
+        from specpride_tpu.io.mgf import StreamedClusters
+
+        path, spectra = self._write(tmp_path, rng)
+        eager = group_into_clusters(read_mgf(path))
+        streamed = StreamedClusters(path, window=3)
+        assert len(streamed) == len(eager)
+        assert streamed.cluster_ids == [c.cluster_id for c in eager]
+        assert streamed.n_spectra == len(spectra)
+        for a, b in zip(streamed, eager):
+            assert a.cluster_id == b.cluster_id
+            assert [s.title for s in a.members] == [
+                s.title for s in b.members
+            ]
+            for sa, sb in zip(a.members, b.members):
+                np.testing.assert_allclose(sa.mz, sb.mz)
+                np.testing.assert_allclose(sa.intensity, sb.intensity)
+
+    def test_scattered_members(self, tmp_path, rng):
+        """Members of one cluster scattered through the file regroup in
+        in-file order, exactly as eager grouping does."""
+        from specpride_tpu.io.mgf import StreamedClusters
+
+        path, _ = self._write(tmp_path, rng, scatter=True)
+        eager = group_into_clusters(read_mgf(path))
+        streamed = StreamedClusters(path, window=2)
+        assert streamed.cluster_ids == [c.cluster_id for c in eager]
+        for a, b in zip(streamed, eager):
+            assert [s.title for s in a.members] == [
+                s.title for s in b.members
+            ]
+
+    def test_only_one_window_cached(self, tmp_path, rng):
+        """Peak memory is one window of parsed clusters, not the file."""
+        from specpride_tpu.io.mgf import StreamedClusters
+
+        path, _ = self._write(tmp_path, rng, n_clusters=12)
+        streamed = StreamedClusters(path, window=4)
+        for c in streamed:
+            assert len(streamed._cache) <= 4
+        # jumping back re-materialises the earlier window
+        first = streamed[0]
+        assert streamed._cache_lo == 0
+        assert first.cluster_id == "cluster-0"
+
+    def test_slicing_returns_view(self, tmp_path, rng):
+        from specpride_tpu.io.mgf import StreamedClusters
+
+        path, _ = self._write(tmp_path, rng, n_clusters=10)
+        streamed = StreamedClusters(path, window=4)
+        view = streamed[3:7]
+        assert len(view) == 4
+        assert view.cluster_ids == streamed.cluster_ids[3:7]
+        assert view[0].cluster_id == "cluster-3"

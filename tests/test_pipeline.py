@@ -788,3 +788,57 @@ class TestCli:
         ]) == 0
         reps = read_mgf(out)
         assert [s.title for s in reps] == [c.cluster_id for c in clusters]
+
+
+class TestStreamingIngest:
+    def test_streamed_consensus_matches_eager(self, tmp_path, rng):
+        """--stream-clusters N produces byte-identical output to eager
+        ingest (same cluster order, same chunking semantics via the
+        window), with bounded memory."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=25)
+            for i in range(11)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        eager_out = tmp_path / "eager.mgf"
+        stream_out = tmp_path / "stream.mgf"
+        assert cli_main([
+            "consensus", str(clustered), str(eager_out),
+            "--backend", "numpy", "--stream-clusters", "off",
+        ]) == 0
+        assert cli_main([
+            "consensus", str(clustered), str(stream_out),
+            "--backend", "numpy", "--stream-clusters", "4",
+        ]) == 0
+        assert eager_out.read_bytes() == stream_out.read_bytes()
+
+    def test_streamed_resume_and_qc(self, tmp_path, rng):
+        """Streaming composes with checkpoint/resume and the QC report:
+        a resumed streamed run recomputes QC for done clusters without
+        loading the file whole."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=2, n_peaks=20)
+            for i in range(8)
+        ]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        out = tmp_path / "out.mgf"
+        ckpt = tmp_path / "ckpt.json"
+        qc = tmp_path / "qc.json"
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--backend", "numpy",
+            "--stream-clusters", "3", "--checkpoint", str(ckpt),
+            "--checkpoint-every", "3",
+        ]) == 0
+        # resume over a finished run: everything skipped, QC recomputed
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--backend", "numpy",
+            "--stream-clusters", "3", "--checkpoint", str(ckpt),
+            "--checkpoint-every", "3", "--qc-report", str(qc),
+        ]) == 0
+        report = json.loads(qc.read_text())
+        assert report["summary"]["n_clusters"] == 8
+        assert [r["cluster_id"] for r in report["clusters"]] == [
+            c.cluster_id for c in clusters
+        ]
