@@ -547,11 +547,59 @@ def _load_clusters(path: str, stats: RunStats, stream: str = "off"):
     return clusters
 
 
+def _is_mzml(path: str) -> bool:
+    return path.lower().endswith((".mzml", ".mzml.gz"))
+
+
+def _clusters_from_mzml(path: str, args, stats: RunStats) -> list[Cluster]:
+    """Direct mzML + MaRaCluster ingestion — the reference's C1 entry that
+    needs no pre-conversion step (ref src/binning.py:33-118: read the
+    cluster list, read exactly the clustered scans, group): titles become
+    ``cluster;usi`` on the fly, with peptide interpretations when --msms
+    is given (optional, as in the reference)."""
+    from specpride_tpu.data.peaks import build_title
+    from specpride_tpu.io.maracluster import scan_to_cluster
+    from specpride_tpu.io.maxquant import read_msms_peptides
+    from specpride_tpu.io.mzml import read_mzml_scans
+
+    if not getattr(args, "clusters", None):
+        raise SystemExit(
+            "an .mzML input needs --clusters <MaRaCluster TSV> (or run "
+            "`specpride convert` first)"
+        )
+    with stats.phase("parse"):
+        cluster_of = scan_to_cluster(args.clusters)
+        spectra = read_mzml_scans(path, scans=set(cluster_of))
+        peptides = (
+            read_msms_peptides(args.msms)
+            if getattr(args, "msms", None)
+            else {}
+        )
+    raw = (
+        getattr(args, "raw_name", None)
+        or os.path.basename(path).split(".")[0]
+    )
+    px = getattr(args, "px_accession", "PXD004732")
+    out = []
+    for scan in sorted(spectra):
+        s = spectra[scan]
+        s.title = build_title(
+            cluster_of[scan], px, raw, scan, peptides.get(scan),
+            s.precursor_charge if peptides.get(scan) else None,
+        )
+        out.append(s)
+    stats.count("spectra_in", len(out))
+    return group_into_clusters(out)
+
+
 def cmd_consensus(args) -> int:
     stats = RunStats()
-    clusters = _load_clusters(
-        args.input, stats, getattr(args, "stream_clusters", "off")
-    )
+    if _is_mzml(args.input):
+        clusters = _clusters_from_mzml(args.input, args, stats)
+    else:
+        clusters = _load_clusters(
+            args.input, stats, getattr(args, "stream_clusters", "off")
+        )
     if args.single:
         # whole file = one cluster; the reference titles the result with
         # the output filename (ref average_spectrum_clustering.py:203-205).
@@ -577,9 +625,12 @@ def cmd_consensus(args) -> int:
 
 def cmd_select(args) -> int:
     stats = RunStats()
-    clusters = _load_clusters(
-        args.input, stats, getattr(args, "stream_clusters", "off")
-    )
+    if _is_mzml(args.input):
+        clusters = _clusters_from_mzml(args.input, args, stats)
+    else:
+        clusters = _load_clusters(
+            args.input, stats, getattr(args, "stream_clusters", "off")
+        )
     backend = _get_backend(args)
     scores = _load_scores(args) if args.method == "best" else None
     clusters, args.output = _shard_for_process(clusters, args)
@@ -687,9 +738,11 @@ def cmd_plot(args) -> int:
     from specpride_tpu import viz
     from specpride_tpu.data.peaks import peptide_from_usi
 
-    clusters = {
-        c.cluster_id: c for c in group_into_clusters(read_mgf(args.clustered))
-    }
+    if _is_mzml(args.clustered):
+        cluster_list = _clusters_from_mzml(args.clustered, args, RunStats())
+    else:
+        cluster_list = group_into_clusters(read_mgf(args.clustered))
+    clusters = {c.cluster_id: c for c in cluster_list}
     if args.cluster_id not in clusters:
         print(f"cluster {args.cluster_id!r} not found", file=sys.stderr)
         return 1
@@ -773,6 +826,16 @@ def build_parser() -> argparse.ArgumentParser:
         "clusters off a byte index instead of loading the whole MGF "
         "(default auto: streams inputs over 256 MB)",
     )
+    pc.add_argument(
+        "--clusters",
+        help="MaRaCluster TSV — consume a raw .mzML input directly, no "
+        "convert step (ref binning.py:33-118)",
+    )
+    pc.add_argument("--msms", help="MaxQuant msms.txt for peptide titles "
+                                   "(direct .mzML input; optional)")
+    pc.add_argument("--raw-name", help="raw file name for USIs "
+                                       "(direct .mzML input)")
+    pc.add_argument("--px-accession", default="PXD004732")
     pc.set_defaults(fn=cmd_consensus)
 
     ps = sub.add_parser("select", help="pick an existing member per cluster")
@@ -807,6 +870,11 @@ def build_parser() -> argparse.ArgumentParser:
         "clusters off a byte index instead of loading the whole MGF "
         "(default auto: streams inputs over 256 MB)",
     )
+    ps.add_argument(
+        "--clusters",
+        help="MaRaCluster TSV — consume a raw .mzML input directly, no "
+        "convert step (--msms then also provides peptide titles)",
+    )
     ps.set_defaults(fn=cmd_select)
 
     pv = sub.add_parser("convert", help="build the clustered-MGF interchange file")
@@ -839,11 +907,19 @@ def build_parser() -> argparse.ArgumentParser:
     pm.set_defaults(fn=cmd_merge_parts)
 
     pp = sub.add_parser("plot", help="mirror plots for one cluster")
-    pp.add_argument("clustered")
+    pp.add_argument("clustered",
+                    help="clustered MGF, or a raw .mzML with --clusters")
     pp.add_argument("cluster_id")
     pp.add_argument("out_prefix")
     pp.add_argument("--consensus", help="representatives MGF (vs-consensus mode)")
     pp.add_argument("--peptide", help="peptide for the theoretical mirror")
+    pp.add_argument("--clusters",
+                    help="MaRaCluster TSV (direct .mzML input, "
+                         "ref plot_cluster.py:50-86)")
+    pp.add_argument("--msms", help="MaxQuant msms.txt for peptide titles "
+                                   "(direct .mzML input)")
+    pp.add_argument("--raw-name", help="raw file name for USIs")
+    pp.add_argument("--px-accession", default="PXD004732")
     pp.set_defaults(fn=cmd_plot)
 
     return ap

@@ -842,3 +842,78 @@ class TestStreamingIngest:
         assert [r["cluster_id"] for r in report["clusters"]] == [
             c.cluster_id for c in clusters
         ]
+
+
+class TestDirectMzml:
+    """Direct mzML + MaRaCluster workflows (ref src/binning.py:33-118 and
+    src/plot_cluster.py:50-86 need no pre-conversion step)."""
+
+    def test_consensus_direct_equals_convert_route(self, tmp_path, rng,
+                                                   raw_spectra):
+        _, msms, tsv = write_inputs(tmp_path, raw_spectra)
+        mzml = tmp_path / "raw.mzML"
+        write_mzml([(scan, s, {}) for scan, s in raw_spectra], mzml)
+        # route A: convert -> consensus
+        clustered = tmp_path / "clustered.mgf"
+        assert cli_main([
+            "convert", str(mzml), str(clustered), "--msms", str(msms),
+            "--clusters", str(tsv), "--raw-name", "raw",
+        ]) == 0
+        out_a = tmp_path / "a.mgf"
+        assert cli_main([
+            "consensus", str(clustered), str(out_a), "--backend", "numpy",
+        ]) == 0
+        # route B: direct mzML
+        out_b = tmp_path / "b.mgf"
+        assert cli_main([
+            "consensus", str(mzml), str(out_b), "--backend", "numpy",
+            "--clusters", str(tsv), "--msms", str(msms),
+            "--raw-name", "raw",
+        ]) == 0
+        assert out_a.read_bytes() == out_b.read_bytes()
+
+    def test_consensus_direct_without_msms(self, tmp_path, rng, raw_spectra):
+        """The reference's C1 needs no peptide IDs — neither do we."""
+        _, _, tsv = write_inputs(tmp_path, raw_spectra)
+        mzml = tmp_path / "raw.mzML"
+        write_mzml([(scan, s, {}) for scan, s in raw_spectra], mzml)
+        out = tmp_path / "o.mgf"
+        assert cli_main([
+            "consensus", str(mzml), str(out), "--backend", "numpy",
+            "--clusters", str(tsv),
+        ]) == 0
+        reps = read_mgf(out)
+        assert sorted(s.cluster_id for s in reps) == [
+            "cluster-1", "cluster-2",
+        ]
+
+    def test_consensus_mzml_requires_clusters(self, tmp_path, rng,
+                                              raw_spectra):
+        mzml = tmp_path / "raw.mzML"
+        write_mzml([(scan, s, {}) for scan, s in raw_spectra], mzml)
+        with pytest.raises(SystemExit, match="clusters"):
+            cli_main([
+                "consensus", str(mzml), str(tmp_path / "o.mgf"),
+                "--backend", "numpy",
+            ])
+
+    def test_select_direct_mzml(self, tmp_path, rng, raw_spectra):
+        _, msms, tsv = write_inputs(tmp_path, raw_spectra)
+        mzml = tmp_path / "raw.mzML"
+        write_mzml([(scan, s, {}) for scan, s in raw_spectra], mzml)
+        out = tmp_path / "sel.mgf"
+        assert cli_main([
+            "select", str(mzml), str(out), "--method", "medoid",
+            "--backend", "numpy", "--clusters", str(tsv),
+        ]) == 0
+        assert len(read_mgf(out)) == 2
+
+    def test_plot_direct_mzml(self, tmp_path, rng, raw_spectra):
+        _, msms, tsv = write_inputs(tmp_path, raw_spectra)
+        mzml = tmp_path / "raw.mzML"
+        write_mzml([(scan, s, {}) for scan, s in raw_spectra], mzml)
+        assert cli_main([
+            "plot", str(mzml), "cluster-1", str(tmp_path / "m"),
+            "--clusters", str(tsv), "--msms", str(msms),
+        ]) == 0
+        assert os.path.getsize(f"{tmp_path}/m_0.png") > 1000
