@@ -233,6 +233,68 @@ def bench_end_to_end(clusters, workdir: str, runs: int = 2) -> dict:
     }
 
 
+def bench_sweep(clusters, backend, nb) -> dict:
+    """BASELINE configs[3]: the ppm-tolerance grid sweep and the sqrt/log
+    intensity-normalization sweep.  Grid rows time the bin-mean method on
+    both backends per tolerance config (full-set oracle, one steady device
+    run); normalization rows time the fused pipeline per transform and
+    record the mean QC cosine so the knob's effect is visible."""
+    from specpride_tpu.config import BinMeanConfig, CosineConfig
+    from specpride_tpu.utils.observe import RunStats
+
+    grid_rows = []
+    for label, cfg in [
+        ("da-0.02", BinMeanConfig()),
+        ("ppm-5", BinMeanConfig(tolerance_mode="ppm", ppm=5.0)),
+        ("ppm-20", BinMeanConfig(tolerance_mode="ppm", ppm=20.0)),
+        ("ppm-50", BinMeanConfig(tolerance_mode="ppm", ppm=50.0)),
+    ]:
+        t0 = time.perf_counter()
+        nb.run_bin_mean(clusters, cfg)
+        np_s = time.perf_counter() - t0
+        backend.run_bin_mean(clusters, cfg)  # warm-up / compile
+        backend.stats = RunStats()
+        t0 = time.perf_counter()
+        out = backend.run_bin_mean(clusters, cfg)
+        dev_s = time.perf_counter() - t0
+        assert len(out) == len(clusters)
+        eprint(
+            f"[sweep:{label}] n_bins={cfg.n_bins} numpy "
+            f"{len(clusters) / np_s:.0f} cl/s device "
+            f"{len(clusters) / dev_s:.0f} cl/s"
+        )
+        grid_rows.append({
+            "grid": label,
+            "n_bins": cfg.n_bins,
+            "numpy_clusters_per_sec": round(len(clusters) / np_s, 2),
+            "device_clusters_per_sec": round(len(clusters) / dev_s, 2),
+            "speedup_vs_numpy": round(np_s / dev_s, 3),
+        })
+
+    norm_rows = []
+    for norm in ("none", "sqrt", "log"):
+        ccfg = CosineConfig(normalization=norm)
+        backend.run_bin_mean_with_cosines(
+            clusters, BinMeanConfig(), ccfg
+        )  # warm-up
+        backend.stats = RunStats()
+        t0 = time.perf_counter()
+        _, cos = backend.run_bin_mean_with_cosines(
+            clusters, BinMeanConfig(), ccfg
+        )
+        dev_s = time.perf_counter() - t0
+        eprint(
+            f"[sweep:norm-{norm}] {len(clusters) / dev_s:.0f} cl/s "
+            f"mean_cosine={float(np.mean(cos)):.4f}"
+        )
+        norm_rows.append({
+            "normalization": norm,
+            "device_clusters_per_sec": round(len(clusters) / dev_s, 2),
+            "mean_cosine": round(float(np.mean(cos)), 5),
+        })
+    return {"tolerance_grid": grid_rows, "normalization": norm_rows}
+
+
 def pallas_ab(clusters) -> dict | None:
     """On-chip A/B of the K1 segmented-scan core: XLA shift/select
     formulation (ops.segments.seg_scan) vs the Pallas single-pass kernel
@@ -251,10 +313,7 @@ def pallas_ab(clusters) -> dict | None:
     if not pk.available() or pk.pl is None:
         return None
     cfg = BinMeanConfig()
-    batch = pack_flat_bin_mean(
-        clusters, cfg.min_mz, cfg.max_mz, cfg.bin_size, cfg.n_bins,
-        max_elements=1 << 24,
-    )[0]
+    batch = pack_flat_bin_mean(clusters, cfg, max_elements=1 << 24)[0]
     n = batch.gbin.size
     n_pad = -(-n // pk.BLK) * pk.BLK
     sent = np.int32(2**31 - 1)
@@ -379,6 +438,7 @@ def main() -> None:
             # collection pass between methods keeps runs comparable to
             # standalone --method invocations
             gc.collect()
+        report["sweep"] = bench_sweep(clusters, backend, nb)
         import tempfile
 
         with tempfile.TemporaryDirectory() as workdir:

@@ -64,10 +64,13 @@ def bin_mean_consensus(
     charges = [s.precursor_charge for s in members]
 
     for s in members:
-        keep = (s.mz >= config.min_mz) & (s.mz < config.max_mz)
+        # grid quantization shared with the device packers
+        # (ops.quantize.bin_mean_bins): "da" fixed grid or "ppm"
+        # mass-proportional bins
+        bins64, keep = quantize.bin_mean_bins(s.mz, config)
         mz = s.mz[keep]
         inten = s.intensity[keep]
-        bins = ((mz - config.min_mz) / config.bin_size).astype(int)
+        bins = bins64[keep]
         # numpy buffered fancy-index += : duplicate bins within this member
         # collapse to the last occurrence (ref src/binning.py:197-199)
         counts[bins] += 1
@@ -368,7 +371,10 @@ def binned_cosine(
         # scipy binned_statistic puts values equal to the last edge into the
         # final bin (right-closed last bin)
         idx = np.where(idx == edges.size - 1, edges.size - 2, idx)
-        np.add.at(vec, idx[ok], s.intensity[ok])
+        # optional sqrt/log intensity transform (BASELINE configs[3]),
+        # shared with the device/native paths via ops.quantize
+        weights = quantize.cosine_normalize(s.intensity, config)
+        np.add.at(vec, idx[ok], weights[ok])
         return vec
 
     va, vb = binned(a), binned(b)

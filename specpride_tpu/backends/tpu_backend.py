@@ -330,14 +330,7 @@ class TpuBackend:
         pending = []
         st = self.stats
         for batch in self._timed_batches(
-            pack_bucketize_bin_mean(
-                clusters,
-                config.min_mz,
-                config.max_mz,
-                config.bin_size,
-                config.n_bins,
-                self.batch_config,
-            )
+            pack_bucketize_bin_mean(clusters, config, self.batch_config)
         ):
             b, k = batch.mz.shape
             chunk = max(1, self.max_grid_elements // max(k * 4, 1))
@@ -454,12 +447,7 @@ class TpuBackend:
         # time the call itself, not just iteration
         with st.phase("pack"):
             batches = pack_flat_bin_mean(
-                clusters,
-                config.min_mz,
-                config.max_mz,
-                config.bin_size,
-                config.n_bins,
-                max_elements=self.max_grid_elements // 4,
+                clusters, config, max_elements=self.max_grid_elements // 4
             )
         for batch in batches:
             with st.phase("dispatch"):
@@ -865,7 +853,9 @@ class TpuBackend:
                 for ci, gi in enumerate(idxs):
                     r = representatives[gi]
                     rep_mz[ci, : r.n_peaks] = r.mz
-                    rep_int[ci, : r.n_peaks] = r.intensity
+                    rep_int[ci, : r.n_peaks] = quantize.cosine_normalize(
+                        r.intensity, config
+                    )
                     rep_valid[ci, : r.n_peaks] = True
                     for mi, mem in enumerate(clusters[gi].members):
                         if mem.n_peaks:
@@ -894,7 +884,11 @@ class TpuBackend:
                 key = mm * (1 << 31) + mem_bins
                 m_order = np.argsort(key, axis=1, kind="stable")
                 mem_bins = np.take_along_axis(mem_bins, m_order, axis=1)
-                mem_int = np.take_along_axis(batch.intensity, m_order, axis=1)
+                mem_int = np.take_along_axis(
+                    quantize.cosine_normalize(batch.intensity, config)
+                    .astype(np.float32),
+                    m_order, axis=1,
+                )
                 mem_mm = np.take_along_axis(
                     mm.astype(np.int32), m_order, axis=1
                 )
@@ -994,14 +988,8 @@ class TpuBackend:
             max_el = min(
                 self.max_grid_elements // 4, max(total // 6 + 1, 1 << 19)
             )
-            batches = pack_flat_bin_mean(
-                table,
-                bin_config.min_mz,
-                bin_config.max_mz,
-                bin_config.bin_size,
-                bin_config.n_bins,
-                max_elements=max_el,
-            )
+            batches = pack_flat_bin_mean(table, bin_config,
+                                         max_elements=max_el)
 
         out: list[Spectrum | None] = [None] * len(clusters)
         cosines = np.zeros(len(clusters), dtype=np.float64)
@@ -1022,7 +1010,7 @@ class TpuBackend:
             # attributable (dispatch = H2D+call, device = kernel, d2h =
             # pure transfer) — overlap is deliberately given up
             with st.phase("pack"):
-                mprep = self._prep_cosine_native(table)
+                mprep = self._prep_cosine_native(table, cos_config)
             for batch in batches:
                 with st.phase("dispatch"):
                     fused, cap, rows = self._flat_chunk_dispatch(
@@ -1048,7 +1036,7 @@ class TpuBackend:
                 with st.phase("dispatch"):
                     futs = [ex.submit(run_chunk, b) for b in batches]
                 with st.phase("pack"):
-                    mprep = self._prep_cosine_native(table)
+                    mprep = self._prep_cosine_native(table, cos_config)
                 for batch, fut in zip(batches, futs):
                     with st.phase("d2h"):
                         fused, cap, rows = fut.result()
@@ -1075,7 +1063,7 @@ class TpuBackend:
                 title=batch.cluster_ids[ci],
             )
 
-    def _prep_cosine_native(self, clusters):
+    def _prep_cosine_native(self, clusters, config: CosineConfig):
         """Representative-independent half of the NATIVE cosine path: the
         flat member layout (one gather off the columnar table — no
         quantization, no sort: the C++ kernel bins on the fly in cache).
@@ -1095,7 +1083,7 @@ class TpuBackend:
         np.cumsum(idx.n_members, out=cso[1:])
         return dict(
             mem_mz=table.mz[src],
-            mem_int=table.intensity[src],
+            mem_int=quantize.cosine_normalize(table.intensity[src], config),
             spec_offsets=spec_offsets,
             cluster_spec_offsets=cso,
             n_members=idx.n_members,
@@ -1125,6 +1113,7 @@ class TpuBackend:
             if rep_offsets[-1]
             else np.zeros(0, np.float64)
         )
+        rep_int = quantize.cosine_normalize(rep_int, config)
         cso = mprep["cluster_spec_offsets"]
         s0, s1 = int(cso[lo]), int(cso[hi])
         p0 = int(mprep["spec_offsets"][s0])
@@ -1160,7 +1149,7 @@ class TpuBackend:
         mesh runs)."""
         st = self.stats
         with st.phase("pack"):
-            mprep = self._prep_cosine_native(clusters)
+            mprep = self._prep_cosine_native(clusters, config)
         with st.phase("compute"):
             out = self._cosine_native_rows(
                 representatives, mprep, config, 0, len(clusters)
@@ -1206,7 +1195,9 @@ class TpuBackend:
         row_pk = np.repeat(sorted_code, cnt)
         src = np.repeat(table.peak_offsets[order], cnt) + _grouped_arange(cnt)
         mz64 = table.mz[src]
-        inten = table.intensity[src].astype(np.float32)
+        inten = quantize.cosine_normalize(
+            table.intensity[src], config
+        ).astype(np.float32)
         cbin = np.maximum(
             np.floor((mz64 + space / 2.0) / space).astype(np.int64), 0
         )
@@ -1279,8 +1270,13 @@ class TpuBackend:
             else np.zeros(0, np.float64)
         )
         rep_in = (
-            np.concatenate([np.asarray(representatives[i].intensity,
-                                       np.float32) for i in range(c)])
+            quantize.cosine_normalize(
+                np.concatenate([
+                    np.asarray(representatives[i].intensity, np.float64)
+                    for i in range(c)
+                ]),
+                config,
+            ).astype(np.float32)
             if rep_counts.sum()
             else np.zeros(0, np.float32)
         )

@@ -168,12 +168,20 @@ def _load_scores(args) -> dict[str, float]:
     )
 
 
-def _cosines_of(backend, reps, clusters):
+def _cosine_config(args) -> CosineConfig:
+    return CosineConfig(
+        normalization=getattr(args, "qc_normalization", None) or "none"
+    )
+
+
+def _cosines_of(backend, reps, clusters, config=None):
     """Mean member cosine per cluster on whichever backend is active."""
+    config = config or CosineConfig()
     if hasattr(backend, "average_cosines"):  # device backend: one dispatch
-        return backend.average_cosines(reps, clusters)
+        return backend.average_cosines(reps, clusters, config)
     return [
-        backend.average_cosine(r, c.members) for r, c in zip(reps, clusters)
+        backend.average_cosine(r, c.members, config)
+        for r, c in zip(reps, clusters)
     ]
 
 
@@ -226,7 +234,7 @@ def _write_qc_report(
                         [c for _, c in pairs],
                         _cosines_of(
                             backend, [r for r, _ in pairs],
-                            [c for _, c in pairs],
+                            [c for _, c in pairs], _cosine_config(args),
                         ),
                     )
     order = {cid: i for i, cid in enumerate(all_ids)}
@@ -271,12 +279,14 @@ def _run_method(backend, method: str, clusters, args, scores=None,
             min_mz=args.min_mz, max_mz=args.max_mz, bin_size=args.bin_size,
             apply_peak_quorum=not args.no_quorum,
             quorum_fraction=args.quorum_fraction,
+            tolerance_mode=getattr(args, "tolerance_mode", "da"),
+            ppm=getattr(args, "ppm", 20.0),
         )
         if qc is not None and hasattr(backend, "run_bin_mean_with_cosines"):
             # fused consensus + QC: the cosine member prep overlaps the
             # consensus D2H stream (see TpuBackend.run_bin_mean_with_cosines)
             reps, cosines = backend.run_bin_mean_with_cosines(
-                clusters, config, CosineConfig()
+                clusters, config, _cosine_config(args)
             )
             _append_qc_rows(qc, clusters, cosines)
             return reps
@@ -457,6 +467,7 @@ def _checkpointed_run(
                         _cosines_of(
                             backend,
                             [by_id[c.cluster_id] for c in kept], kept,
+                            _cosine_config(args),
                         ),
                     )
             except (ValueError, RuntimeError) as e:
@@ -725,7 +736,9 @@ def cmd_evaluate(args) -> int:
             backend=(
                 "numpy" if args.backend == "numpy" else _get_backend(args)
             ),
-            cosine_config=CosineConfig(),
+            cosine_config=CosineConfig(
+                normalization=getattr(args, "normalization", "none")
+            ),
         )
     summary = metrics.summarize(results)
     if args.report:
@@ -792,6 +805,19 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--bin-size", type=float, default=0.02)
     pc.add_argument("--no-quorum", action="store_true")
     pc.add_argument("--quorum-fraction", type=float, default=0.25)
+    pc.add_argument(
+        "--tolerance-mode", choices=["da", "ppm"], default="da",
+        help="bin-mean grid: fixed-Da bins (reference) or "
+        "mass-proportional ppm bins",
+    )
+    pc.add_argument("--ppm", type=float, default=20.0,
+                    help="bin width in ppm for --tolerance-mode ppm")
+    pc.add_argument(
+        "--qc-normalization", choices=["none", "sqrt", "log"],
+        default="none",
+        help="intensity transform for the QC cosine (sqrt tempers "
+        "dominant peaks; log flattens dynamic range)",
+    )
     pc.add_argument("--mz-accuracy", type=float, default=0.01)
     pc.add_argument("--dyn-range", type=float, default=1000.0)
     pc.add_argument("--min-fraction", type=float, default=0.5)
@@ -875,6 +901,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="MaRaCluster TSV — consume a raw .mzML input directly, no "
         "convert step (--msms then also provides peptide titles)",
     )
+    ps.add_argument(
+        "--qc-normalization", choices=["none", "sqrt", "log"],
+        default="none",
+        help="intensity transform for the QC cosine",
+    )
     ps.set_defaults(fn=cmd_select)
 
     pv = sub.add_parser("convert", help="build the clustered-MGF interchange file")
@@ -891,6 +922,10 @@ def build_parser() -> argparse.ArgumentParser:
     pe.add_argument("clustered")
     _add_backend(pe)
     pe.add_argument("--report", help="write per-cluster report to this path")
+    pe.add_argument(
+        "--normalization", choices=["none", "sqrt", "log"], default="none",
+        help="intensity transform for the cosine metric",
+    )
     pe.add_argument("--format", choices=["json", "csv"], default="json")
     pe.set_defaults(fn=cmd_evaluate)
 

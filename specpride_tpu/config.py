@@ -34,9 +34,24 @@ class BinMeanConfig:
     bin_size: float = 0.02
     apply_peak_quorum: bool = True
     quorum_fraction: float = 0.25
+    # grid generalization (BASELINE configs[3]): "da" is the reference's
+    # fixed-width grid; "ppm" uses mass-proportional bins of ``ppm`` parts
+    # per million (bin = floor(ln(mz/min_mz) / ln(1 + ppm*1e-6)) — width
+    # grows with m/z, matching instrument mass accuracy).  Quantization
+    # lives in ONE place (ops.quantize.bin_mean_bins) shared by the oracle
+    # and every packer.
+    tolerance_mode: Literal["da", "ppm"] = "da"
+    ppm: float = 20.0
 
     @property
     def n_bins(self) -> int:
+        if self.tolerance_mode == "ppm":
+            import math
+
+            return int(
+                math.log(self.max_mz / self.min_mz)
+                / math.log1p(self.ppm * 1e-6)
+            ) + 1
         # ref src/binning.py:172: int((max-min)/binsize) + 1
         return int((self.max_mz - self.min_mz) / self.bin_size) + 1
 
@@ -100,6 +115,11 @@ class CosineConfig:
 
     mz_unit: float = 1.000508
     mz_space_factor: float = 0.005
+    # intensity transform before binning (BASELINE configs[3]): "sqrt"
+    # tempers dominant peaks, "log" (log1p) flattens dynamic range —
+    # applied identically by the oracle, the native kernel wrapper, and
+    # both device packers (ops.quantize.cosine_normalize)
+    normalization: Literal["none", "sqrt", "log"] = "none"
 
     @property
     def mz_space(self) -> float:

@@ -350,13 +350,16 @@ def _dedup_keep_mask(
     return keep
 
 
-def _bin_quantize_dedup(table: SpectraTable, min_mz, max_mz, bin_size, n_bins):
-    """Shared K1 pack-time pass: f64 quantization, range filter, and
-    duplicate-(member, bin) drop.  Returns (bins64, kept_src, kept_counts,
-    kept_offsets, kept_totals)."""
+def _bin_quantize_dedup(table: SpectraTable, config):
+    """Shared K1 pack-time pass: f64 quantization (``quantize
+    .bin_mean_bins`` — the single grid implementation, da or ppm), range
+    filter, and duplicate-(member, bin) drop.  Returns (bins64, kept_src,
+    kept_counts, kept_offsets, kept_totals)."""
+    from specpride_tpu.ops import quantize
+
     mz = table.mz
-    in_range = (mz >= min_mz) & (mz < max_mz)
-    bins64 = ((mz - min_mz) / bin_size).astype(np.int64)
+    n_bins = config.n_bins
+    bins64, in_range = quantize.bin_mean_bins(mz, config)
     bins64 = np.where(in_range, np.clip(bins64, 0, n_bins - 1), -1)
     spec_of_peak = np.repeat(
         np.arange(table.n_spectra, dtype=np.int64), table.peak_counts
@@ -379,10 +382,7 @@ def _bin_quantize_dedup(table: SpectraTable, min_mz, max_mz, bin_size, n_bins):
 
 def pack_bucketize_bin_mean(
     clusters_or_table,
-    min_mz: float,
-    max_mz: float,
-    bin_size: float,
-    n_bins: int,
+    bin_config,
     config: BatchConfig = BatchConfig(),
 ) -> list[BinPackedBatch]:
     """Quantize (float64), dedup, and bucket clusters for the binned-mean
@@ -393,7 +393,7 @@ def pack_bucketize_bin_mean(
 
     mz = table.mz
     bins64, kept_src, kept_counts, kept_offsets, kept_totals = (
-        _bin_quantize_dedup(table, min_mz, max_mz, bin_size, n_bins)
+        _bin_quantize_dedup(table, bin_config)
     )
 
     eligible = idx.n_members > 0
@@ -417,7 +417,7 @@ def pack_bucketize_bin_mean(
         mzf[dest] = mz[src]
         inten = np.zeros(b * k, dtype=np.float32)
         inten[dest] = table.intensity[src]
-        pbins = np.full(b * k, n_bins, dtype=np.int32)
+        pbins = np.full(b * k, bin_config.n_bins, dtype=np.int32)
         pbins[dest] = bins64[src]
         # pre-sort each row by bin ON THE HOST (sentinel n_bins sorts the
         # padding last): the device kernel's per-row stable argsort was the
@@ -478,10 +478,7 @@ class FlatBinBatch:
 
 def pack_flat_bin_mean(
     clusters_or_table,
-    min_mz: float,
-    max_mz: float,
-    bin_size: float,
-    n_bins: int,
+    bin_config,
     max_elements: int = 16 * 1024 * 1024,
 ) -> list[FlatBinBatch]:
     """Quantize (f64), dedup, and lay out ALL kept peaks flat, sorted by
@@ -490,9 +487,10 @@ def pack_flat_bin_mean(
     composite stays inside int32."""
     table = _as_table(clusters_or_table)
     idx = table.cluster_order()
+    n_bins = bin_config.n_bins
 
     bins64, kept_src, kept_counts, kept_offsets, kept_totals = (
-        _bin_quantize_dedup(table, min_mz, max_mz, bin_size, n_bins)
+        _bin_quantize_dedup(table, bin_config)
     )
 
     c = table.n_clusters

@@ -68,6 +68,48 @@ def gap_segments(
     return mz, inten, seg
 
 
+def bin_mean_bins(
+    mz: np.ndarray, config: BinMeanConfig
+) -> tuple[np.ndarray, np.ndarray]:
+    """K1 grid quantization, float64 — THE single implementation shared by
+    the numpy oracle and every device packer (so the grids cannot drift).
+
+    Returns ``(bins64, in_range)``:
+
+    * ``"da"``: ``((mz - min_mz) / bin_size).astype(int64)`` — the
+      reference's fixed grid (ref src/binning.py:195);
+    * ``"ppm"``: ``floor(ln(mz / min_mz) / ln(1 + ppm*1e-6))`` —
+      mass-proportional bins whose width is ``ppm`` of the m/z at that
+      point (BASELINE configs[3] generalization; no reference analogue).
+
+    ``in_range`` is the reference's ``[min_mz, max_mz)`` window; bins of
+    out-of-range peaks are whatever the formula yields and must be masked
+    by the caller.
+    """
+    mzf = np.asarray(mz, dtype=np.float64)
+    in_range = (mzf >= config.min_mz) & (mzf < config.max_mz)
+    if config.tolerance_mode == "ppm":
+        width = np.log1p(config.ppm * 1e-6)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            bins = np.floor(
+                np.log(np.maximum(mzf, 1e-300) / config.min_mz) / width
+            ).astype(np.int64)
+    else:
+        bins = ((mzf - config.min_mz) / config.bin_size).astype(np.int64)
+    return bins, in_range
+
+
+def cosine_normalize(intensity: np.ndarray, config: CosineConfig) -> np.ndarray:
+    """Intensity transform before cosine binning (BASELINE configs[3]):
+    identity, sqrt, or log1p — one implementation for the oracle, the
+    native kernel wrapper, and both device packers."""
+    if config.normalization == "sqrt":
+        return np.sqrt(np.asarray(intensity, dtype=np.float64))
+    if config.normalization == "log":
+        return np.log1p(np.asarray(intensity, dtype=np.float64))
+    return intensity
+
+
 def distinct_bins_per_row(bins: np.ndarray, sentinel: int) -> np.ndarray:
     """(B,) number of distinct non-sentinel bin values per row — the exact
     per-cluster consensus output bound, used to size the globally-compacted

@@ -645,3 +645,69 @@ class TestOrdering:
         assert [s.title for s in device] == [c.cluster_id for c in clusters]
         for o, d in zip(oracle, device):
             assert_spectra_close(o, d)
+
+
+class TestPpmAndNormalization:
+    """BASELINE configs[3]: ppm-tolerance grid + sqrt/log intensity
+    normalization — oracle and device share ops.quantize, so parity must
+    hold on every layout."""
+
+    @pytest.mark.parametrize("layout", ["auto", "flat", "bucketized"])
+    @pytest.mark.parametrize("ppm", [5.0, 20.0, 50.0])
+    def test_ppm_bin_mean_parity(self, rng, layout, ppm):
+        clusters = random_clusters(rng, n=8)
+        config = BinMeanConfig(tolerance_mode="ppm", ppm=ppm)
+        oracle = nb.run_bin_mean(clusters, config)
+        device = TpuBackend(layout=layout).run_bin_mean(clusters, config)
+        assert len(oracle) == len(device)
+        for o, d in zip(oracle, device):
+            assert_spectra_close(d, o)
+
+    def test_ppm_bin_width_scales_with_mz(self):
+        from specpride_tpu.ops import quantize
+
+        config = BinMeanConfig(tolerance_mode="ppm", ppm=20.0)
+        # two peaks 10 ppm apart share a 20-ppm bin; 40 ppm apart do not
+        for base in (150.0, 800.0, 1900.0):
+            near = np.array([base, base * (1 + 10e-6)])
+            far = np.array([base, base * (1 + 40e-6)])
+            bn, _ = quantize.bin_mean_bins(near, config)
+            bf, _ = quantize.bin_mean_bins(far, config)
+            # width is proportional, so the far pair always splits
+            assert bf[0] != bf[1]
+            # near pair may straddle an edge at one base, but widths match
+            # the geometric definition exactly
+            width = np.log1p(20.0 * 1e-6)
+            expect = np.floor(np.log(near / config.min_mz) / width)
+            np.testing.assert_array_equal(bn, expect.astype(np.int64))
+        assert config.n_bins > 0
+
+    @pytest.mark.parametrize("layout", ["auto", "flat", "bucketized"])
+    @pytest.mark.parametrize("norm", ["sqrt", "log"])
+    def test_normalized_cosine_parity(self, rng, layout, norm):
+        clusters = random_clusters(rng, n=8)
+        config = CosineConfig(normalization=norm)
+        reps = nb.run_bin_mean(clusters)
+        oracle = np.array([
+            nb.average_cosine(r, c.members, config)
+            for r, c in zip(reps, clusters)
+        ])
+        device = TpuBackend(layout=layout).average_cosines(
+            reps, clusters, config
+        )
+        np.testing.assert_allclose(oracle, device, rtol=5e-5, atol=1e-5)
+        # the transform changes the metric (sanity that the knob is live)
+        plain = np.array([
+            nb.average_cosine(r, c.members) for r, c in zip(reps, clusters)
+        ])
+        assert not np.allclose(oracle, plain)
+
+    def test_fused_pipeline_honors_normalization(self, rng):
+        clusters = random_clusters(rng, n=6)
+        backend = TpuBackend()
+        config = CosineConfig(normalization="sqrt")
+        reps, cos = backend.run_bin_mean_with_cosines(
+            clusters, BinMeanConfig(), config
+        )
+        expect = backend.average_cosines(reps, clusters, config)
+        np.testing.assert_allclose(cos, expect, rtol=1e-6, atol=1e-9)
