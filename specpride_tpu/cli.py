@@ -272,16 +272,24 @@ def _write_qc_report(
     logger.info("QC report -> %s", args.qc_report)
 
 
+def _bin_mean_config(args) -> BinMeanConfig:
+    """Build (and thereby validate) the bin-mean config.  Called once up
+    front by cmd_consensus so bad grid options fail fast as a usage error
+    — inside the chunked runner a ValueError would be misattributed to
+    the chunk's clusters under --on-error skip."""
+    return BinMeanConfig(
+        min_mz=args.min_mz, max_mz=args.max_mz, bin_size=args.bin_size,
+        apply_peak_quorum=not args.no_quorum,
+        quorum_fraction=args.quorum_fraction,
+        tolerance_mode=getattr(args, "tolerance_mode", "da"),
+        ppm=getattr(args, "ppm", 20.0),
+    )
+
+
 def _run_method(backend, method: str, clusters, args, scores=None,
                 qc: list | None = None):
     if method == "bin-mean":
-        config = BinMeanConfig(
-            min_mz=args.min_mz, max_mz=args.max_mz, bin_size=args.bin_size,
-            apply_peak_quorum=not args.no_quorum,
-            quorum_fraction=args.quorum_fraction,
-            tolerance_mode=getattr(args, "tolerance_mode", "da"),
-            ppm=getattr(args, "ppm", 20.0),
-        )
+        config = _bin_mean_config(args)
         if qc is not None and hasattr(backend, "run_bin_mean_with_cosines"):
             # fused consensus + QC: the cosine member prep overlaps the
             # consensus D2H stream (see TpuBackend.run_bin_mean_with_cosines)
@@ -605,6 +613,11 @@ def _clusters_from_mzml(path: str, args, stats: RunStats) -> list[Cluster]:
 
 def cmd_consensus(args) -> int:
     stats = RunStats()
+    if args.method == "bin-mean":
+        try:
+            _bin_mean_config(args)
+        except ValueError as e:
+            raise SystemExit(f"invalid bin-mean options: {e}")
     if _is_mzml(args.input):
         clusters = _clusters_from_mzml(args.input, args, stats)
     else:

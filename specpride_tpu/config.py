@@ -20,6 +20,19 @@ from typing import Literal
 Backend = Literal["numpy", "tpu"]
 
 
+def ppm_bin_index(mz, min_mz: float, ppm: float):
+    """THE mass-proportional grid formula:
+    ``floor(ln(mz / min_mz) / ln(1 + ppm*1e-6))``, float64.  Accepts a
+    scalar or an array.  Single home shared by ``BinMeanConfig.n_bins``
+    (the bound) and ``ops.quantize.bin_mean_bins`` (peak quantization) so
+    an edit to one cannot silently break the other's bin-range contract."""
+    import numpy as np
+
+    width = np.log1p(ppm * 1e-6)
+    mzf = np.maximum(np.asarray(mz, dtype=np.float64), 1e-300)
+    return np.floor(np.log(mzf / min_mz) / width).astype(np.int64)
+
+
 @dataclasses.dataclass(frozen=True)
 class BinMeanConfig:
     """Binned-mean consensus (ref src/binning.py:170 combine_bin_mean).
@@ -36,22 +49,34 @@ class BinMeanConfig:
     quorum_fraction: float = 0.25
     # grid generalization (BASELINE configs[3]): "da" is the reference's
     # fixed-width grid; "ppm" uses mass-proportional bins of ``ppm`` parts
-    # per million (bin = floor(ln(mz/min_mz) / ln(1 + ppm*1e-6)) — width
-    # grows with m/z, matching instrument mass accuracy).  Quantization
-    # lives in ONE place (ops.quantize.bin_mean_bins) shared by the oracle
-    # and every packer.
+    # per million (``ppm_bin_index`` below — THE single formula, consumed
+    # by ``n_bins`` here and by ``ops.quantize.bin_mean_bins`` for peak
+    # quantization, so grid and bound cannot drift apart).
     tolerance_mode: Literal["da", "ppm"] = "da"
     ppm: float = 20.0
+
+    def __post_init__(self):
+        if self.tolerance_mode == "ppm":
+            if not self.ppm > 0:
+                raise ValueError(
+                    f"tolerance_mode='ppm' needs ppm > 0, got {self.ppm}"
+                )
+            if not self.min_mz > 0:
+                raise ValueError(
+                    "tolerance_mode='ppm' needs min_mz > 0 (the grid is "
+                    f"logarithmic in mz/min_mz), got {self.min_mz}"
+                )
+        elif not self.bin_size > 0:
+            raise ValueError(f"bin_size must be > 0, got {self.bin_size}")
+        if not self.max_mz > self.min_mz:
+            raise ValueError(
+                f"max_mz ({self.max_mz}) must exceed min_mz ({self.min_mz})"
+            )
 
     @property
     def n_bins(self) -> int:
         if self.tolerance_mode == "ppm":
-            import math
-
-            return int(
-                math.log(self.max_mz / self.min_mz)
-                / math.log1p(self.ppm * 1e-6)
-            ) + 1
+            return int(ppm_bin_index(self.max_mz, self.min_mz, self.ppm)) + 1
         # ref src/binning.py:172: int((max-min)/binsize) + 1
         return int((self.max_mz - self.min_mz) / self.bin_size) + 1
 

@@ -86,14 +86,12 @@ def bin_mean_bins(
     out-of-range peaks are whatever the formula yields and must be masked
     by the caller.
     """
+    from specpride_tpu.config import ppm_bin_index
+
     mzf = np.asarray(mz, dtype=np.float64)
     in_range = (mzf >= config.min_mz) & (mzf < config.max_mz)
     if config.tolerance_mode == "ppm":
-        width = np.log1p(config.ppm * 1e-6)
-        with np.errstate(divide="ignore", invalid="ignore"):
-            bins = np.floor(
-                np.log(np.maximum(mzf, 1e-300) / config.min_mz) / width
-            ).astype(np.int64)
+        bins = ppm_bin_index(mzf, config.min_mz, config.ppm)
     else:
         bins = ((mzf - config.min_mz) / config.bin_size).astype(np.int64)
     return bins, in_range

@@ -917,3 +917,20 @@ class TestDirectMzml:
             "--clusters", str(tsv), "--msms", str(msms),
         ]) == 0
         assert os.path.getsize(f"{tmp_path}/m_0.png") > 1000
+
+
+def test_invalid_ppm_options_fail_fast(tmp_path, rng):
+    """Bad grid options are a usage error before any cluster runs — not a
+    deep ZeroDivisionError, and never misattributed to clusters under
+    --on-error skip (advisor r5)."""
+    c = make_cluster(rng, "cluster-0", n_members=2, n_peaks=10)
+    clustered = tmp_path / "c.mgf"
+    write_mgf(c.members, clustered)
+    for extra in (["--tolerance-mode", "ppm", "--ppm", "0"],
+                  ["--tolerance-mode", "ppm", "--min-mz", "0"],
+                  ["--bin-size", "0"]):
+        with pytest.raises(SystemExit, match="invalid bin-mean"):
+            cli_main([
+                "consensus", str(clustered), str(tmp_path / "o.mgf"),
+                "--backend", "numpy", "--on-error", "skip", *extra,
+            ])
