@@ -934,3 +934,23 @@ def test_invalid_ppm_options_fail_fast(tmp_path, rng):
                 "consensus", str(clustered), str(tmp_path / "o.mgf"),
                 "--backend", "numpy", "--on-error", "skip", *extra,
             ])
+
+
+def test_exploration_notebook_executes(tmp_path, monkeypatch):
+    """The C9 exploratory notebook (notebooks/exploration.ipynb) must stay
+    runnable: execute its code cells top to bottom in one namespace (the
+    first cell's sys.path insert is replaced by the test environment)."""
+    nb_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "notebooks", "exploration.ipynb",
+    )
+    with open(nb_path) as fh:
+        nb = json.load(fh)
+    monkeypatch.chdir(tmp_path)  # notebook writes scratch files to cwd
+    ns: dict = {}
+    for cell in nb["cells"]:
+        if cell["cell_type"] != "code":
+            continue
+        exec("".join(cell["source"]), ns)  # noqa: S102 - our own notebook
+    assert os.path.exists(tmp_path / "exploration_reps.mgf")
+    assert os.path.exists(tmp_path / "exploration_mirror.png")
