@@ -392,47 +392,77 @@ class TpuBackend:
         return self._bin_mean_flat_finish(pending, clusters)
 
     def _flat_chunk_dispatch(self, batch, config: BinMeanConfig):
-        """Pad one ``FlatBinBatch`` to its size classes and dispatch the
-        fused kernel (one batched H2D put + one jit call); returns
-        ``(device_array, cap, rows)``.  Shared by the serial flat path and
-        the pipelined native path so the argument packing lives once.
+        """One flat chunk: host run pass (counts, oracle-exact quorum,
+        m/z means) + one batched H2D put + the intensity kernel call.
+        Returns ``(device_array, aux)`` where ``aux`` carries the
+        host-computed ``kept_mz`` / ``row_out_offsets`` / ``rows`` that
+        ``_emit_bin_mean_rows`` assembles with the device means.  Shared
+        by the serial flat path and the pipelined native path so the
+        protocol lives once.
 
         Input padding uses the half-octave classes like the output caps:
         the measured tunneled H2D link (~90 MB/s with multi-second jitter,
         round-5 profile) makes input bytes the pipeline's largest single
         cost — worth one extra XLA compile class per octave."""
-        from specpride_tpu.ops.binning import bin_mean_flat_compact
+        from specpride_tpu.ops.binning import bin_mean_flat_intensity
 
         sent = np.int32(2**31 - 1)
-        n = batch.gbin.size
+        g = batch.gbin
+        n = g.size
         n_pad = _cap_class(n, floor=1024)
         rows = len(batch.source_indices)
-        b_cap = _pow2(rows, floor=64)
         cap = _cap_class(batch.n_distinct_total, floor=1024)
         rcap = _cap_class(batch.n_distinct_total + 1, floor=1024)
         # dedup bounds every (row, bin) run at the row's member count
         lcap = _pow2(int(batch.n_members.max(initial=1)))
-        n_runs = batch.n_distinct_total + (1 if n_pad > n else 0)
-        # padded rows own zero runs: repeat the final extent
-        run_offsets = np.full(b_cap + 1, batch.run_offsets[-1],
-                              dtype=np.int32)
-        run_offsets[: rows + 1] = batch.run_offsets
-        fused = bin_mean_flat_compact(
+
+        # host run pass over the sorted composite (run structure carried
+        # from the packer): per-run counts, the ORACLE-EXACT int quorum
+        # (int(n*frac)+1, ref src/binning.py:183), and per-bin m/z means
+        # (f32 reduceat in the oracle's accumulation order) — everything
+        # except the heavy intensity reduction, which is the device's job;
+        # m/z never crosses the link
+        starts_idx = batch.run_starts
+        counts = np.diff(np.append(starts_idx, n))
+        mz_sums = (
+            np.add.reduceat(batch.mz, starts_idx)
+            if starts_idx.size
+            else np.zeros(0, np.float32)
+        )
+        row_of_run = g[starts_idx].astype(np.int64) // np.int64(
+            config.n_bins + 1
+        )
+        if config.apply_peak_quorum:
+            quorum = (
+                batch.n_members[row_of_run].astype(np.float64)
+                * config.quorum_fraction
+            ).astype(np.int64) + 1
+        else:
+            quorum = np.ones_like(counts)
+        keep = counts >= quorum
+        # oracle dtype chain: f32 sum promoted to f64 by the int division
+        mz_mean = mz_sums.astype(np.float64) / counts
+        kept_mz = mz_mean[keep]
+        n_out = np.bincount(row_of_run[keep], minlength=rows)
+        row_out_offsets = np.zeros(rows + 1, dtype=np.int64)
+        np.cumsum(n_out, out=row_out_offsets[1:])
+        keep_runs = np.zeros(rcap, dtype=bool)
+        keep_runs[: keep.size] = keep
+
+        fused = bin_mean_flat_intensity(
             *self._put_batch([
-                np.pad(batch.mz, (0, n_pad - n)),
                 np.pad(batch.intensity, (0, n_pad - n)),
-                np.pad(batch.gbin, (0, n_pad - n), constant_values=sent),
-                np.pad(batch.n_members, (0, b_cap - rows)),
-                run_offsets,
-                np.array([n_runs], dtype=np.int32),
+                np.pad(g, (0, n_pad - n), constant_values=sent),
+                keep_runs,
             ]),
-            config=config,
             total_cap=cap,
-            b_cap=b_cap,
             rcap=rcap,
             lcap=lcap,
         )
-        return fused, cap, rows
+        aux = dict(
+            kept_mz=kept_mz, row_out_offsets=row_out_offsets, rows=rows
+        )
+        return fused, aux
 
     def _bin_mean_flat_dispatch(
         self, clusters: list[Cluster], config: BinMeanConfig
@@ -451,14 +481,14 @@ class TpuBackend:
             )
         for batch in batches:
             with st.phase("dispatch"):
-                fused, cap, rows = self._flat_chunk_dispatch(batch, config)
+                fused, aux = self._flat_chunk_dispatch(batch, config)
             # fetch in a background thread now — on the slow device->host
             # link the copy is the critical path, and the caller has host
             # work (the fused pipeline's cosine prep; the next chunk's
             # np.pad) to hide it behind.  Under sync_timing keep the raw
             # device array so _collect can still split device vs d2h time.
             pending.append((
-                batch, rows, cap,
+                batch, aux,
                 fused if self.sync_timing else _AsyncFetch(fused),
             ))
         return pending
@@ -472,9 +502,8 @@ class TpuBackend:
             with st.phase("d2h"):
                 fuseds = [p[-1].get() for p in pending]
         with st.phase("finalize"):
-            for (batch, rows, cap, _), fused in zip(pending, fuseds):
-                self._emit_bin_mean_rows(batch, fused, cap, rows, clusters,
-                                         out)
+            for (batch, aux, _), fused in zip(pending, fuseds):
+                self._emit_bin_mean_rows(batch, fused, aux, clusters, out)
         return [s for s in out if s is not None]
 
     # -- gap-average consensus (K3) -------------------------------------
@@ -994,12 +1023,11 @@ class TpuBackend:
         out: list[Spectrum | None] = [None] * len(clusters)
         cosines = np.zeros(len(clusters), dtype=np.float64)
 
-        def finish_chunk(batch, fused, cap, rows):
+        def finish_chunk(batch, fused, aux):
             lo = batch.source_indices[0]
             hi = batch.source_indices[-1] + 1
             with st.phase("finalize"):
-                self._emit_bin_mean_rows(batch, fused, cap, rows, clusters,
-                                         out)
+                self._emit_bin_mean_rows(batch, fused, aux, clusters, out)
             with st.phase("compute"):
                 cosines[lo:hi] = self._cosine_native_rows(
                     out[lo:hi], mprep, cos_config, lo, hi
@@ -1013,24 +1041,20 @@ class TpuBackend:
                 mprep = self._prep_cosine_native(table, cos_config)
             for batch in batches:
                 with st.phase("dispatch"):
-                    fused, cap, rows = self._flat_chunk_dispatch(
-                        batch, bin_config
-                    )
+                    fused, aux = self._flat_chunk_dispatch(batch, bin_config)
                 with st.phase("device"):
                     fused.block_until_ready()
                 with st.phase("d2h"):
                     fused = np.asarray(fused)
-                finish_chunk(batch, fused, cap, rows)
+                finish_chunk(batch, fused, aux)
         else:
             def run_chunk(batch):
-                # dispatch-worker job: one batched H2D put + kernel call +
-                # blocking host fetch (transfers release the GIL, so two
-                # workers pipeline the link while the main thread
-                # packs/finalizes/scores)
-                fused, cap, rows = self._flat_chunk_dispatch(
-                    batch, bin_config
-                )
-                return np.asarray(fused), cap, rows
+                # dispatch-worker job: host run pass + one batched H2D put
+                # + kernel call + blocking host fetch (transfers release
+                # the GIL, so two workers pipeline the link while the main
+                # thread packs/finalizes/scores)
+                fused, aux = self._flat_chunk_dispatch(batch, bin_config)
+                return np.asarray(fused), aux
 
             with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
                 with st.phase("dispatch"):
@@ -1039,22 +1063,26 @@ class TpuBackend:
                     mprep = self._prep_cosine_native(table, cos_config)
                 for batch, fut in zip(batches, futs):
                     with st.phase("d2h"):
-                        fused, cap, rows = fut.result()
-                    finish_chunk(batch, fused, cap, rows)
+                        fused, aux = fut.result()
+                    finish_chunk(batch, fused, aux)
         st.count("clusters", len(clusters))
         return [s for s in out if s is not None], cosines
 
-    def _emit_bin_mean_rows(
-        self, batch, fused, cap: int, rows: int, clusters, out
-    ) -> None:
-        """Unpack one flat-chunk fused buffer into ``out`` Spectrum slots
+    def _emit_bin_mean_rows(self, batch, fused, aux, clusters, out) -> None:
+        """Assemble one flat chunk's Spectrum slots from the HOST m/z means
+        (``aux["kept_mz"]``) and the device's compacted intensity means
         (shared by the serial flat finish and the pipelined native path)."""
-        for ci, r_mz, r_int in _iter_compacted(fused, cap, rows):
+        flat_int = np.asarray(fused)
+        kept_mz = aux["kept_mz"]
+        off = aux["row_out_offsets"]
+        for ci in range(aux["rows"]):
+            o0, o1 = int(off[ci]), int(off[ci + 1])
             gi = batch.source_indices[ci]
             members = clusters[gi].members
             out[gi] = Spectrum(
-                mz=r_mz,
-                intensity=r_int,
+                # copies: slices would pin the chunk-wide buffers alive
+                mz=kept_mz[o0:o1].copy(),
+                intensity=flat_int[o0:o1].astype(np.float64),
                 # exact f64 mean, as the oracle (ref src/binning.py:224)
                 precursor_mz=float(
                     np.mean([s.precursor_mz for s in members])

@@ -471,7 +471,7 @@ class FlatBinBatch:
     gbin: np.ndarray  # (N,) i32 composite, sentinel = 2**31 - 1
     n_members: np.ndarray  # (rows,) i32
     n_distinct_total: int  # exact surviving-bin bound for this chunk
-    run_offsets: np.ndarray  # (rows + 1,) i64 per-row distinct-bin extents
+    run_starts: np.ndarray  # (R,) i64 run-start positions within the chunk
     cluster_ids: list[str]
     source_indices: list[int]
 
@@ -514,13 +514,14 @@ def pack_flat_bin_mean(
     s_bin = bins64[final]
     s_row = np.repeat(np.arange(c, dtype=np.int64), kept_totals)
 
-    # distinct bins per row (exact compaction bound), from the sorted pass
+    # run starts over the sorted (row, bin) axis: the exact compaction
+    # bound AND the run structure the backend's host pass consumes
+    # (carried per chunk so nothing re-derives it)
     if s_bin.size:
         first = np.ones(s_bin.size, dtype=bool)
         first[1:] = (s_bin[1:] != s_bin[:-1]) | (s_row[1:] != s_row[:-1])
-        distinct_per_row = np.bincount(s_row[first], minlength=c)
     else:
-        distinct_per_row = np.zeros(c, dtype=np.int64)
+        first = np.zeros(0, dtype=bool)
 
     # chunk rows greedily under the element and composite-key budgets
     max_rows = (2**31 - 2) // (n_bins + 1)
@@ -545,16 +546,17 @@ def pack_flat_bin_mean(
         gbin = (
             (s_row[p0:p1] - lo) * np.int64(n_bins + 1) + s_bin[p0:p1]
         ).astype(np.int32)
-        run_offsets = np.zeros(hi - lo + 1, dtype=np.int64)
-        np.cumsum(distinct_per_row[lo:hi], out=run_offsets[1:])
+        # chunk boundaries are row boundaries, so first[p0] is always a
+        # run start — chunk-local positions need no fixup
+        run_starts = np.flatnonzero(first[p0:p1])
         batches.append(
             FlatBinBatch(
                 mz=s_mz[p0:p1],
                 intensity=s_int[p0:p1],
                 gbin=gbin,
                 n_members=idx.n_members[lo:hi].astype(np.int32),
-                n_distinct_total=int(run_offsets[-1]),
-                run_offsets=run_offsets,
+                n_distinct_total=int(run_starts.size),
+                run_starts=run_starts,
                 cluster_ids=[table.cluster_names[i] for i in range(lo, hi)],
                 source_indices=list(range(lo, hi)),
             )

@@ -69,74 +69,45 @@ def _bin_mean_deduped_stats(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config", "total_cap", "b_cap", "rcap", "lcap")
+    jax.jit, static_argnames=("total_cap", "rcap", "lcap")
 )
-def bin_mean_flat_compact(
-    mz: jax.Array,  # (N,) f32, sorted by (row, bin); tail padding
-    intensity: jax.Array,  # (N,) f32, same order
+def bin_mean_flat_intensity(
+    intensity: jax.Array,  # (N,) f32, sorted by (row, bin); tail padding
     gbin: jax.Array,  # (N,) i32 row*(n_bins+1)+bin, sentinel 2**31-1
-    n_members: jax.Array,  # (b_cap,) i32, 0 past the real rows
-    run_offsets: jax.Array,  # (b_cap + 1,) i32 per-row run extents (host)
-    n_runs: jax.Array,  # (1,) i32 total runs incl. any sentinel tail run
-    config: BinMeanConfig,
+    keep_runs: jax.Array,  # (rcap,) bool HOST-computed quorum keep, in run
+    #   order; False past the real runs (incl. any sentinel tail run)
     total_cap: int,
-    b_cap: int,
-    rcap: int,  # pow2 >= n_runs
+    rcap: int,  # pow2 >= run count incl. any sentinel tail run
     lcap: int,  # pow2 >= longest real run (<= max n_members after dedup)
 ):
-    """Flat zero-padding variant of ``bin_mean_deduped_compact`` (see
-    ``data.packed.FlatBinBatch``): one fused 1-D output
-    ``[flat_mz (total_cap) | flat_intensity (total_cap) | n_out (b_cap)]``.
+    """Intensity-only flat binned-mean: per-run intensity means compacted
+    by a HOST-shipped keep mask, one (total_cap,) f32 output.
 
-    The (row, bin) composite ``gbin`` makes runs globally unique, so one
-    scatter-free run pass (``ops.segments``; dedup bounds every real run at
-    the cluster's member count, so ``lcap`` stays tiny) handles every
-    cluster at once — no vmap, no per-row padding, and no per-row scatter
-    for the output counts either: the host already knows each row's run
-    extents (``run_offsets``), so per-row surviving-bin counts are an int
-    prefix-sum differenced at those offsets."""
+    Round-5 link economics (see ``backends.tpu_backend``): the tunneled
+    H2D/D2H link is the pipeline's cost, so everything the host can
+    compute exactly from its own sorted pass stays there — per-run counts,
+    the oracle-exact INT quorum (the device's f32 quorum compare could
+    drift at edges), per-bin m/z means (f32 reduceat in oracle
+    accumulation order), and per-row output counts.  The device does the
+    one heavy reduction (per-run intensity sums over millions of peaks)
+    and ships back only the kept means; m/z never crosses the link at
+    all.  Shipping the keep mask (one bool per run) guarantees host and
+    device agree on the compaction layout by construction."""
     from specpride_tpu.ops import segments as sg
 
     sent = jnp.int32(2**31 - 1)
-    nb1 = jnp.int32(config.n_bins + 1)
     valid = gbin != sent
     w = jnp.where(valid, 1.0, 0.0)
-
     starts = sg.run_starts(gbin)
-    (counts, mz_sum, inten_sum), endpos = sg.run_sums(
-        starts, (w, mz * w, intensity * w), rcap, lcap
+    (counts, inten_sum), _ = sg.run_sums(
+        starts, (w, intensity * w), rcap, lcap
     )
-    rkey = gbin[endpos]
-    genuine = (jnp.arange(rcap, dtype=jnp.int32) < n_runs[0]) & (rkey != sent)
-    row_of_run = jnp.where(genuine, rkey // nb1, b_cap - 1)
-
-    if config.apply_peak_quorum:
-        nm = n_members[jnp.clip(row_of_run, 0, b_cap - 1)].astype(jnp.float32)
-        quorum = jnp.floor(nm * config.quorum_fraction) + 1.0
-    else:
-        quorum = jnp.float32(1.0)
-    keep = genuine & (counts >= quorum)
-
-    safe = jnp.maximum(counts, 1.0)
-    mz_mean = mz_sum / safe
-    inten_mean = inten_sum / safe
-
-    # per-row surviving counts: int prefix over runs, diffed at the host's
-    # per-row run extents (exact, no scatter)
-    cs0 = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(keep.astype(jnp.int32))]
-    )
-    n_out = (cs0[run_offsets[1:]] - cs0[run_offsets[:-1]]).astype(jnp.float32)
-
-    (idx,) = jnp.nonzero(keep, size=total_cap, fill_value=rcap)
+    inten_mean = inten_sum / jnp.maximum(counts, 1.0)
+    (idx,) = jnp.nonzero(keep_runs, size=total_cap, fill_value=rcap)
     ok = idx < rcap
-    flat_mz = jnp.where(
-        ok, mz_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0
-    )
-    flat_int = jnp.where(
+    return jnp.where(
         ok, inten_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0
     )
-    return jnp.concatenate([flat_mz, flat_int, n_out])
 
 
 @functools.partial(
