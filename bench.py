@@ -438,6 +438,23 @@ def main() -> None:
             # collection pass between methods keeps runs comparable to
             # standalone --method invocations
             gc.collect()
+        # the measured-choice default ("auto") runs K1/K2b on the host
+        # mesh-less; keep the DEVICE flat paths measured too, so the
+        # device-vs-host decision stays pinned to current numbers
+        dev_backend = TpuBackend(
+            batch_config=BatchConfig(clusters_per_batch=4096),
+            layout="flat",
+            sync_timing=args.sync_timing,
+        )
+        for method in ("bin_mean", "pipeline"):
+            entry = bench_method(
+                method, clusters, dev_backend, nb,
+                numpy_sample=len(clusters), seed=args.seed,
+            )
+            entry["method"] += "_device_flat"
+            entry["metric"] += " [device flat layout]"
+            report["methods"].append(entry)
+            gc.collect()
         report["sweep"] = bench_sweep(clusters, backend, nb)
         import tempfile
 

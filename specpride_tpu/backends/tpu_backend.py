@@ -323,6 +323,8 @@ class TpuBackend:
         for c in clusters:
             numpy_backend.check_uniform_charge(c.members)
 
+        if self.mesh is None and self.layout == "auto":
+            return self._run_bin_mean_host(clusters, config)
         if self.mesh is None and self.layout != "bucketized":
             return self._run_bin_mean_flat(clusters, config)
 
@@ -417,11 +419,35 @@ class TpuBackend:
         lcap = _pow2(int(batch.n_members.max(initial=1)))
 
         # host run pass over the sorted composite (run structure carried
-        # from the packer): per-run counts, the ORACLE-EXACT int quorum
-        # (int(n*frac)+1, ref src/binning.py:183), and per-bin m/z means
-        # (f32 reduceat in the oracle's accumulation order) — everything
-        # except the heavy intensity reduction, which is the device's job;
-        # m/z never crosses the link
+        # from the packer) — everything except the heavy intensity
+        # reduction, which is the device's job; m/z never crosses the link
+        aux = self._host_run_pass(batch, config)
+        keep_runs = np.zeros(rcap, dtype=bool)
+        keep_runs[: aux["keep"].size] = aux["keep"]
+
+        fused = bin_mean_flat_intensity(
+            *self._put_batch([
+                np.pad(batch.intensity, (0, n_pad - n)),
+                np.pad(g, (0, n_pad - n), constant_values=sent),
+                keep_runs,
+            ]),
+            total_cap=cap,
+            rcap=rcap,
+            lcap=lcap,
+        )
+        return fused, aux
+
+    def _host_run_pass(self, batch, config: BinMeanConfig) -> dict:
+        """Per-run host pass over one flat chunk's sorted composite:
+        counts, the ORACLE-EXACT int quorum (int(n*frac)+1, ref
+        src/binning.py:183), per-bin m/z means (f32 reduceat — the
+        oracle's accumulation order: the stable (row, bin) sort keeps
+        member order within a bin), and per-row output extents.  Shared
+        by the device flat path (which ships the keep mask) and the full
+        host path (which adds one intensity reduceat)."""
+        g = batch.gbin
+        n = g.size
+        rows = len(batch.source_indices)
         starts_idx = batch.run_starts
         counts = np.diff(np.append(starts_idx, n))
         mz_sums = (
@@ -441,28 +467,59 @@ class TpuBackend:
             quorum = np.ones_like(counts)
         keep = counts >= quorum
         # oracle dtype chain: f32 sum promoted to f64 by the int division
-        mz_mean = mz_sums.astype(np.float64) / counts
-        kept_mz = mz_mean[keep]
+        kept_mz = (mz_sums.astype(np.float64) / counts)[keep]
         n_out = np.bincount(row_of_run[keep], minlength=rows)
         row_out_offsets = np.zeros(rows + 1, dtype=np.int64)
         np.cumsum(n_out, out=row_out_offsets[1:])
-        keep_runs = np.zeros(rcap, dtype=bool)
-        keep_runs[: keep.size] = keep
+        return dict(
+            kept_mz=kept_mz, row_out_offsets=row_out_offsets, rows=rows,
+            keep=keep, counts=counts, starts_idx=starts_idx,
+        )
 
-        fused = bin_mean_flat_intensity(
-            *self._put_batch([
-                np.pad(batch.intensity, (0, n_pad - n)),
-                np.pad(g, (0, n_pad - n), constant_values=sent),
-                keep_runs,
-            ]),
-            total_cap=cap,
-            rcap=rcap,
-            lcap=lcap,
-        )
-        aux = dict(
-            kept_mz=kept_mz, row_out_offsets=row_out_offsets, rows=rows
-        )
-        return fused, aux
+    def _host_bin_mean_chunk(self, batch, config, clusters, out) -> None:
+        """One flat chunk fully on the host: run pass + ONE intensity
+        reduceat, emitted straight into ``out``."""
+        st = self.stats
+        with st.phase("compute"):
+            aux = self._host_run_pass(batch, config)
+            int_sums = (
+                np.add.reduceat(batch.intensity, aux["starts_idx"])
+                if aux["starts_idx"].size
+                else np.zeros(0, np.float32)
+            )
+            kept_int = (
+                int_sums.astype(np.float64) / aux["counts"]
+            )[aux["keep"]]
+        with st.phase("finalize"):
+            self._emit_bin_mean_rows(batch, kept_int, aux, clusters, out)
+
+    def _run_bin_mean_host(
+        self, clusters: list[Cluster], config: BinMeanConfig
+    ) -> list[Spectrum]:
+        """Full host K1 (mesh-less ``layout="auto"`` — the measured
+        choice, same economics as gap-average): after the packer's sorted
+        pass, the per-run host work already includes counts, quorum and
+        m/z means; the only remaining reduction is ONE intensity reduceat
+        (~20 ms for 2.8M peaks), ~20x cheaper than shipping ~25 MB over
+        the tunneled link for the device to do it (round-5 profile).  The
+        device flat path stays selectable (``layout="flat"``) and the
+        bucketized path carries mesh runs, where sharding changes the
+        economics."""
+        from specpride_tpu.data.packed import pack_flat_bin_mean
+
+        _check_no_empty(clusters)
+        for c in clusters:
+            numpy_backend.check_uniform_charge(c.members)
+        st = self.stats
+        with st.phase("pack"):
+            batches = pack_flat_bin_mean(
+                clusters, config, max_elements=self.max_grid_elements // 4
+            )
+        out: list[Spectrum | None] = [None] * len(clusters)
+        for batch in batches:
+            self._host_bin_mean_chunk(batch, config, clusters, out)
+        st.count("clusters", len(clusters))
+        return [s for s in out if s is not None]
 
     def _bin_mean_flat_dispatch(
         self, clusters: list[Cluster], config: BinMeanConfig
@@ -977,9 +1034,14 @@ class TpuBackend:
             from specpride_tpu.ops import cosine_native
 
             if cosine_native.available():
-                return self._run_pipeline_native(
+                return self._run_pipeline_host(
                     clusters, bin_config, cos_config
                 )
+            # no C++ cosine built: host consensus + device flat cosine
+            reps = self._run_bin_mean_host(clusters, bin_config)
+            return reps, self._average_cosines_flat(
+                reps, clusters, cos_config
+            )
 
         st = self.stats
         pending = self._bin_mean_flat_dispatch(clusters, bin_config)
@@ -991,80 +1053,39 @@ class TpuBackend:
         cosines = self._dispatch_cosine_flat(prep)
         return reps, cosines
 
-    def _run_pipeline_native(
+    def _run_pipeline_host(
         self,
         clusters: list[Cluster],
         bin_config: BinMeanConfig,
         cos_config: CosineConfig,
     ) -> tuple[list[Spectrum], np.ndarray]:
-        """Chunk-pipelined consensus+QC: device bin-mean chunks stream
-        through a 2-worker dispatch pool (chunk i+1's H2D overlaps chunk
-        i's kernel/D2H; workers hold the link, not the GIL) while the host
-        finalizes each arrived chunk and scores its member cosines with the
-        native threaded kernel (``native/cosine.cpp``).  The cluster axis
-        is split into ~6 device chunks so host and device work interleave
-        instead of serializing on one monolithic transfer (the round-4
-        profile: one 50 MB H2D + one fused kernel left the host idle for
-        ~1 s per run)."""
-        import concurrent.futures
-
+        """Fully host consensus+QC (mesh-less ``auto`` — the measured
+        choice): one packed sort pass, per-chunk host run reductions
+        (``_run_bin_mean_host``), and the native C++ cosine per chunk.
+        With host reductions ~20x cheaper than the link transfer they
+        would replace (see ``_run_bin_mean_host``), no device round trip
+        survives on this path; the chunk loop keeps the working set in
+        cache and matches the streaming-ingest window."""
         from specpride_tpu.data.packed import _as_table, pack_flat_bin_mean
 
         st = self.stats
         with st.phase("pack"):
             table = _as_table(clusters)
-            total = int(table.mz.size)
-            max_el = min(
-                self.max_grid_elements // 4, max(total // 6 + 1, 1 << 19)
+            batches = pack_flat_bin_mean(
+                table, bin_config, max_elements=self.max_grid_elements // 4
             )
-            batches = pack_flat_bin_mean(table, bin_config,
-                                         max_elements=max_el)
+            mprep = self._prep_cosine_native(table, cos_config)
 
         out: list[Spectrum | None] = [None] * len(clusters)
         cosines = np.zeros(len(clusters), dtype=np.float64)
-
-        def finish_chunk(batch, fused, aux):
+        for batch in batches:
+            self._host_bin_mean_chunk(batch, bin_config, clusters, out)
             lo = batch.source_indices[0]
             hi = batch.source_indices[-1] + 1
-            with st.phase("finalize"):
-                self._emit_bin_mean_rows(batch, fused, aux, clusters, out)
             with st.phase("compute"):
                 cosines[lo:hi] = self._cosine_native_rows(
                     out[lo:hi], mprep, cos_config, lo, hi
                 )
-
-        if self.sync_timing:
-            # diagnostics mode: serial chunks so the phase split stays
-            # attributable (dispatch = H2D+call, device = kernel, d2h =
-            # pure transfer) — overlap is deliberately given up
-            with st.phase("pack"):
-                mprep = self._prep_cosine_native(table, cos_config)
-            for batch in batches:
-                with st.phase("dispatch"):
-                    fused, aux = self._flat_chunk_dispatch(batch, bin_config)
-                with st.phase("device"):
-                    fused.block_until_ready()
-                with st.phase("d2h"):
-                    fused = np.asarray(fused)
-                finish_chunk(batch, fused, aux)
-        else:
-            def run_chunk(batch):
-                # dispatch-worker job: host run pass + one batched H2D put
-                # + kernel call + blocking host fetch (transfers release
-                # the GIL, so two workers pipeline the link while the main
-                # thread packs/finalizes/scores)
-                fused, aux = self._flat_chunk_dispatch(batch, bin_config)
-                return np.asarray(fused), aux
-
-            with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
-                with st.phase("dispatch"):
-                    futs = [ex.submit(run_chunk, b) for b in batches]
-                with st.phase("pack"):
-                    mprep = self._prep_cosine_native(table, cos_config)
-                for batch, fut in zip(batches, futs):
-                    with st.phase("d2h"):
-                        fused, aux = fut.result()
-                    finish_chunk(batch, fused, aux)
         st.count("clusters", len(clusters))
         return [s for s in out if s is not None], cosines
 
