@@ -101,7 +101,8 @@ def available() -> bool:
 # before the other libraries existed never compiles them (and their
 # callers silently fall back to single-threaded numpy paths)
 _ALL_NATIVE_LIBS = (
-    "libmgf_parser.so", "libgap_average.so", "libsegsort.so", "libcosine.so"
+    "libmgf_parser.so", "libgap_average.so", "libsegsort.so",
+    "libcosine.so", "libmedoid.so"
 )
 
 

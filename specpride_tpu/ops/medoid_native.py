@@ -1,0 +1,95 @@
+"""ctypes bindings for the multithreaded C++ medoid shared-bin counter
+(native/medoid.cpp — exact integer pair counts; the float64 finalize stays
+in ``ops.similarity.medoid_finalize``, shared with the device path so both
+paths' fp semantics are identical by construction).
+
+Loading mirrors ``ops.gap_native``: lazy, soft-failing (``available()``
+False when unbuilt), reusing the one-shot ``make -C native`` bootstrap."""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    p = ctypes.POINTER
+    lib.medoid_shared_run.restype = ctypes.c_int
+    lib.medoid_shared_run.argtypes = [
+        p(ctypes.c_double),  # mz
+        p(ctypes.c_int64),  # spec_offsets
+        p(ctypes.c_int64),  # cluster_spec_offsets
+        p(ctypes.c_int64),  # out_offsets
+        ctypes.c_int64,  # n_clusters
+        ctypes.c_double,  # bin_size
+        p(ctypes.c_int32),  # out_shared
+        ctypes.c_int,  # n_threads
+    ]
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        from specpride_tpu.io.native import load_native
+
+        _lib = load_native("libmedoid.so", "SPECPRIDE_MEDOID_LIB", _bind)
+        _load_failed = _lib is None
+        return _lib
+
+
+def available() -> bool:
+    """True when the C++ medoid library is built and loadable."""
+    return _load() is not None
+
+
+def shared_bin_counts(
+    mz: np.ndarray,  # (P,) f64, spectra contiguous, clusters contiguous
+    spec_offsets: np.ndarray,  # (S + 1,) i64 peak extents per spectrum
+    cluster_spec_offsets: np.ndarray,  # (C + 1,) i64 spectrum extents/cluster
+    bin_size: float,
+    n_threads: int = 0,  # 0 = hardware concurrency
+) -> tuple[np.ndarray, np.ndarray]:
+    """Flat per-cluster (M, M) shared unique-bin count matrices.
+
+    Returns ``(shared_flat, out_offsets)``: cluster c's matrix is
+    ``shared_flat[out_offsets[c] : out_offsets[c + 1]].reshape(M, M)``.
+    Raises ``RuntimeError`` when the library is unavailable (callers
+    guard with ``available()``)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native medoid not built (make -C native)")
+    mz = np.ascontiguousarray(mz, dtype=np.float64)
+    spec_offsets = np.ascontiguousarray(spec_offsets, dtype=np.int64)
+    cluster_spec_offsets = np.ascontiguousarray(
+        cluster_spec_offsets, dtype=np.int64
+    )
+    c = cluster_spec_offsets.size - 1
+    m_per = np.diff(cluster_spec_offsets)
+    out_offsets = np.zeros(c + 1, dtype=np.int64)
+    np.cumsum(m_per * m_per, out=out_offsets[1:])
+    out = np.zeros(int(out_offsets[-1]), dtype=np.int32)
+    dp = ctypes.POINTER(ctypes.c_double)
+    ip = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    rc = lib.medoid_shared_run(
+        mz.ctypes.data_as(dp),
+        spec_offsets.ctypes.data_as(ip),
+        cluster_spec_offsets.ctypes.data_as(ip),
+        out_offsets.ctypes.data_as(ip),
+        c,
+        float(bin_size),
+        out.ctypes.data_as(i32p),
+        int(n_threads),
+    )
+    if rc != 0:
+        raise RuntimeError(f"native medoid failed (rc={rc})")
+    return out, out_offsets

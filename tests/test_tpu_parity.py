@@ -365,11 +365,29 @@ class TestGapAverageParity:
 # ---------------------------------------------------------------------------
 
 class TestMedoidParity:
-    def test_random_clusters(self, rng, backend):
+    @pytest.mark.parametrize("layout", ["auto", "bucketized"])
+    def test_random_clusters(self, rng, layout):
+        """"auto" takes the native C++ counter when built; "bucketized"
+        forces the device gram-matmul path — both must match the oracle
+        index for index."""
+        backend = TpuBackend(layout=layout)
         clusters = random_clusters(rng)
         oracle_idx = [nb.medoid_index(c.members) for c in clusters]
         device_idx = backend.medoid_indices(clusters)
         assert oracle_idx == device_idx
+
+    def test_native_counts_match_device_semantics(self, rng):
+        """The native counter's integer pair counts drive the SAME
+        medoid_finalize as the device path: spot-check the counts against
+        the oracle's xcorr numerators."""
+        from specpride_tpu.ops import medoid_native
+
+        if not medoid_native.available():
+            pytest.skip("native medoid not built")
+        clusters = random_clusters(rng, n=4)
+        backend = TpuBackend()
+        idx = backend._medoid_indices_native(clusters, MedoidConfig())
+        assert idx == [nb.medoid_index(c.members) for c in clusters]
 
     def test_identical_members_lowest_index(self, rng, backend):
         s = make_cluster(rng, n_members=1).members[0]
