@@ -28,13 +28,17 @@
 namespace {
 
 // unique, ascending bin ids of one spectrum (mz sorted -> trunc monotone;
-// unsorted input falls back to an explicit sort, same result as np.unique)
-void build_bins(const double* mz, int64_t n, double inv_bin,
+// unsorted input falls back to an explicit sort, same result as np.unique).
+// The bin MUST be a true division — mz * (1/bin_size) rounds differently
+// at bin boundaries (e.g. 100.1/0.1 -> 1001 but 100.1*10.0000..x ->
+// 1000.99..), and one-decimal m/z values, ubiquitous in MGF files, sit on
+// those boundaries for the default 0.1 Da grid.
+void build_bins(const double* mz, int64_t n, double bin_size,
                 std::vector<int64_t>& bins) {
   bins.clear();
   bool sorted = true;
   for (int64_t i = 0; i < n; ++i) {
-    const int64_t b = static_cast<int64_t>(mz[i] * inv_bin);
+    const int64_t b = static_cast<int64_t>(mz[i] / bin_size);
     if (!bins.empty() && b < bins.back()) {
       sorted = false;
       break;
@@ -45,7 +49,7 @@ void build_bins(const double* mz, int64_t n, double inv_bin,
   bins.clear();
   bins.reserve(n);
   for (int64_t i = 0; i < n; ++i) {
-    bins.push_back(static_cast<int64_t>(mz[i] * inv_bin));
+    bins.push_back(static_cast<int64_t>(mz[i] / bin_size));
   }
   std::sort(bins.begin(), bins.end());
   bins.erase(std::unique(bins.begin(), bins.end()), bins.end());
@@ -92,7 +96,6 @@ int medoid_shared_run(
     n_threads = hc ? static_cast<int>(hc) : 4;
   }
   n_threads = std::min<int64_t>(n_threads, std::max<int64_t>(n_clusters, 1));
-  const double inv_bin = 1.0 / bin_size;
 
   std::atomic<int64_t> next{0};
   auto worker = [&]() {
@@ -106,7 +109,8 @@ int medoid_shared_run(
       bins.resize(m);
       for (int64_t i = 0; i < m; ++i) {
         const int64_t p0 = spec_offsets[s0 + i];
-        build_bins(mz + p0, spec_offsets[s0 + i + 1] - p0, inv_bin, bins[i]);
+        build_bins(mz + p0, spec_offsets[s0 + i + 1] - p0, bin_size,
+                   bins[i]);
       }
       for (int64_t i = 0; i < m; ++i) {
         out[i * m + i] = static_cast<int32_t>(bins[i].size());

@@ -613,19 +613,12 @@ class TpuBackend:
         st = self.stats
 
         if gap_native.available():
-            from specpride_tpu.data.packed import _grouped_arange
-
             with st.phase("pack"):
                 table = _as_table(clusters)
                 idx = table.cluster_order()
                 # member-concatenation order per cluster (the oracle's
-                # input to its stable sort)
-                cnt = table.peak_counts[idx.order]
-                src = np.repeat(
-                    table.peak_offsets[idx.order], cnt
-                ) + _grouped_arange(cnt)
-                mz_c = table.mz[src]
-                int_c = table.intensity[src]
+                # input to its stable sort); zero-copy when contiguous
+                mz_c, int_c, _ = self._cluster_ordered_peaks(table, idx)
                 offs = np.zeros(table.n_clusters + 1, dtype=np.int64)
                 np.cumsum(idx.total_peaks, out=offs[1:])
             with st.phase("compute"):
@@ -885,7 +878,7 @@ class TpuBackend:
         round trips than compute).  The float64 finalize is the SAME
         ``medoid_finalize`` the device path uses, so both paths share one
         fp semantics; the bucketized MXU path still carries mesh runs."""
-        from specpride_tpu.data.packed import _as_table, _grouped_arange
+        from specpride_tpu.data.packed import _as_table
         from specpride_tpu.ops import medoid_native
         from specpride_tpu.ops.similarity import medoid_finalize
 
@@ -893,37 +886,35 @@ class TpuBackend:
         with st.phase("pack"):
             table = _as_table(clusters)
             idx = table.cluster_order()
-            cnt = table.peak_counts[idx.order]
-            src = np.repeat(
-                table.peak_offsets[idx.order], cnt
-            ) + _grouped_arange(cnt)
+            mz_c, _, cnt = self._cluster_ordered_peaks(table, idx)
             spec_offsets = np.zeros(idx.order.size + 1, dtype=np.int64)
             np.cumsum(cnt, out=spec_offsets[1:])
             cso = np.zeros(table.n_clusters + 1, dtype=np.int64)
             np.cumsum(idx.n_members, out=cso[1:])
         with st.phase("compute"):
             shared_flat, out_offsets = medoid_native.shared_bin_counts(
-                table.mz[src], spec_offsets, cso, config.bin_size
+                mz_c, spec_offsets, cso, config.bin_size
             )
         with st.phase("finalize"):
-            # one padded finalize call, identical math to the device path
+            # identical math to the device path, grouped by member count:
+            # a single globally-padded (B, Mmax, Mmax) batch would inflate
+            # memory quadratically for every cluster off one big outlier
+            # (advisor r5) — equal-M groups stack with ZERO padding
             m_per = np.diff(cso)
-            m_max = int(m_per.max(initial=1))
             b = table.n_clusters
-            shared = np.zeros((b, m_max, m_max), dtype=np.int64)
-            n_peaks = np.zeros((b, m_max), dtype=np.int64)
-            mask = np.zeros((b, m_max), dtype=bool)
-            for ci in range(b):
-                m = int(m_per[ci])
-                shared[ci, :m, :m] = shared_flat[
-                    out_offsets[ci] : out_offsets[ci + 1]
-                ].reshape(m, m)
-                s0 = int(cso[ci])
-                n_peaks[ci, :m] = cnt[s0 : s0 + m]
-                mask[ci, :m] = True
-            indices = medoid_finalize(
-                shared, n_peaks, mask, m_per.astype(np.int64)
-            )
+            indices = np.zeros(b, dtype=np.int64)
+            for m in np.unique(m_per):
+                sel = np.flatnonzero(m_per == m)
+                g = sel.size
+                take = out_offsets[sel][:, None] + np.arange(m * m)
+                shared = shared_flat[take].reshape(g, m, m).astype(np.int64)
+                n_peaks = cnt[cso[sel][:, None] + np.arange(m)]
+                indices[sel] = medoid_finalize(
+                    shared,
+                    n_peaks,
+                    np.ones((g, m), dtype=bool),
+                    np.full(g, m, dtype=np.int64),
+                )
         st.count("clusters", len(clusters))
         return [int(i) for i in indices]
 
@@ -1169,27 +1160,41 @@ class TpuBackend:
                 title=batch.cluster_ids[ci],
             )
 
+    @staticmethod
+    def _cluster_ordered_peaks(table, idx):
+        """``(mz, intensity, cnt)`` with spectra grouped by cluster in
+        code order — ZERO-COPY views when the table is already
+        cluster-contiguous (the common CLI case: the parser emits spectra
+        in file order and clusters are file-grouped), one gather
+        otherwise."""
+        from specpride_tpu.data.packed import _grouped_arange
+
+        cnt = table.peak_counts[idx.order]
+        if np.array_equal(idx.order, np.arange(idx.order.size)):
+            return table.mz, table.intensity, cnt
+        src = np.repeat(
+            table.peak_offsets[idx.order], cnt
+        ) + _grouped_arange(cnt)
+        return table.mz[src], table.intensity[src], cnt
+
     def _prep_cosine_native(self, clusters, config: CosineConfig):
         """Representative-independent half of the NATIVE cosine path: the
-        flat member layout (one gather off the columnar table — no
+        flat member layout (at most one gather off the columnar table — no
         quantization, no sort: the C++ kernel bins on the fly in cache).
         Split out so the fused pipeline can run it while the consensus
         kernel and its D2H stream are in flight."""
-        from specpride_tpu.data.packed import _as_table, _grouped_arange
+        from specpride_tpu.data.packed import _as_table
 
         table = _as_table(clusters)
         idx = table.cluster_order()
-        cnt = table.peak_counts[idx.order]
-        src = np.repeat(table.peak_offsets[idx.order], cnt) + _grouped_arange(
-            cnt
-        )
+        mem_mz, mem_int, cnt = self._cluster_ordered_peaks(table, idx)
         spec_offsets = np.zeros(idx.order.size + 1, dtype=np.int64)
         np.cumsum(cnt, out=spec_offsets[1:])
         cso = np.zeros(table.n_clusters + 1, dtype=np.int64)
         np.cumsum(idx.n_members, out=cso[1:])
         return dict(
-            mem_mz=table.mz[src],
-            mem_int=quantize.cosine_normalize(table.intensity[src], config),
+            mem_mz=mem_mz,
+            mem_int=quantize.cosine_normalize(mem_int, config),
             spec_offsets=spec_offsets,
             cluster_spec_offsets=cso,
             n_members=idx.n_members,

@@ -389,6 +389,38 @@ class TestMedoidParity:
         idx = backend._medoid_indices_native(clusters, MedoidConfig())
         assert idx == [nb.medoid_index(c.members) for c in clusters]
 
+    def test_bin_boundary_mzs(self, rng):
+        """One-decimal m/z values sit exactly on the default 0.1 Da grid
+        edges — trunc(mz / bin_size) must match numpy's division bit for
+        bit (advisor r5: a reciprocal-multiply formulation binned ~32% of
+        such values differently, e.g. 100.1*10.0000..x -> 1000 instead of
+        1001)."""
+        members = []
+        for k, base in enumerate(([100.1, 250.7, 999.9],
+                                  [100.1, 250.7, 999.89],
+                                  [100.14, 250.72, 999.9])):
+            members.append(Spectrum(
+                mz=np.array(base), intensity=np.array([5.0, 7.0, 9.0]),
+                precursor_mz=500.0, precursor_charge=2,
+                title=f"c1;mzspec:PXD1:r:scan:{k}",
+            ))
+        clusters = [Cluster("c1", members)]
+        oracle = [nb.medoid_index(c.members) for c in clusters]
+        for layout in ("auto", "bucketized"):
+            assert TpuBackend(layout=layout).medoid_indices(
+                clusters
+            ) == oracle
+
+    def test_mixed_member_counts_group_finalize(self, rng):
+        """Clusters of very different sizes finalize in equal-M groups
+        (no global quadratic padding): outputs stay in input order."""
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=m, n_peaks=20)
+            for i, m in enumerate([1, 7, 2, 7, 15, 1, 3])
+        ]
+        oracle = [nb.medoid_index(c.members) for c in clusters]
+        assert TpuBackend().medoid_indices(clusters) == oracle
+
     def test_identical_members_lowest_index(self, rng, backend):
         s = make_cluster(rng, n_members=1).members[0]
         members = [
