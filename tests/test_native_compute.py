@@ -1,0 +1,143 @@
+"""JAX-free tests for the native compute kernels (cosine + medoid).
+
+Deliberately imports no jax: ``make -C native tsan`` runs this module with
+the ThreadSanitizer builds preloaded, and an instrumented process that
+loads jax drowns in false positives from its uninstrumented runtime
+threads.  The oracle (``backends.numpy_backend``) is pure numpy, so the
+same parity checks run clean under TSan.
+"""
+
+import numpy as np
+import pytest
+
+from specpride_tpu.backends import numpy_backend as nb
+from specpride_tpu.config import CosineConfig, MedoidConfig
+from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.ops import cosine_native, medoid_native
+
+
+def _clusters(rng, n=24, max_members=9):
+    """Enough clusters that the worker pool actually runs multi-threaded
+    (when cores exist) — the point of the TSan pass."""
+    out = []
+    for i in range(n):
+        n_peaks = int(rng.integers(5, 120))
+        skel = np.sort(rng.uniform(120.0, 1800.0, n_peaks))
+        members = [
+            Spectrum(
+                mz=np.sort(skel + rng.normal(0, 0.003, n_peaks)),
+                intensity=rng.uniform(1.0, 1e4, n_peaks),
+                precursor_mz=500.0,
+                precursor_charge=2,
+                title=f"cluster-{i};mzspec:PXD1:r:scan:{i * 100 + m}",
+            )
+            for m in range(int(rng.integers(1, max_members)))
+        ]
+        out.append(Cluster(f"cluster-{i}", members))
+    return out
+
+
+def _flat_layout(clusters):
+    mz, inten, spec_offsets, cso = [], [], [0], [0]
+    for c in clusters:
+        for s in c.members:
+            mz.append(np.asarray(s.mz, np.float64))
+            inten.append(np.asarray(s.intensity, np.float64))
+            spec_offsets.append(spec_offsets[-1] + s.n_peaks)
+        cso.append(cso[-1] + c.n_members)
+    cat = lambda xs: (
+        np.concatenate(xs) if xs else np.zeros(0, np.float64)
+    )
+    return (
+        cat(mz), cat(inten),
+        np.array(spec_offsets, np.int64), np.array(cso, np.int64),
+    )
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+class TestNativeCosineStandalone:
+    @pytest.fixture(autouse=True)
+    def _need(self):
+        if not cosine_native.available():
+            pytest.skip("native cosine not built")
+
+    def test_pair_cosines_match_oracle(self, rng):
+        clusters = _clusters(rng)
+        reps = nb.run_bin_mean(clusters)
+        mem_mz, mem_int, spec_offsets, cso = _flat_layout(clusters)
+        rep_offsets = np.zeros(len(reps) + 1, np.int64)
+        np.cumsum([r.n_peaks for r in reps], out=rep_offsets[1:])
+        cos = cosine_native.pair_cosines(
+            np.concatenate([r.mz for r in reps]),
+            np.concatenate([r.intensity for r in reps]),
+            rep_offsets, mem_mz, mem_int, spec_offsets, cso,
+            CosineConfig().mz_space,
+        )
+        k = 0
+        for rep, c in zip(reps, clusters):
+            for s in c.members:
+                assert cos[k] == pytest.approx(
+                    nb.binned_cosine(rep, s), rel=1e-12, abs=1e-14
+                )
+                k += 1
+
+
+class TestNativeMedoidStandalone:
+    @pytest.fixture(autouse=True)
+    def _need(self):
+        if not medoid_native.available():
+            pytest.skip("native medoid not built")
+
+    def test_shared_counts_match_oracle(self, rng):
+        clusters = _clusters(rng)
+        mem_mz, _, spec_offsets, cso = _flat_layout(clusters)
+        bin_size = MedoidConfig().bin_size
+        shared_flat, out_offsets = medoid_native.shared_bin_counts(
+            mem_mz, spec_offsets, cso, bin_size
+        )
+        for ci, c in enumerate(clusters):
+            m = c.n_members
+            shared = shared_flat[
+                out_offsets[ci] : out_offsets[ci + 1]
+            ].reshape(m, m)
+            for i in range(m):
+                bi = np.unique(
+                    (c.members[i].mz / bin_size).astype(np.int64)
+                )
+                assert shared[i, i] == bi.size
+                for j in range(i + 1, m):
+                    bj = np.unique(
+                        (c.members[j].mz / bin_size).astype(np.int64)
+                    )
+                    expect = np.intersect1d(
+                        bi, bj, assume_unique=True
+                    ).size
+                    assert shared[i, j] == expect == shared[j, i]
+
+    def test_boundary_values(self):
+        """One-decimal m/z on exact 0.1 Da grid edges must bin by true
+        division (trunc(mz / bin_size)), as numpy does."""
+        s1 = Spectrum(
+            mz=np.array([100.1, 250.7, 999.9]),
+            intensity=np.ones(3), precursor_mz=500.0, precursor_charge=2,
+            title="c;u1",
+        )
+        s2 = Spectrum(
+            mz=np.array([100.14, 250.72, 999.95]),
+            intensity=np.ones(3), precursor_mz=500.0, precursor_charge=2,
+            title="c;u2",
+        )
+        mem_mz, _, spec_offsets, cso = _flat_layout(
+            [Cluster("c", [s1, s2])]
+        )
+        shared_flat, _ = medoid_native.shared_bin_counts(
+            mem_mz, spec_offsets, cso, 0.1
+        )
+        shared = shared_flat.reshape(2, 2)
+        b1 = np.unique((s1.mz / 0.1).astype(np.int64))
+        b2 = np.unique((s2.mz / 0.1).astype(np.int64))
+        assert shared[0, 1] == np.intersect1d(b1, b2).size
