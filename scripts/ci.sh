@@ -15,7 +15,7 @@ python -m pytest tests/ -x -q
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan
-    echo "== native: TSan parser suite =="
+    echo "== native: TSan parser + threaded compute kernels =="
     make -C native tsan
 fi
 echo "CI OK"
