@@ -502,11 +502,16 @@ def pack_flat_bin_mean(
     # are independent segments, so a global lexsort wastes the structure)
     from specpride_tpu.ops.segsort import seg_argsort
 
-    cnt_kept = kept_counts[idx.order]
-    src2 = np.repeat(
-        kept_offsets[idx.order], cnt_kept
-    ) + _grouped_arange(cnt_kept)
-    orig = kept_src[src2]  # original peak ids, grouped by cluster
+    if np.array_equal(idx.order, np.arange(idx.order.size)):
+        # spectra already cluster-contiguous (the common CLI case): kept
+        # peaks are already grouped by cluster in kept_src order
+        orig = kept_src
+    else:
+        cnt_kept = kept_counts[idx.order]
+        src2 = np.repeat(
+            kept_offsets[idx.order], cnt_kept
+        ) + _grouped_arange(cnt_kept)
+        orig = kept_src[src2]  # original peak ids, grouped by cluster
     order_local = seg_argsort(bins64[orig], row_peak_offsets)
     final = orig[order_local]
     s_mz = table.mz[final].astype(np.float32)
