@@ -115,11 +115,16 @@ def bench_method(
     numpy_sample: int,
     seed: int,
     steady_runs: int = 3,
+    journal=None,
 ) -> dict:
     """Bench one method: numpy oracle rate (stratified sample or full set),
     device warm-up (compile) time, steady-state rate, and the backend's
-    per-phase seconds for the best steady run."""
-    from specpride_tpu.utils.observe import RunStats
+    per-phase seconds for the best steady run.  With ``journal``, the
+    per-run phase numbers stream out as ``bench_run`` events (the BENCH
+    stdout JSON line is unchanged)."""
+    from specpride_tpu.observability import NullJournal, RunStats
+
+    journal = journal if journal is not None else NullJournal()
 
     run_np, run_dev = _runners(backend, nb)
 
@@ -166,7 +171,7 @@ def bench_method(
                 k: round(v, 4) for k, v in backend.stats.phases.items()
             }
 
-    return {
+    entry = {
         "method": method,
         "metric": METRIC_NAMES[method],
         "numpy_clusters_per_sec": round(numpy_rate, 2),
@@ -176,6 +181,14 @@ def bench_method(
         "device_phases_s": best_phases,
         "speedup_vs_numpy": round(best_rate / numpy_rate, 3),
     }
+    journal.emit(
+        "bench_run", method=method, phases_s=best_phases,
+        device_clusters_per_sec=entry["device_clusters_per_sec"],
+        numpy_clusters_per_sec=entry["numpy_clusters_per_sec"],
+        device_warmup_s=entry["device_warmup_s"],
+        n_clusters=len(clusters),
+    )
+    return entry
 
 
 def bench_end_to_end(clusters, workdir: str, runs: int = 2) -> dict:
@@ -384,6 +397,11 @@ def main() -> None:
         help="block after dispatch so the 'device' (H2D+kernel) and 'd2h' "
         "(pure transfer) phases time apart",
     )
+    ap.add_argument(
+        "--journal", metavar="FILE", default=None,
+        help="stream per-run phase telemetry as JSONL bench_run events "
+        "(default with --report: <report>.journal.jsonl)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -401,11 +419,23 @@ def main() -> None:
         f"built in {time.perf_counter() - t0:.1f}s"
     )
 
+    from specpride_tpu.observability import device_summary, open_journal
+
+    journal_path = args.journal or (
+        args.report + ".journal.jsonl" if args.report else None
+    )
+    journal = open_journal(journal_path)
+    journal.emit(
+        "run_start", command="bench", method=args.method,
+        backend="tpu", n_clusters=len(clusters),
+    )
+
     # large batches: on tunneled hosts every extra dispatch costs a full
     # round-trip, so amortize over as many clusters as memory allows
     backend = TpuBackend(
         batch_config=BatchConfig(clusters_per_batch=4096),
         sync_timing=args.sync_timing,
+        journal=journal,
     )
 
     if args.report:
@@ -431,6 +461,7 @@ def main() -> None:
                 bench_method(
                     method, clusters, backend, nb,
                     numpy_sample=len(clusters), seed=args.seed,
+                    journal=journal,
                 )
             )
             # back-to-back methods in one process measurably degrade on
@@ -445,11 +476,16 @@ def main() -> None:
             batch_config=BatchConfig(clusters_per_batch=4096),
             layout="flat",
             sync_timing=args.sync_timing,
+            journal=journal,
+            # one registry across both backends: run_end.device must cover
+            # the flat-layout benches too, not just the default backend's
+            metrics=backend.metrics,
         )
         for method in ("bin_mean", "pipeline"):
             entry = bench_method(
                 method, clusters, dev_backend, nb,
                 numpy_sample=len(clusters), seed=args.seed,
+                journal=journal,
             )
             entry["method"] += "_device_flat"
             entry["metric"] += " [device flat layout]"
@@ -474,7 +510,17 @@ def main() -> None:
         head = bench_method(
             args.method, clusters, backend, nb,
             numpy_sample=args.numpy_sample, seed=args.seed,
+            journal=journal,
         )
+
+    journal.emit(
+        "run_end",
+        counters={"clusters": len(clusters), "spectra": n_spectra},
+        phases_s=head["device_phases_s"],
+        elapsed_s=round(time.perf_counter() - t0, 2),
+        device=device_summary(backend.metrics),
+    )
+    journal.close()
 
     print(
         json.dumps(

@@ -12,6 +12,19 @@ cd "$(dirname "$0")/.."
 echo "== pytest =="
 python -m pytest tests/ -x -q
 
+echo "== observability: journal-producing pipeline + specpride stats =="
+# one real CLI run must produce a schema-valid journal and metrics file;
+# `specpride stats` exits non-zero on any schema violation
+obs_tmp=$(mktemp -d)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus tests/data/golden_clustered.mgf "$obs_tmp/reps.mgf" \
+    --method bin-mean --backend tpu \
+    --journal "$obs_tmp/run.jsonl" --metrics-out "$obs_tmp/run.prom"
+test -s "$obs_tmp/run.prom"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$obs_tmp/run.jsonl" --json "$obs_tmp/agg.json"
+rm -rf "$obs_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

@@ -402,8 +402,26 @@ def average_cosine(
 # Cluster-level drivers
 # ---------------------------------------------------------------------------
 
+# module-level registry: the oracle backend is a module, not a class, so its
+# telemetry lives here.  It records the SAME metric families the device
+# backend does (device-only series — compiles, H2D/D2H bytes, padding —
+# simply stay zero), so an oracle run's --metrics-out and run_end.device
+# diff cleanly against a device run's.
+from specpride_tpu.observability import MetricsRegistry as _MetricsRegistry
+
+metrics = _MetricsRegistry()
+
+
+def _count_run(method: str, n: int) -> None:
+    metrics.counter(
+        "specpride_oracle_clusters_total",
+        "clusters processed by the numpy oracle", labels=("method",),
+    ).inc(n, method=method)
+
+
 def run_bin_mean(clusters: list[Cluster], config: BinMeanConfig = BinMeanConfig()) -> list[Spectrum]:
     """Per-cluster loop of ref src/binning.py:291-297."""
+    _count_run("bin_mean", len(clusters))
     return [bin_mean_consensus(c.members, config, c.cluster_id) for c in clusters]
 
 
@@ -411,6 +429,7 @@ def run_gap_average(
     clusters: list[Cluster], config: GapAverageConfig = GapAverageConfig()
 ) -> list[Spectrum]:
     """Per-cluster loop of ref src/average_spectrum_clustering.py:158-164."""
+    _count_run("gap_average", len(clusters))
     get_pepmass, get_rt = resolve_gap_estimators(config)
     out = []
     for c in clusters:
@@ -426,6 +445,7 @@ def run_medoid(
     clusters: list[Cluster], config: MedoidConfig = MedoidConfig()
 ) -> list[Spectrum]:
     """Per-cluster loop of ref src/most_similar_representative.py:60-111."""
+    _count_run("medoid", len(clusters))
     return [c.members[medoid_index(c.members, config)] for c in clusters]
 
 
@@ -436,6 +456,7 @@ def run_best_spectrum(
 ) -> list[Spectrum]:
     """Scoreless clusters are silently dropped (ref src/best_spectrum.py:
     170-174)."""
+    _count_run("best", len(clusters))
     out = []
     for c in clusters:
         try:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 
 import numpy as np
 
@@ -35,7 +36,7 @@ from specpride_tpu.config import (
 from specpride_tpu.data.peaks import Cluster, Spectrum
 from specpride_tpu.ops import quantize
 from specpride_tpu.backends import numpy_backend
-from specpride_tpu.utils.observe import RunStats
+from specpride_tpu.observability import MetricsRegistry, NullJournal, RunStats
 
 
 _cache_configured = False
@@ -232,9 +233,126 @@ class TpuBackend:
     # (pure transfer) time apart.  Off by default — each block is a tunnel
     # round trip (~0.1 s measured).
     sync_timing: bool = False
+    # telemetry sinks (observability subsystem): per-kernel compile /
+    # dispatch / padding / byte counters, and the run-journal event stream.
+    # The CLI points ``journal`` at its --journal file; both default to
+    # no-ops so library use pays only dict bumps.
+    metrics: MetricsRegistry = dataclasses.field(
+        default_factory=MetricsRegistry, repr=False
+    )
+    journal: object = dataclasses.field(
+        default_factory=NullJournal, repr=False
+    )
+    # pack-waste accounting is an O(rows*k) host reduction per dispatch
+    # (the lazy ``real_elems`` callables below), so it runs only when the
+    # numbers are consumed: a journal is attached, or the CLI flips this
+    # on for --metrics-out.  Bare library use pays only dict bumps.
+    pack_accounting: bool = False
+    # (kernel, shape-class) combos dispatched by THIS backend — a new combo
+    # is a fresh XLA trace, i.e. a compile (an upper bound: the persistent
+    # on-disk cache may turn it into a cache load)
+    _seen_shapes: set = dataclasses.field(
+        default_factory=set, repr=False
+    )
 
     def __post_init__(self):
         _ensure_compile_cache()
+
+    # -- telemetry hooks ------------------------------------------------
+
+    def _note_dispatch(
+        self, kernel: str, shape_key: tuple, *, rows: int, padded_rows: int,
+        real_elems=None, padded_elems: int | None = None,
+        seconds: float | None = None,
+    ) -> None:
+        """Record one device dispatch: per-kernel dispatch/compile counters,
+        bucket occupancy (real vs padded rows), pack padding waste (real vs
+        padded elements), dispatch-call latency, and the journal events an
+        operator tails (``compile`` once per new shape class, ``dispatch``
+        per call).
+
+        ``real_elems`` may be a zero-arg callable deferring an expensive
+        host reduction; it is evaluated only when pack accounting is on."""
+        m = self.metrics
+        if callable(real_elems):
+            real_elems = (
+                int(real_elems())
+                if getattr(self.journal, "enabled", True)
+                or self.pack_accounting
+                else None
+            )
+        key = (kernel, *shape_key)
+        if key not in self._seen_shapes:
+            self._seen_shapes.add(key)
+            m.counter(
+                "specpride_compiles_total",
+                "XLA compiles: first dispatch of a (kernel, shape-class)",
+                labels=("kernel",),
+            ).inc(1, kernel=kernel)
+            self.journal.emit(
+                "compile", kernel=kernel, shape_key=list(shape_key)
+            )
+        m.counter(
+            "specpride_dispatches_total", "device kernel dispatches",
+            labels=("kernel",),
+        ).inc(1, kernel=kernel)
+        m.counter(
+            "specpride_rows_real_total",
+            "real cluster rows dispatched", labels=("kernel",),
+        ).inc(rows, kernel=kernel)
+        m.counter(
+            "specpride_rows_padded_total",
+            "dispatched cluster rows incl. shape padding",
+            labels=("kernel",),
+        ).inc(padded_rows, kernel=kernel)
+        if real_elems is not None and padded_elems:
+            m.counter(
+                "specpride_pack_real_elements_total",
+                "real packed elements shipped", labels=("kernel",),
+            ).inc(int(real_elems), kernel=kernel)
+            m.counter(
+                "specpride_pack_padded_elements_total",
+                "packed elements shipped incl. padding", labels=("kernel",),
+            ).inc(int(padded_elems), kernel=kernel)
+        if seconds is not None:
+            m.histogram(
+                "specpride_dispatch_seconds",
+                "dispatch-call wall time (async: excludes device execution "
+                "unless sync_timing)", labels=("kernel",),
+            ).observe(seconds, kernel=kernel)
+        self.journal.emit(
+            "dispatch", kernel=kernel, rows=rows, padded_rows=padded_rows,
+            **(
+                {"real_elems": int(real_elems),
+                 "padded_elems": int(padded_elems)}
+                if real_elems is not None and padded_elems else {}
+            ),
+        )
+
+    def _note_d2h(self, arrays) -> None:
+        self.metrics.counter(
+            "specpride_bytes_d2h_total", "bytes fetched device->host",
+        ).inc(sum(int(a.nbytes) for a in arrays))
+        self._note_device_memory()
+
+    def _note_device_memory(self) -> None:
+        """Device memory high-water gauge (best effort: CPU/older PJRT
+        backends expose no memory_stats)."""
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            return
+        if not stats:
+            return
+        peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+        if peak:
+            g = self.metrics.gauge(
+                "specpride_device_peak_bytes_in_use",
+                "high-water device memory (bytes) observed at collect time",
+            )
+            g.set(max(float(peak), g.value()))
 
     def _dispatch_size(self, chunk: int, b: int) -> int:
         """Dispatch (padded) cluster count: the chunk size rounded up to a
@@ -254,15 +372,20 @@ class TpuBackend:
         return size
 
     def _ship(self, *arrays: np.ndarray):
-        """Shard inputs over the mesh (if any) along the cluster axis."""
+        """Shard inputs over the mesh (if any) along the cluster axis.
+
+        Mesh-less, the host arrays are returned as-is and jit transfers
+        them implicitly — still a real H2D, so both paths count bytes."""
+        self.metrics.counter(
+            "specpride_bytes_h2d_total", "bytes shipped host->device",
+        ).inc(sum(int(a.nbytes) for a in arrays))
         if self.mesh is None:
             return arrays
         from specpride_tpu.parallel.mesh import shard_batch_arrays
 
         return shard_batch_arrays(self.mesh, *arrays)
 
-    @staticmethod
-    def _put_batch(arrays: list[np.ndarray]) -> list:
+    def _put_batch(self, arrays: list[np.ndarray]) -> list:
         """One batched host->device transfer for a kernel's argument list.
 
         ``jax.device_put`` on a pytree ships every leaf in a single
@@ -271,6 +394,9 @@ class TpuBackend:
         0.056 s batched)."""
         import jax
 
+        self.metrics.counter(
+            "specpride_bytes_h2d_total", "bytes shipped host->device",
+        ).inc(sum(int(a.nbytes) for a in arrays))
         return jax.device_put(arrays)
 
     def _timed_batches(self, batches):
@@ -300,7 +426,9 @@ class TpuBackend:
             for a in arrays:
                 if hasattr(a, "copy_to_host_async"):
                     a.copy_to_host_async()
-            return [np.asarray(a) for a in arrays]
+            out = [np.asarray(a) for a in arrays]
+        self._note_d2h(out)
+        return out
 
     # -- binned-mean consensus (K1) -------------------------------------
 
@@ -346,6 +474,8 @@ class TpuBackend:
                     )
                     # pow2: cap is a static jit arg — see _pow2
                     cap = _cap_class(int(dist.sum()), floor=1024)
+                lcap = _pow2(int(batch.n_members.max(initial=1)))
+                t0 = time.perf_counter()
                 with st.phase("dispatch"):
                     fused = bin_mean_deduped_compact(
                         *self._ship(
@@ -361,8 +491,17 @@ class TpuBackend:
                         config=config,
                         total_cap=cap,
                         # dedup bounds (row, bin) runs at the member count
-                        lcap=_pow2(int(batch.n_members.max(initial=1))),
+                        lcap=lcap,
                     )
+                self._note_dispatch(
+                    "bin_mean_bucketized", (size, k, cap, lcap),
+                    rows=hi - lo, padded_rows=size,
+                    real_elems=lambda lo=lo, hi=hi: (
+                        batch.bins[lo:hi] != config.n_bins
+                    ).sum(),
+                    padded_elems=size * k,
+                    seconds=time.perf_counter() - t0,
+                )
                 pending.append((batch, lo, hi, cap, fused))
 
         fuseds = self._collect([p[-1] for p in pending])
@@ -425,6 +564,7 @@ class TpuBackend:
         keep_runs = np.zeros(rcap, dtype=bool)
         keep_runs[: aux["keep"].size] = aux["keep"]
 
+        t0 = time.perf_counter()
         fused = bin_mean_flat_intensity(
             *self._put_batch([
                 np.pad(batch.intensity, (0, n_pad - n)),
@@ -434,6 +574,12 @@ class TpuBackend:
             total_cap=cap,
             rcap=rcap,
             lcap=lcap,
+        )
+        self._note_dispatch(
+            "bin_mean_flat_intensity", (n_pad, cap, rcap, lcap),
+            rows=rows, padded_rows=rows,
+            real_elems=n, padded_elems=n_pad,
+            seconds=time.perf_counter() - t0,
         )
         return fused, aux
 
@@ -558,6 +704,7 @@ class TpuBackend:
         else:
             with st.phase("d2h"):
                 fuseds = [p[-1].get() for p in pending]
+            self._note_d2h(fuseds)
         with st.phase("finalize"):
             for (batch, aux, _), fused in zip(pending, fuseds):
                 self._emit_bin_mean_rows(batch, fused, aux, clusters, out)
@@ -742,6 +889,7 @@ class TpuBackend:
                 # compacted D2H buffer carries only real output bytes
                 # pow2: cap is a static jit arg — see _pow2
                 cap = _cap_class(int(batch.n_groups[lo:hi].sum()), floor=1024)
+                t0 = time.perf_counter()
                 with st.phase("dispatch"):
                     fused = gap_average_compact(
                         *self._ship(
@@ -755,6 +903,13 @@ class TpuBackend:
                         config=config,
                         total_cap=cap,
                     )
+                self._note_dispatch(
+                    "gap_average_compact", (size, k, cap),
+                    rows=hi - lo, padded_rows=size,
+                    real_elems=lambda lo=lo, hi=hi: batch.n_valid[lo:hi].sum(),
+                    padded_elems=size * k,
+                    seconds=time.perf_counter() - t0,
+                )
                 pending.append((batch, lo, hi, cap, fused))
 
         fuseds = self._collect([p[-1] for p in pending])
@@ -839,6 +994,7 @@ class TpuBackend:
             chunk = max(1, (4 * self.max_grid_elements) // max(k * m, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
+                t0 = time.perf_counter()
                 with st.phase("dispatch"):
                     args = (
                         _pad_axis0(sbins[lo:hi], size, fill=2**30),
@@ -852,6 +1008,13 @@ class TpuBackend:
                     res = shared_bins_packed(*args, m=m, lcap=lcap)
                     # slice on device first: D2H carries only real rows
                     res = res[: hi - lo]
+                self._note_dispatch(
+                    "shared_bins_packed", (size, k, m, lcap),
+                    rows=hi - lo, padded_rows=size,
+                    real_elems=lambda lo=lo, hi=hi: (smm[lo:hi] != m).sum(),
+                    padded_elems=size * k,
+                    seconds=time.perf_counter() - t0,
+                )
                 pending.append((batch, lo, hi, res))
 
         shareds = self._collect([p[-1] for p in pending])
@@ -1030,6 +1193,7 @@ class TpuBackend:
             chunk = max(1, self.max_grid_elements // max((k + pr) * 6, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
+                t0 = time.perf_counter()
                 with st.phase("dispatch"):
                     mean, _ = cosine_packed(
                         *self._ship(
@@ -1045,6 +1209,13 @@ class TpuBackend:
                         ),
                         m=m,
                     )
+                self._note_dispatch(
+                    "cosine_packed", (size, k, pr, m),
+                    rows=hi - lo, padded_rows=size,
+                    real_elems=lambda lo=lo, hi=hi: (mem_mm[lo:hi] != m).sum(),
+                    padded_elems=size * k,
+                    seconds=time.perf_counter() - t0,
+                )
                 pending.append((idxs, lo, hi, mean))
 
         means = self._collect([p[-1] for p in pending])
@@ -1574,6 +1745,7 @@ class TpuBackend:
                     + cut_spec_all[s0:s1] + 1,
                 ).astype(np.int32)
 
+            t0 = time.perf_counter()
             with st.phase("dispatch"):
                 mean = cosine_flat(
                     *self._put_batch([
@@ -1597,6 +1769,12 @@ class TpuBackend:
                     l_mem=prep["l_mem"],
                     l_members=prep["l_members"],
                 )
+            self._note_dispatch(
+                "cosine_flat", (n_pad, nr_pad, rows_cap, s_pad),
+                rows=rows, padded_rows=rows_cap,
+                real_elems=n, padded_elems=n_pad,
+                seconds=time.perf_counter() - t0,
+            )
             pending.append((lo, rows, mean))
             lo = hi
 

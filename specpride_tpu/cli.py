@@ -33,7 +33,17 @@ from specpride_tpu.config import (
 )
 from specpride_tpu.data.peaks import Cluster, group_into_clusters
 from specpride_tpu.io.mgf import read_mgf, write_mgf
-from specpride_tpu.utils.observe import RunStats, configure_logging, logger
+from specpride_tpu.observability import (
+    MetricsRegistry,
+    NullJournal,
+    RunStats,
+    configure_logging,
+    device_summary,
+    device_trace,
+    export_run_metrics,
+    logger,
+    open_journal,
+)
 
 
 def _add_backend(p: argparse.ArgumentParser) -> None:
@@ -61,6 +71,26 @@ def _add_backend(p: argparse.ArgumentParser) -> None:
                    help="multi-host: total process count")
     p.add_argument("--process-id", type=int,
                    help="multi-host: this process's rank")
+
+
+def _add_observability(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--journal", metavar="FILE",
+        help="append-only JSONL run journal: typed events (run_start, "
+        "chunk heartbeats, compile/dispatch, checkpoint_write, resume, "
+        "run_end) an operator can tail live; multi-host runs write "
+        "<FILE>.part<rank> (read with `specpride stats`)",
+    )
+    p.add_argument(
+        "--metrics-out", metavar="FILE",
+        help="write run metrics as a Prometheus textfile on exit "
+        "(counters/gauges/histograms; node_exporter textfile format)",
+    )
+    p.add_argument(
+        "--trace-dir", metavar="DIR",
+        help="capture a jax.profiler device trace of the compute into "
+        "this directory (view with TensorBoard / Perfetto)",
+    )
 
 
 def _get_backend(args):
@@ -140,6 +170,13 @@ def _shard_for_process(clusters: list, args) -> tuple[list, str]:
         # per-rank QC shards too — every rank writing the same JSON path
         # would leave a last-writer-wins report covering one shard
         args.qc_report = f"{args.qc_report}.part{pid:05d}"
+    # per-rank telemetry: concurrent appends to one journal would interleave
+    # events across ranks (and one metrics file would be last-writer-wins);
+    # `specpride stats` re-merges the parts rank-aware like `merge-parts`
+    if getattr(args, "journal", None):
+        args.journal = f"{args.journal}.part{pid:05d}"
+    if getattr(args, "metrics_out", None):
+        args.metrics_out = f"{args.metrics_out}.part{pid:05d}"
     logger.info(
         "process %d/%d: %d of %d clusters -> %s",
         pid, nproc, len(mine), len(clusters), part,
@@ -321,7 +358,7 @@ def _run_method(backend, method: str, clusters, args, scores=None,
 
 def _checkpointed_run(
     backend, method, clusters, args, stats: RunStats, scores=None,
-    qc: list | None = None,
+    qc: list | None = None, journal=None,
 ):
     """Chunked execution with a resume manifest (survey §5).
 
@@ -330,6 +367,7 @@ def _checkpointed_run(
     between leaves output past the manifest's recorded size; resume
     truncates back to that offset before appending, so the re-run chunk is
     never duplicated (the advisor's r1 duplicate-append window)."""
+    journal = journal if journal is not None else NullJournal()
     done: set[str] = set()
     output_bytes: int | None = None  # None: manifest predates offset tracking
     restarted = False  # a resume state was found unusable and discarded
@@ -377,6 +415,10 @@ def _checkpointed_run(
             with open(args.output, "r+b") as fh:
                 fh.truncate(output_bytes)
         logger.info("resuming: %d clusters already done", len(done))
+        journal.emit(
+            "resume", n_done=len(done), restarted=restarted,
+            n_prior_failed=len(prior_failed),
+        )
 
     # index-based filtering: a StreamedClusters input exposes ids from its
     # byte index, so resume filtering never materialises member spectra
@@ -423,8 +465,14 @@ def _checkpointed_run(
     failed: dict[str, None] = dict.fromkeys(prior_failed)
     qc_failed: dict[str, None] = {}
     on_error = getattr(args, "on_error", "abort")
-    for start in range(0, len(todo_idx), chunk):
+    import time as _time
+
+    for chunk_index, start in enumerate(range(0, len(todo_idx), chunk)):
         part = [clusters[i] for i in todo_idx[start : start + chunk]]
+        journal.emit(
+            "chunk_start", chunk_index=chunk_index, n_clusters=len(part)
+        )
+        chunk_t0 = _time.perf_counter()
         n_qc_before = len(qc) if qc is not None else 0
         try:
             with stats.phase("compute"):
@@ -487,12 +535,24 @@ def _checkpointed_run(
                 # must be able to tell "row dropped by the method" from
                 # "QC itself failed" (advisor r4)
                 qc_failed.update(dict.fromkeys(c.cluster_id for c in part))
+                journal.emit(
+                    "qc_failure",
+                    cluster_ids=[c.cluster_id for c in part],
+                    error=str(e),
+                )
         with stats.phase("write"):
             write_mgf(reps, args.output, append=not first_write)
         first_write = False
         stats.count("clusters", len(part))
         stats.count("representatives", len(reps))
         done.update(c.cluster_id for c in part)
+        chunk_dt = _time.perf_counter() - chunk_t0
+        journal.emit(
+            "chunk_done", chunk_index=chunk_index, n_clusters=len(part),
+            n_representatives=len(reps), elapsed_s=round(chunk_dt, 4),
+            clusters_per_sec=round(len(part) / chunk_dt, 2)
+            if chunk_dt > 0 else 0.0,
+        )
         if args.checkpoint:
             output_bytes = os.path.getsize(args.output)
             tmp = args.checkpoint + ".tmp"
@@ -506,12 +566,19 @@ def _checkpointed_run(
                     fh,
                 )
             os.replace(tmp, args.checkpoint)
+            journal.emit(
+                "checkpoint_write", n_done=len(done),
+                output_bytes=output_bytes,
+            )
     if failed:
         logger.warning(
             "%d clusters failed and were skipped: %s%s",
             len(failed), ", ".join(list(failed)[:5]),
             "..." if len(failed) > 5 else "",
         )
+        # the warning truncates at 5; the journal carries the FULL list so
+        # an --on-error skip run stays auditable without log archaeology
+        journal.emit("skipped_clusters", cluster_ids=sorted(failed))
     return resumed_ids, list(failed), list(qc_failed)
 
 
@@ -611,6 +678,46 @@ def _clusters_from_mzml(path: str, args, stats: RunStats) -> list[Cluster]:
     return group_into_clusters(out)
 
 
+def _open_run_journal(args, backend, command: str, n_clusters: int):
+    """Open the --journal stream (NullJournal when absent), hook it into
+    the backend's dispatch instrumentation, and emit ``run_start``."""
+    journal = open_journal(getattr(args, "journal", None))
+    if hasattr(backend, "journal"):  # TpuBackend; the numpy module has none
+        backend.journal = journal
+        # --metrics-out without --journal must still pay for pack-waste
+        # accounting: its padding gauges come from the same counters
+        if getattr(args, "metrics_out", None):
+            backend.pack_accounting = True
+    journal.emit(
+        "run_start", command=command,
+        method=getattr(args, "method", command),
+        backend=getattr(args, "backend", "numpy"),
+        n_clusters=int(n_clusters), output=args.output,
+    )
+    return journal
+
+
+def _finish_run(args, backend, stats: RunStats, journal) -> None:
+    """Emit ``run_end`` (full summary + the device-telemetry dict both
+    backends share) and write the Prometheus textfile if requested."""
+    device = device_summary(getattr(backend, "metrics", None))
+    journal.emit(
+        "run_end",
+        counters=dict(stats.counters),
+        phases_s={k: round(v, 4) for k, v in stats.phases.items()},
+        elapsed_s=round(stats.elapsed, 4),
+        representatives_written=stats.counters.get("representatives", 0),
+        clusters_per_sec=round(stats.throughput("clusters"), 2),
+        device=device,
+    )
+    journal.close()
+    if getattr(args, "metrics_out", None):
+        registry = getattr(backend, "metrics", None) or MetricsRegistry()
+        export_run_metrics(registry, stats, device)
+        registry.write_textfile(args.metrics_out)
+        logger.info("metrics -> %s", args.metrics_out)
+
+
 def cmd_consensus(args) -> int:
     stats = RunStats()
     if args.method == "bin-mean":
@@ -633,16 +740,20 @@ def cmd_consensus(args) -> int:
         clusters = [Cluster(args.output, spectra)] if spectra else []
     backend = _get_backend(args)
     clusters, args.output = _shard_for_process(clusters, args)
+    journal = _open_run_journal(args, backend, "consensus", len(clusters))
     qc = [] if getattr(args, "qc_report", None) else None
-    resumed, failed, qc_failed = _checkpointed_run(
-        backend, args.method, clusters, args, stats, qc=qc
-    )
+    with device_trace(getattr(args, "trace_dir", None)):
+        resumed, failed, qc_failed = _checkpointed_run(
+            backend, args.method, clusters, args, stats, qc=qc,
+            journal=journal,
+        )
     if qc is not None:
         _write_qc_report(args, backend, clusters, qc, stats, resumed,
                          failed, qc_failed)
     logger.info(
         "consensus done: %.1f clusters/sec", stats.throughput("clusters")
     )
+    _finish_run(args, backend, stats, journal)
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0
 
@@ -658,15 +769,25 @@ def cmd_select(args) -> int:
     backend = _get_backend(args)
     scores = _load_scores(args) if args.method == "best" else None
     clusters, args.output = _shard_for_process(clusters, args)
+    journal = _open_run_journal(args, backend, "select", len(clusters))
     qc = [] if getattr(args, "qc_report", None) else None
-    resumed, failed, qc_failed = _checkpointed_run(
-        backend, args.method, clusters, args, stats, scores, qc=qc
-    )
+    with device_trace(getattr(args, "trace_dir", None)):
+        resumed, failed, qc_failed = _checkpointed_run(
+            backend, args.method, clusters, args, stats, scores, qc=qc,
+            journal=journal,
+        )
     if qc is not None:
         _write_qc_report(args, backend, clusters, qc, stats, resumed,
                          failed, qc_failed)
+    _finish_run(args, backend, stats, journal)
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0
+
+
+def cmd_stats(args) -> int:
+    from specpride_tpu.observability.stats_cli import run_stats
+
+    return run_stats(args.journals, json_out=args.json)
 
 
 def cmd_merge_parts(args) -> int:
@@ -875,6 +996,7 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--raw-name", help="raw file name for USIs "
                                        "(direct .mzML input)")
     pc.add_argument("--px-accession", default="PXD004732")
+    _add_observability(pc)
     pc.set_defaults(fn=cmd_consensus)
 
     ps = sub.add_parser("select", help="pick an existing member per cluster")
@@ -919,6 +1041,7 @@ def build_parser() -> argparse.ArgumentParser:
         default="none",
         help="intensity transform for the QC cosine",
     )
+    _add_observability(ps)
     ps.set_defaults(fn=cmd_select)
 
     pv = sub.add_parser("convert", help="build the clustered-MGF interchange file")
@@ -953,6 +1076,17 @@ def build_parser() -> argparse.ArgumentParser:
     pm.add_argument("--remove-parts", action="store_true",
                     help="delete the part files after a successful merge")
     pm.set_defaults(fn=cmd_merge_parts)
+
+    pst = sub.add_parser(
+        "stats",
+        help="summarize run journals (accepts base paths; multi-host "
+        ".part<rank> shards merge rank-aware like merge-parts)",
+    )
+    pst.add_argument("journals", nargs="+",
+                     help="journal file(s) from --journal runs")
+    pst.add_argument("--json", metavar="FILE",
+                     help="also write the machine-readable aggregate here")
+    pst.set_defaults(fn=cmd_stats)
 
     pp = sub.add_parser("plot", help="mirror plots for one cluster")
     pp.add_argument("clustered",

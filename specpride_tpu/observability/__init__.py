@@ -1,0 +1,56 @@
+"""Run-journal telemetry subsystem (replaces ``utils/observe.py``).
+
+Four pieces:
+
+* ``stats``    — ``RunStats`` counters/phase timers, structured logging,
+                 the ``jax.profiler`` ``device_trace`` hook
+* ``journal``  — append-only JSONL event stream (``--journal FILE``):
+                 typed, versioned events an operator can tail live and
+                 post-mortem dead runs from
+* ``registry`` — named counters/gauges/histograms with labels, exported
+                 as a Prometheus textfile (``--metrics-out FILE``) and as
+                 JSON inside the journal's ``run_end`` event
+* ``stats_cli``— the ``specpride stats`` command over one or more
+                 journals (multi-host ``.part<id>`` shards merge
+                 rank-aware like ``merge-parts``)
+"""
+
+from specpride_tpu.observability.journal import (
+    EVENT_FIELDS,
+    SCHEMA_VERSION,
+    Journal,
+    NullJournal,
+    expand_parts,
+    open_journal,
+    read_events,
+    validate_event,
+)
+from specpride_tpu.observability.registry import (
+    MetricsRegistry,
+    device_summary,
+    export_run_metrics,
+)
+from specpride_tpu.observability.stats import (
+    RunStats,
+    configure_logging,
+    device_trace,
+    logger,
+)
+
+__all__ = [
+    "EVENT_FIELDS",
+    "SCHEMA_VERSION",
+    "Journal",
+    "MetricsRegistry",
+    "NullJournal",
+    "RunStats",
+    "configure_logging",
+    "device_summary",
+    "device_trace",
+    "expand_parts",
+    "export_run_metrics",
+    "logger",
+    "open_journal",
+    "read_events",
+    "validate_event",
+]

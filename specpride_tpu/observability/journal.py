@@ -1,0 +1,193 @@
+"""Run journal: append-only JSONL event stream for live tailing and
+post-mortems.
+
+Every event is one JSON object per line with three envelope fields —
+``v`` (schema version, pinned at 1), ``ts`` (unix seconds), ``event``
+(type name) — plus the per-type payload listed in ``EVENT_FIELDS``.
+An operator can ``tail -f`` a live run's journal (every line is flushed
+as it is written) or feed one or more finished/dead journals to
+``specpride stats`` for an aggregate post-mortem.
+
+Multi-host runs write one journal per rank (``<journal>.part<id>``, the
+same naming as output shards); ``expand_parts`` resolves a base path to
+its rank-ordered part list the way ``merge-parts`` does for outputs.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+SCHEMA_VERSION = 1
+
+# event type -> required payload fields (the envelope v/ts/event is implied;
+# extra fields are allowed — the schema is additive within a version)
+EVENT_FIELDS: dict[str, frozenset] = {
+    "run_start": frozenset({"command", "method", "backend", "n_clusters"}),
+    "chunk_start": frozenset({"chunk_index", "n_clusters"}),
+    "chunk_done": frozenset(
+        {"chunk_index", "n_clusters", "n_representatives", "elapsed_s",
+         "clusters_per_sec"}
+    ),
+    "compile": frozenset({"kernel", "shape_key"}),
+    "dispatch": frozenset({"kernel", "rows", "padded_rows"}),
+    "checkpoint_write": frozenset({"n_done", "output_bytes"}),
+    "resume": frozenset({"n_done"}),
+    "qc_failure": frozenset({"cluster_ids"}),
+    "skipped_clusters": frozenset({"cluster_ids"}),
+    "bench_run": frozenset({"method", "phases_s"}),
+    "run_end": frozenset({"counters", "phases_s", "elapsed_s", "device"}),
+}
+
+
+def _json_default(obj):
+    """Journals must never crash a run over a numpy scalar in a payload."""
+    for cast in (int, float):
+        try:
+            return cast(obj)
+        except (TypeError, ValueError):
+            continue
+    return str(obj)
+
+
+class Journal:
+    """Append-only JSONL event writer.  Line-buffered so each event hits
+    the filesystem as one complete line — tailable mid-run, and a crash
+    loses at most the event being written."""
+
+    enabled = True
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = str(path)
+        self._fh = open(self.path, "a", buffering=1, encoding="utf-8")
+        # a kill mid-write leaves a torn final line with no newline; a
+        # resumed run appending straight onto it would corrupt BOTH its
+        # own run_start and the torn event — heal the seam first
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() > 0:
+                    fh.seek(-1, os.SEEK_END)
+                    if fh.read(1) != b"\n":
+                        self._fh.write("\n")
+        except OSError:
+            pass
+
+    def emit(self, event: str, **fields) -> dict:
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(), "event": event}
+        rec.update(fields)
+        self._fh.write(json.dumps(rec, default=_json_default) + "\n")
+        return rec
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NullJournal:
+    """No-op stand-in so call sites never branch on '--journal given?'."""
+
+    enabled = False
+    path = None
+
+    def emit(self, event: str, **fields) -> dict:
+        return {}
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+def open_journal(path: str | None) -> Journal | NullJournal:
+    return Journal(path) if path else NullJournal()
+
+
+def validate_event(rec: object) -> list[str]:
+    """Schema-violation messages for one decoded journal line (empty list
+    when valid)."""
+    problems: list[str] = []
+    if not isinstance(rec, dict):
+        return [f"event is not an object: {rec!r}"]
+    if rec.get("v") != SCHEMA_VERSION:
+        problems.append(f"unsupported schema version {rec.get('v')!r}")
+    if not isinstance(rec.get("ts"), (int, float)):
+        problems.append("missing/non-numeric 'ts'")
+    event = rec.get("event")
+    required = EVENT_FIELDS.get(event)
+    if required is None:
+        problems.append(f"unknown event type {event!r}")
+    else:
+        missing = sorted(required - rec.keys())
+        if missing:
+            problems.append(f"{event}: missing fields {missing}")
+    return problems
+
+
+def read_events(path: str) -> tuple[list[dict], list[str]]:
+    """Decode one journal file.  Returns ``(events, violations)``;
+    violations carry ``path:line:`` prefixes so a multi-journal report
+    stays attributable."""
+    events: list[dict] = []
+    violations: list[str] = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                violations.append(f"{path}:{lineno}: invalid JSON ({e.msg})")
+                continue
+            problems = validate_event(rec)
+            for p in problems:
+                violations.append(f"{path}:{lineno}: {p}")
+            # only schema-valid events reach the summary: consumers may then
+            # index required fields without re-checking (an invalid line is
+            # still reported above and fails `specpride stats`)
+            if not problems:
+                events.append(rec)
+    return events, violations
+
+
+def expand_parts(path: str) -> tuple[list[str], list[str]]:
+    """Resolve a journal path to its file list, rank-aware like
+    ``merge-parts``: the path itself if it exists, else its
+    ``<path>.part<id>`` shards ordered by parsed rank (NOT lexically).
+    Returns ``(paths, warnings)``; a gap in the rank sequence is a
+    warning, not an error — a post-mortem of a dead run must still read
+    the ranks that DID write."""
+    if os.path.exists(path):
+        return [path], []
+    parts = glob.glob(glob.escape(path) + ".part*")
+    if not parts:
+        return [], [f"no journal at {path} and no {path}.part* shards"]
+    ranked, warnings = [], []
+    for p in parts:
+        suffix = p.rsplit(".part", 1)[1]
+        if suffix.isdigit():
+            ranked.append((int(suffix), p))
+        else:
+            warnings.append(f"unrecognized part name {p}")
+    ranked.sort()
+    ranks = [r for r, _ in ranked]
+    missing = sorted(set(range(max(ranks) + 1)) - set(ranks)) if ranks else []
+    if missing:
+        warnings.append(
+            f"{path}: rank gap — have {ranks}, missing {missing} "
+            "(a rank died before writing its journal?)"
+        )
+    return [p for _, p in ranked], warnings

@@ -1,0 +1,277 @@
+"""Metrics registry: named counters / gauges / histograms with labels.
+
+Two export views of one registry: the Prometheus textfile format
+(``--metrics-out FILE``, consumable by node_exporter's textfile collector)
+and a plain JSON dict (folded into the run journal's ``run_end`` event).
+Counters are cumulative over the registry's lifetime — Prometheus
+semantics — so re-exporting after more work is monotone, and rewriting
+the textfile is idempotent for an unchanged registry.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+# seconds buckets sized for dispatch/transfer latencies: sub-ms XLA calls
+# up to multi-second tunneled round trips
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    def __init__(self, kind: str, name: str, help: str, label_names: tuple):
+        self.kind = kind
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        # label-values tuple -> float (counter/gauge) or histogram state
+        self.samples: dict[tuple, object] = {}
+
+    def _key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, "
+                f"declared {sorted(self.label_names)}"
+            )
+        return tuple(str(labels[n]) for n in self.label_names)
+
+
+class Counter(_Metric):
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up (got {n})")
+        key = self._key(labels)
+        self.samples[key] = self.samples.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        return float(self.samples.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    def set(self, v: float, **labels) -> None:
+        self.samples[self._key(labels)] = float(v)
+
+    def value(self, **labels) -> float:
+        return float(self.samples.get(self._key(labels), 0.0))
+
+
+class _HistState:
+    __slots__ = ("counts", "total", "n")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
+        self.total = 0.0
+        self.n = 0
+
+
+class Histogram(_Metric):
+    def __init__(self, kind, name, help, label_names, buckets):
+        super().__init__(kind, name, help, label_names)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, v: float, **labels) -> None:
+        key = self._key(labels)
+        st = self.samples.get(key)
+        if st is None:
+            st = self.samples[key] = _HistState(len(self.buckets))
+        for i, le in enumerate(self.buckets):
+            if v <= le:
+                st.counts[i] += 1
+                break
+        st.total += v
+        st.n += 1
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+
+    def _register(self, cls, kind, name, help, labels, **kw) -> _Metric:
+        m = self._metrics.get(name)
+        if m is not None:
+            if m.kind != kind or m.label_names != tuple(labels):
+                raise ValueError(
+                    f"metric {name} re-registered as {kind}"
+                    f"{tuple(labels)} (was {m.kind}{m.label_names})"
+                )
+            return m
+        m = self._metrics[name] = cls(kind, name, help, tuple(labels), **kw)
+        return m
+
+    def counter(self, name: str, help: str = "", labels=()) -> Counter:
+        return self._register(Counter, "counter", name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels=()) -> Gauge:
+        return self._register(Gauge, "gauge", name, help, labels)
+
+    def histogram(
+        self, name: str, help: str = "", labels=(),
+        buckets=DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._register(
+            Histogram, "histogram", name, help, labels, buckets=buckets
+        )
+
+    # -- export views ---------------------------------------------------
+
+    def to_prometheus_text(self) -> str:
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if m.help:
+                lines.append(f"# HELP {name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            for key in sorted(m.samples):
+                labelstr = ",".join(
+                    f'{ln}="{_escape_label(lv)}"'
+                    for ln, lv in zip(m.label_names, key)
+                )
+                if isinstance(m, Histogram):
+                    st = m.samples[key]
+                    cum = 0
+                    for le, c in zip(m.buckets, st.counts):
+                        cum += c
+                        blabel = ",".join(
+                            filter(None, [labelstr, f'le="{_fmt(le)}"'])
+                        )
+                        lines.append(f"{name}_bucket{{{blabel}}} {cum}")
+                    blabel = ",".join(filter(None, [labelstr, 'le="+Inf"']))
+                    lines.append(f"{name}_bucket{{{blabel}}} {st.n}")
+                    base = f"{{{labelstr}}}" if labelstr else ""
+                    lines.append(f"{name}_sum{base} {_fmt(st.total)}")
+                    lines.append(f"{name}_count{base} {st.n}")
+                else:
+                    base = f"{{{labelstr}}}" if labelstr else ""
+                    lines.append(f"{name}{base} {_fmt(m.samples[key])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_textfile(self, path: str) -> None:
+        """Atomic rewrite (tmp + rename): a scraper never reads a torn
+        file, and re-export replaces — never appends to — the old view."""
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(self.to_prometheus_text())
+        os.replace(tmp, path)
+
+    def to_json(self) -> dict:
+        out: dict = {}
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if isinstance(m, Histogram):
+                out[name] = {
+                    "|".join(key) or "": {"sum": st.total, "count": st.n}
+                    for key, st in m.samples.items()
+                }
+            else:
+                out[name] = {
+                    "|".join(key) or "": v for key, v in m.samples.items()
+                }
+        return out
+
+    def sum_counter(self, name: str) -> float:
+        """Total over all label combinations (0.0 when never registered)."""
+        m = self._metrics.get(name)
+        if m is None or isinstance(m, Histogram):
+            return 0.0
+        return float(sum(m.samples.values()))
+
+
+# -- the device schema both backends share ------------------------------
+
+_DEVICE_KEYS = (
+    "compiles", "dispatches", "bytes_h2d", "bytes_d2h",
+    "pack_real_elements", "pack_padded_elements", "padding_waste_frac",
+    "rows_real", "rows_padded", "bucket_occupancy_frac",
+    "device_peak_bytes_in_use",
+)
+
+
+def device_summary(registry: MetricsRegistry | None) -> dict:
+    """Scalar device-telemetry dict with a FIXED key set, for the journal's
+    ``run_end.device`` field.  A numpy-backend run (no registry, or one the
+    device instrumentation never touched) reports the same keys as zeros,
+    so oracle-vs-device journals diff cleanly."""
+    out = {k: 0 for k in _DEVICE_KEYS}
+    if registry is None:
+        return out
+    out["compiles"] = int(registry.sum_counter("specpride_compiles_total"))
+    out["dispatches"] = int(
+        registry.sum_counter("specpride_dispatches_total")
+    )
+    out["bytes_h2d"] = int(registry.sum_counter("specpride_bytes_h2d_total"))
+    out["bytes_d2h"] = int(registry.sum_counter("specpride_bytes_d2h_total"))
+    real = registry.sum_counter("specpride_pack_real_elements_total")
+    padded = registry.sum_counter("specpride_pack_padded_elements_total")
+    out["pack_real_elements"] = int(real)
+    out["pack_padded_elements"] = int(padded)
+    out["padding_waste_frac"] = (
+        round(1.0 - real / padded, 4) if padded > 0 else 0.0
+    )
+    rows_r = registry.sum_counter("specpride_rows_real_total")
+    rows_p = registry.sum_counter("specpride_rows_padded_total")
+    out["rows_real"] = int(rows_r)
+    out["rows_padded"] = int(rows_p)
+    out["bucket_occupancy_frac"] = (
+        round(rows_r / rows_p, 4) if rows_p > 0 else 0.0
+    )
+    # read-only probe: must not register the gauge as a side effect (an
+    # empty metric would clutter the textfile with a sample-less TYPE line)
+    peak = registry._metrics.get("specpride_device_peak_bytes_in_use")
+    out["device_peak_bytes_in_use"] = int(
+        max(peak.samples.values()) if peak and peak.samples else 0
+    )
+    return out
+
+
+def export_run_metrics(
+    registry: MetricsRegistry, stats, device: dict
+) -> None:
+    """Fold one run's RunStats + device summary into ``registry`` so the
+    textfile export carries the full picture.  Counters inc (cumulative
+    across runs sharing a registry); phase seconds and summary fractions
+    are gauges (point-in-time views of the latest run)."""
+    for name, n in stats.counters.items():
+        registry.counter(
+            f"specpride_run_{name}_total",
+            f"run counter '{name}' accumulated across runs",
+        ).inc(n)
+    for phase, secs in stats.phases.items():
+        registry.counter(
+            "specpride_phase_seconds_total",
+            "per-phase wall seconds accumulated across runs",
+            labels=("phase",),
+        ).inc(secs, phase=phase)
+    registry.gauge(
+        "specpride_padding_waste_frac",
+        "fraction of packed device elements that were padding (last run)",
+    ).set(device["padding_waste_frac"])
+    registry.gauge(
+        "specpride_bucket_occupancy_frac",
+        "real rows / padded rows across device dispatches (last run)",
+    ).set(device["bucket_occupancy_frac"])
+    registry.gauge(
+        "specpride_run_elapsed_seconds", "wall time of the last run"
+    ).set(stats.elapsed)

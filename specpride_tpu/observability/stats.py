@@ -1,0 +1,116 @@
+"""Run counters, phase timers, structured logging, profiler hook.
+
+Core of the observability subsystem (moved from ``utils/observe.py``,
+which remains as a compatibility shim): a structured logger with named
+counters (clusters, spectra, peaks, skipped — the categories the
+reference prints ad hoc), phase timers covering the pipeline stages
+(parse / pack / compute / dispatch / d2h / finalize / write), and an
+optional ``jax.profiler`` trace hook for device-level profiling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import sys
+import time
+from collections import defaultdict
+
+logger = logging.getLogger("specpride_tpu")
+
+
+def configure_logging(verbose: int = 0, structured: bool = False) -> None:
+    level = logging.WARNING
+    if verbose == 1:
+        level = logging.INFO
+    elif verbose >= 2:
+        level = logging.DEBUG
+    handler = logging.StreamHandler(sys.stderr)
+    if structured:
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+    logging.basicConfig(level=level, handlers=[handler], force=True)
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "ts": record.created,
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        extra = getattr(record, "fields", None)
+        if extra:
+            payload.update(extra)
+        return json.dumps(payload)
+
+
+class RunStats:
+    """Counters + phase timers for one pipeline run."""
+
+    def __init__(self) -> None:
+        self.counters: dict[str, int] = defaultdict(int)
+        self.phases: dict[str, float] = defaultdict(float)
+        self._start = time.perf_counter()
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] += time.perf_counter() - t0
+
+    @property
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._start
+
+    def work_seconds(self) -> float:
+        """Summed compute + write phase time — the work actually done this
+        run, excluding parse/setup and clusters skipped by a resume."""
+        return self.phases.get("compute", 0.0) + self.phases.get("write", 0.0)
+
+    def throughput(self, counter: str = "clusters") -> float:
+        """Clusters/sec over the work phases (compute + write).
+
+        Wall time since construction is the wrong denominator: a resumed
+        run spends its wall clock on parse + resume-skip filtering and
+        would underreport the rate of the clusters it actually computed.
+        Falls back to wall time only when no work phase was ever timed."""
+        dt = self.work_seconds()
+        if dt <= 0.0:
+            dt = self.elapsed
+        return self.counters[counter] / dt if dt > 0 else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "elapsed_s": round(self.elapsed, 3),
+            "counters": dict(self.counters),
+            "phases_s": {k: round(v, 3) for k, v in self.phases.items()},
+        }
+
+    def log_summary(self) -> None:
+        logger.info("run summary", extra={"fields": self.summary()})
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str | None):
+    """``jax.profiler`` trace hook: active only when a directory is given."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

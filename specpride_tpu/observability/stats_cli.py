@@ -1,0 +1,194 @@
+"""``specpride stats``: read one or more run journals and render a human
+summary plus a machine-readable aggregate.
+
+Accepts base journal paths (multi-host ``.part<id>`` shards resolve
+rank-aware like ``merge-parts``) or explicit files.  Exits non-zero on
+schema violations — CI runs this over a pipeline invocation's journal,
+so a silently drifting event schema fails the build instead of rotting.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from specpride_tpu.observability.journal import expand_parts, read_events
+
+
+def _split_runs(events: list[dict]) -> list[list[dict]]:
+    """Split one journal's events into per-run segments at ``run_start``
+    boundaries.  Journals open in append mode, so a crashed run resumed
+    with the same ``--journal`` path holds several runs back to back —
+    summarizing them as one would pair run 1's heartbeats with run 2's
+    ``run_end``."""
+    segments: list[list[dict]] = []
+    for e in events:
+        if e["event"] == "run_start" or not segments:
+            segments.append([])
+        segments[-1].append(e)
+    return segments
+
+
+def _summarize_run(path: str, events: list[dict]) -> dict:
+    start = next((e for e in events if e["event"] == "run_start"), None)
+    end = next(
+        (e for e in reversed(events) if e["event"] == "run_end"), None
+    )
+    chunks = [e for e in events if e["event"] == "chunk_done"]
+    compiles = sum(1 for e in events if e["event"] == "compile")
+    dispatches = sum(1 for e in events if e["event"] == "dispatch")
+    resumes = sum(1 for e in events if e["event"] == "resume")
+    skipped = sum(
+        len(e.get("cluster_ids", ()))
+        for e in events
+        if e["event"] == "skipped_clusters"
+    )
+    run: dict = {
+        "journal": path,
+        "n_events": len(events),
+        "complete": end is not None,
+        "resumes": resumes,
+        "chunks": len(chunks),
+        "skipped_clusters": skipped,
+    }
+    if start:
+        run.update(
+            command=start.get("command"),
+            method=start.get("method"),
+            backend=start.get("backend"),
+            n_clusters=start.get("n_clusters"),
+        )
+    if chunks:
+        rates = [c["clusters_per_sec"] for c in chunks]
+        run["mean_chunk_clusters_per_sec"] = round(
+            sum(rates) / len(rates), 2
+        )
+    if end:
+        device = end.get("device", {})
+        run.update(
+            counters=end.get("counters", {}),
+            phases_s=end.get("phases_s", {}),
+            elapsed_s=end.get("elapsed_s"),
+            representatives_written=end.get("representatives_written"),
+            compile_count=max(compiles, device.get("compiles", 0)),
+            dispatch_count=max(dispatches, device.get("dispatches", 0)),
+            padding_waste_frac=device.get("padding_waste_frac", 0.0),
+            bucket_occupancy_frac=device.get("bucket_occupancy_frac", 0.0),
+            bytes_h2d=device.get("bytes_h2d", 0),
+            bytes_d2h=device.get("bytes_d2h", 0),
+            device_peak_bytes_in_use=device.get(
+                "device_peak_bytes_in_use", 0
+            ),
+        )
+    else:
+        # dead run: the heartbeats are all we have — surface the last one
+        run["compile_count"] = compiles
+        run["dispatch_count"] = dispatches
+        if chunks:
+            run["last_chunk"] = chunks[-1]
+    return run
+
+
+def _render_run(run: dict, out) -> None:
+    head = (
+        f"{run['journal']}: {run.get('command', '?')}"
+        f"/{run.get('method', '?')} backend={run.get('backend', '?')}"
+    )
+    print(head, file=out)
+    if not run["complete"]:
+        print(
+            "  INCOMPLETE — no run_end event (crashed or still running); "
+            f"{run['chunks']} chunk(s) journaled", file=out,
+        )
+        if "last_chunk" in run:
+            lc = run["last_chunk"]
+            print(
+                f"  last heartbeat: chunk {lc['chunk_index']} "
+                f"({lc['n_clusters']} clusters, "
+                f"{lc['clusters_per_sec']:.1f} cl/s)", file=out,
+            )
+        return
+    counters = run.get("counters", {})
+    print(
+        f"  clusters={counters.get('clusters', 0)} "
+        f"representatives={run.get('representatives_written') or 0} "
+        f"elapsed={run.get('elapsed_s', 0):.3f}s "
+        f"chunks={run['chunks']} resumes={run['resumes']} "
+        f"skipped={run['skipped_clusters']}", file=out,
+    )
+    phases = run.get("phases_s", {})
+    if phases:
+        print(
+            "  phases: "
+            + " ".join(f"{k}={v:.3f}s" for k, v in sorted(phases.items())),
+            file=out,
+        )
+    print(
+        f"  device: compile_count={run['compile_count']} "
+        f"dispatches={run['dispatch_count']} "
+        f"padding_waste_frac={run['padding_waste_frac']} "
+        f"bucket_occupancy_frac={run['bucket_occupancy_frac']} "
+        f"h2d={run['bytes_h2d']}B d2h={run['bytes_d2h']}B "
+        f"peak_device_mem={run['device_peak_bytes_in_use']}B", file=out,
+    )
+
+
+def run_stats(
+    journal_paths: list[str], json_out: str | None = None, out=None
+) -> int:
+    out = out or sys.stdout
+    files: list[str] = []
+    warnings: list[str] = []
+    for p in journal_paths:
+        got, warn = expand_parts(p)
+        files.extend(got)
+        warnings.extend(warn)
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    if not files:
+        print("no journal files to read", file=sys.stderr)
+        return 1
+
+    runs: list[dict] = []
+    violations: list[str] = []
+    for path in files:
+        events, bad = read_events(path)
+        violations.extend(bad)
+        segments = _split_runs(events) or [[]]
+        for i, seg in enumerate(segments):
+            label = path if len(segments) == 1 else f"{path}#run{i}"
+            runs.append(_summarize_run(label, seg))
+
+    for run in runs:
+        _render_run(run, out)
+    totals = {
+        "n_journals": len(files),
+        "n_runs_complete": sum(r["complete"] for r in runs),
+        "clusters": sum(
+            r.get("counters", {}).get("clusters", 0) for r in runs
+        ),
+        "representatives_written": sum(
+            r.get("representatives_written") or 0 for r in runs
+        ),
+        "skipped_clusters": sum(r["skipped_clusters"] for r in runs),
+        "compile_count": sum(r.get("compile_count", 0) for r in runs),
+    }
+    if len(runs) > 1:
+        print(
+            f"TOTAL: {totals['n_journals']} journals, "
+            f"{totals['clusters']} clusters, "
+            f"{totals['representatives_written']} representatives, "
+            f"{totals['compile_count']} compiles", file=out,
+        )
+    if json_out:
+        with open(json_out, "w", encoding="utf-8") as fh:
+            json.dump({"v": 1, "runs": runs, "totals": totals}, fh, indent=1)
+            fh.write("\n")
+    if violations:
+        for v in violations:
+            print(f"schema violation: {v}", file=sys.stderr)
+        print(
+            f"{len(violations)} schema violation(s)", file=sys.stderr
+        )
+        return 1
+    return 0

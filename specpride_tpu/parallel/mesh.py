@@ -41,8 +41,16 @@ def initialize_distributed(
     is only legal *before* backend init — probing through them would make
     multi-host bring-up self-defeating.  ``jax.distributed.is_initialized``
     reads coordination-service state without spinning up a backend."""
-    if jax.distributed.is_initialized():
-        return
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if is_init():
+            return
+    else:
+        # older jax: no public probe — the global client object is the
+        # coordination-service state (still no backend init involved)
+        state = getattr(jax.distributed, "global_state", None)
+        if state is not None and getattr(state, "client", None) is not None:
+            return
     if coordinator_address is None:
         return  # single-process
     jax.distributed.initialize(

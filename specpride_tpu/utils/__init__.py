@@ -1,4 +1,9 @@
-"""Utilities: observability (logging, counters, timers, profiler hooks)."""
-from specpride_tpu.utils.observe import RunStats, configure_logging, device_trace
+"""Utilities.  Observability moved to ``specpride_tpu.observability``;
+these re-exports remain for compatibility."""
+from specpride_tpu.observability import (
+    RunStats,
+    configure_logging,
+    device_trace,
+)
 
 __all__ = ["RunStats", "configure_logging", "device_trace"]

@@ -1,105 +1,14 @@
-"""Observability: structured logging, counters, phase timers (survey §5).
+"""Compatibility shim: the observability subsystem moved to
+``specpride_tpu.observability`` (run journal, metrics registry, stats CLI).
+Import from there; this module re-exports the original names so existing
+imports keep working."""
 
-The reference's only instrumentation is print/eprint and a wall-clock
-spectra/sec line (ref src/binning.py:2-3,115-118).  Here: a structured
-logger with named counters (clusters, spectra, peaks, skipped — the
-categories the reference prints ad hoc), phase timers covering the pipeline
-stages (parse / quantize / device / write), and an optional
-``jax.profiler`` trace hook for device-level profiling.
-"""
+from specpride_tpu.observability.stats import (  # noqa: F401
+    RunStats,
+    _JsonFormatter,
+    configure_logging,
+    device_trace,
+    logger,
+)
 
-from __future__ import annotations
-
-import contextlib
-import json
-import logging
-import sys
-import time
-from collections import defaultdict
-
-logger = logging.getLogger("specpride_tpu")
-
-
-def configure_logging(verbose: int = 0, structured: bool = False) -> None:
-    level = logging.WARNING
-    if verbose == 1:
-        level = logging.INFO
-    elif verbose >= 2:
-        level = logging.DEBUG
-    handler = logging.StreamHandler(sys.stderr)
-    if structured:
-        handler.setFormatter(_JsonFormatter())
-    else:
-        handler.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
-        )
-    logging.basicConfig(level=level, handlers=[handler], force=True)
-
-
-class _JsonFormatter(logging.Formatter):
-    def format(self, record: logging.LogRecord) -> str:
-        payload = {
-            "ts": record.created,
-            "level": record.levelname,
-            "logger": record.name,
-            "msg": record.getMessage(),
-        }
-        extra = getattr(record, "fields", None)
-        if extra:
-            payload.update(extra)
-        return json.dumps(payload)
-
-
-class RunStats:
-    """Counters + phase timers for one pipeline run."""
-
-    def __init__(self) -> None:
-        self.counters: dict[str, int] = defaultdict(int)
-        self.phases: dict[str, float] = defaultdict(float)
-        self._start = time.perf_counter()
-
-    def count(self, name: str, n: int = 1) -> None:
-        self.counters[name] += n
-
-    @contextlib.contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.phases[name] += time.perf_counter() - t0
-
-    @property
-    def elapsed(self) -> float:
-        return time.perf_counter() - self._start
-
-    def throughput(self, counter: str = "clusters") -> float:
-        """The reference's spectra-per-second line (ref src/binning.py:118),
-        generalized."""
-        dt = self.elapsed
-        return self.counters[counter] / dt if dt > 0 else 0.0
-
-    def summary(self) -> dict:
-        return {
-            "elapsed_s": round(self.elapsed, 3),
-            "counters": dict(self.counters),
-            "phases_s": {k: round(v, 3) for k, v in self.phases.items()},
-        }
-
-    def log_summary(self) -> None:
-        logger.info("run summary", extra={"fields": self.summary()})
-
-
-@contextlib.contextmanager
-def device_trace(trace_dir: str | None):
-    """``jax.profiler`` trace hook: active only when a directory is given."""
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    jax.profiler.start_trace(trace_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+__all__ = ["RunStats", "configure_logging", "device_trace", "logger"]

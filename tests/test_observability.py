@@ -1,0 +1,512 @@
+"""Observability subsystem: run journal, metrics registry / Prometheus
+exporter, backend instrumentation, and the `specpride stats` command."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.io.mgf import read_mgf, write_mgf
+from specpride_tpu.observability import (
+    EVENT_FIELDS,
+    SCHEMA_VERSION,
+    Journal,
+    MetricsRegistry,
+    NullJournal,
+    RunStats,
+    device_summary,
+    expand_parts,
+    open_journal,
+    read_events,
+    validate_event,
+)
+from specpride_tpu.observability.stats_cli import run_stats
+
+from conftest import make_cluster
+
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "data", "golden_clustered.mgf"
+)
+
+
+# ---------------------------------------------------------------------------
+# RunStats
+# ---------------------------------------------------------------------------
+
+class TestRunStats:
+    def test_throughput_uses_work_phases_not_wall_time(self):
+        """A resumed run spends wall time on parse/skip; the rate must be
+        clusters over compute+write, not clusters over elapsed."""
+        stats = RunStats()
+        stats.count("clusters", 100)
+        # simulate 0.2 s of work inside a much longer wall clock
+        stats.phases["compute"] = 0.15
+        stats.phases["write"] = 0.05
+        stats._start -= 100.0  # pretend the run has been up 100 s
+        assert stats.throughput("clusters") == pytest.approx(500.0)
+
+    def test_throughput_falls_back_to_wall_time(self):
+        stats = RunStats()
+        stats.count("clusters", 10)
+        assert stats.throughput("clusters") > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Journal
+# ---------------------------------------------------------------------------
+
+class TestJournal:
+    def test_events_are_versioned_lines(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        with Journal(path) as j:
+            j.emit("run_start", command="consensus", method="bin-mean",
+                   backend="tpu", n_clusters=4)
+            j.emit("chunk_start", chunk_index=0, n_clusters=4)
+        events, violations = read_events(str(path))
+        assert violations == []
+        assert [e["event"] for e in events] == ["run_start", "chunk_start"]
+        assert all(e["v"] == SCHEMA_VERSION for e in events)
+        assert all(isinstance(e["ts"], float) for e in events)
+
+    def test_numpy_scalars_serialize(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        with Journal(path) as j:
+            j.emit("chunk_start", chunk_index=np.int64(1),
+                   n_clusters=np.int32(7))
+        events, violations = read_events(str(path))
+        assert violations == []
+        assert events[0]["n_clusters"] == 7
+
+    def test_validate_rejects_unknown_and_missing(self):
+        assert validate_event({"v": 1, "ts": 0.0, "event": "nope"})
+        assert validate_event(
+            {"v": 1, "ts": 0.0, "event": "chunk_start"}
+        )  # missing required fields
+        assert validate_event({"v": 2, "ts": 0.0, "event": "resume",
+                               "n_done": 1})
+        assert validate_event(
+            {"v": 1, "ts": 0.0, "event": "resume", "n_done": 3}
+        ) == []
+
+    def test_null_journal_is_inert(self):
+        j = NullJournal()
+        assert j.emit("anything", x=1) == {}
+        j.close()
+        assert open_journal(None).enabled is False
+
+    def test_reopen_heals_torn_final_line(self, tmp_path):
+        """A kill mid-write leaves a partial line with no newline; the
+        resumed run's first event must start on a fresh line, not fuse
+        with the torn fragment."""
+        path = tmp_path / "torn.jsonl"
+        with Journal(path) as j:
+            j.emit("chunk_start", chunk_index=0, n_clusters=4)
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"v": 1, "ts": 99.9, "event": "chunk_do')  # torn
+        with Journal(path) as j:
+            j.emit("resume", n_done=4)
+        events, violations = read_events(str(path))
+        assert [e["event"] for e in events] == ["chunk_start", "resume"]
+        assert len(violations) == 1  # only the torn line itself
+
+    def test_expand_parts_rank_order_and_gap(self, tmp_path):
+        base = tmp_path / "j.jsonl"
+        for rank in (0, 2, 10):
+            (tmp_path / f"j.jsonl.part{rank:05d}").write_text("")
+        paths, warnings = expand_parts(str(base))
+        assert [p.rsplit(".part", 1)[1] for p in paths] == [
+            "00000", "00002", "00010"
+        ]
+        assert any("missing" in w for w in warnings)
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry + Prometheus exporter
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total", "help", labels=("k",))
+        c.inc(2, k="a")
+        c.inc(3, k="a")
+        c.inc(1, k="b")
+        assert c.value(k="a") == 5
+        assert reg.sum_counter("t_total") == 6
+        g = reg.gauge("g")
+        g.set(1.5)
+        assert g.value() == 1.5
+        h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(99.0)
+        text = reg.to_prometheus_text()
+        assert 'h_seconds_bucket{le="0.1"} 1' in text
+        assert 'h_seconds_bucket{le="1"} 2' in text
+        assert 'h_seconds_bucket{le="+Inf"} 3' in text
+        assert "h_seconds_count 3" in text
+
+    def test_counters_refuse_negative_and_kind_conflicts(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("c_total").inc(-1)
+        reg.counter("x", labels=("a",))
+        with pytest.raises(ValueError):
+            reg.gauge("x", labels=("a",))
+        with pytest.raises(ValueError):
+            reg.counter("x", labels=("b",))
+
+    def test_prometheus_format_help_type_and_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter(
+            'esc_total', 'help with \\ and\nnewline', labels=("lab",)
+        ).inc(1, lab='va"l\\ue\nx')
+        text = reg.to_prometheus_text()
+        assert "# HELP esc_total help with \\\\ and\\nnewline\n" in text
+        assert "# TYPE esc_total counter\n" in text
+        assert 'esc_total{lab="va\\"l\\\\ue\\nx"} 1' in text
+        assert text.endswith("\n")
+
+    def test_textfile_rewrite_is_idempotent(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("a_total").inc(4)
+        reg.gauge("b").set(2.0)
+        out = tmp_path / "m.prom"
+        reg.write_textfile(str(out))
+        first = out.read_text()
+        reg.write_textfile(str(out))
+        assert out.read_text() == first  # replaced, never appended
+        assert not os.path.exists(str(out) + ".tmp")
+
+    def test_device_summary_fixed_schema(self):
+        empty = device_summary(None)
+        reg = MetricsRegistry()
+        reg.counter("specpride_compiles_total", labels=("kernel",)).inc(
+            2, kernel="k"
+        )
+        reg.counter("specpride_pack_real_elements_total",
+                    labels=("kernel",)).inc(30, kernel="k")
+        reg.counter("specpride_pack_padded_elements_total",
+                    labels=("kernel",)).inc(40, kernel="k")
+        full = device_summary(reg)
+        assert set(full) == set(empty)  # numpy and device diff cleanly
+        assert full["compiles"] == 2
+        assert full["padding_waste_frac"] == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# Backend instrumentation
+# ---------------------------------------------------------------------------
+
+class TestBackendInstrumentation:
+    def test_device_dispatch_metrics_and_journal(self, tmp_path, rng):
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+
+        clusters = [
+            make_cluster(rng, f"c{i}", n_members=3, n_peaks=40)
+            for i in range(4)
+        ]
+        jpath = tmp_path / "j.jsonl"
+        backend = TpuBackend(layout="flat", journal=Journal(jpath))
+        reps = backend.run_bin_mean(clusters)
+        backend.journal.close()
+        assert len(reps) == 4
+        summary = device_summary(backend.metrics)
+        assert summary["compiles"] >= 1
+        assert summary["dispatches"] >= 1
+        assert summary["bytes_h2d"] > 0
+        assert summary["bytes_d2h"] > 0
+        assert 0.0 <= summary["padding_waste_frac"] < 1.0
+        events, violations = read_events(str(jpath))
+        assert violations == []
+        kinds = {e["event"] for e in events}
+        assert {"compile", "dispatch"} <= kinds
+
+    def test_pack_accounting_lazy_without_consumer(self, rng):
+        """Bare library use (no journal, accounting off) must skip the
+        O(rows*k) real-element reductions; attaching a journal turns
+        them on."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+
+        clusters = [
+            make_cluster(rng, f"c{i}", n_members=3, n_peaks=40)
+            for i in range(4)
+        ]
+        bare = TpuBackend(layout="bucketized")
+        bare.run_bin_mean(clusters)
+        assert device_summary(bare.metrics)["pack_real_elements"] == 0
+
+        accounted = TpuBackend(layout="bucketized", pack_accounting=True)
+        accounted.run_bin_mean(clusters)
+        assert device_summary(accounted.metrics)["pack_real_elements"] > 0
+
+    def test_second_run_reuses_compiled_shapes(self, rng):
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+
+        clusters = [
+            make_cluster(rng, f"c{i}", n_members=3, n_peaks=40)
+            for i in range(4)
+        ]
+        backend = TpuBackend(layout="flat")
+        backend.run_bin_mean(clusters)
+        compiles_1 = device_summary(backend.metrics)["compiles"]
+        backend.run_bin_mean(clusters)
+        after = device_summary(backend.metrics)
+        assert after["compiles"] == compiles_1  # same shapes: no new trace
+        assert after["dispatches"] > compiles_1
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: --journal / --metrics-out / stats
+# ---------------------------------------------------------------------------
+
+class TestCliJournal:
+    def run_consensus(self, tmp_path, *extra):
+        out = tmp_path / "reps.mgf"
+        jpath = tmp_path / "run.jsonl"
+        rc = cli_main([
+            "consensus", GOLDEN, str(out), "--method", "bin-mean",
+            "--backend", "tpu", "--journal", str(jpath), *extra,
+        ])
+        assert rc == 0
+        return out, jpath
+
+    def test_journal_matches_output(self, tmp_path):
+        out, jpath = self.run_consensus(tmp_path)
+        events, violations = read_events(str(jpath))
+        assert violations == []
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "run_start"
+        assert kinds[-1] == "run_end"
+        assert "chunk_start" in kinds and "chunk_done" in kinds
+        end = events[-1]
+        n_written = len(read_mgf(str(out)))
+        assert end["counters"]["representatives"] == n_written
+        assert end["representatives_written"] == n_written
+        # the device dict is schema-stable across backends
+        assert set(end["device"]) == set(device_summary(None))
+
+    def test_resume_event_journaled(self, tmp_path):
+        ck = tmp_path / "ck.json"
+        out, jpath = self.run_consensus(tmp_path, "--checkpoint", str(ck))
+        # second run with the same manifest resumes (everything done)
+        jpath2 = tmp_path / "resume.jsonl"
+        rc = cli_main([
+            "consensus", GOLDEN, str(out), "--method", "bin-mean",
+            "--backend", "tpu", "--checkpoint", str(ck),
+            "--journal", str(jpath2),
+        ])
+        assert rc == 0
+        events, violations = read_events(str(jpath2))
+        assert violations == []
+        resumes = [e for e in events if e["event"] == "resume"]
+        assert len(resumes) == 1
+        assert resumes[0]["n_done"] == len(read_mgf(str(out)))
+
+    def test_interrupted_run_then_resume(self, tmp_path):
+        """A journal from a killed run has heartbeats but no run_end; the
+        resumed run journals `resume` and completes."""
+        clustered = read_mgf(GOLDEN)
+        out = tmp_path / "reps.mgf"
+        ck = tmp_path / "ck.json"
+        j1 = tmp_path / "dead.jsonl"
+        # simulate the kill: run only the first cluster, checkpoint it
+        ids = sorted({s.cluster_id for s in clustered})
+        first = [s for s in clustered if s.cluster_id == ids[0]]
+        partial_src = tmp_path / "first.mgf"
+        write_mgf(first, str(partial_src))
+        rc = cli_main([
+            "consensus", str(partial_src), str(out), "--method", "bin-mean",
+            "--backend", "tpu", "--checkpoint", str(ck),
+            "--journal", str(j1),
+        ])
+        assert rc == 0
+        dead_events, _ = read_events(str(j1))
+        assert any(e["event"] == "chunk_done" for e in dead_events)
+        # resume over the FULL input with the same manifest
+        j2 = tmp_path / "resumed.jsonl"
+        rc = cli_main([
+            "consensus", GOLDEN, str(out), "--method", "bin-mean",
+            "--backend", "tpu", "--checkpoint", str(ck),
+            "--journal", str(j2),
+        ])
+        assert rc == 0
+        events, violations = read_events(str(j2))
+        assert violations == []
+        assert any(e["event"] == "resume" for e in events)
+        assert len(read_mgf(str(out))) == len(ids)
+
+    def test_metrics_out_prometheus(self, tmp_path):
+        mpath = tmp_path / "m.prom"
+        self.run_consensus(tmp_path, "--metrics-out", str(mpath))
+        text = mpath.read_text()
+        assert "# TYPE specpride_run_representatives_total counter" in text
+        assert "# TYPE specpride_padding_waste_frac gauge" in text
+        assert "specpride_phase_seconds_total{phase=" in text
+
+    def test_numpy_backend_same_schema(self, tmp_path):
+        out = tmp_path / "reps.mgf"
+        jpath = tmp_path / "np.jsonl"
+        rc = cli_main([
+            "consensus", GOLDEN, str(out), "--method", "bin-mean",
+            "--backend", "numpy", "--journal", str(jpath),
+        ])
+        assert rc == 0
+        events, violations = read_events(str(jpath))
+        assert violations == []
+        end = next(e for e in events if e["event"] == "run_end")
+        assert set(end["device"]) == set(device_summary(None))
+
+    def test_skipped_clusters_full_list_journaled(self, tmp_path, rng):
+        """--on-error skip must journal EVERY skipped id (the log line
+        truncates at 5)."""
+        good = make_cluster(rng, "good", n_members=3, charge=2)
+        bad = []
+        for i in range(7):
+            c = make_cluster(rng, f"bad{i}", n_members=2, charge=2)
+            c.members[1].precursor_charge = 3  # mixed charge: bin-mean raises
+            bad.append(c)
+        src = tmp_path / "mixed.mgf"
+        write_mgf([s for c in [good, *bad] for s in c.members], str(src))
+        jpath = tmp_path / "skip.jsonl"
+        rc = cli_main([
+            "consensus", str(src), str(tmp_path / "o.mgf"),
+            "--method", "bin-mean", "--backend", "numpy",
+            "--on-error", "skip", "--journal", str(jpath),
+        ])
+        assert rc == 0
+        events, violations = read_events(str(jpath))
+        assert violations == []
+        skipped = next(
+            e for e in events if e["event"] == "skipped_clusters"
+        )
+        assert sorted(skipped["cluster_ids"]) == sorted(
+            c.cluster_id for c in bad
+        )
+
+    def test_stats_command(self, tmp_path, capsys):
+        out, jpath = self.run_consensus(tmp_path)
+        agg = tmp_path / "agg.json"
+        rc = cli_main(["stats", str(jpath), "--json", str(agg)])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "padding_waste_frac" in text
+        assert "compile_count" in text
+        data = json.loads(agg.read_text())
+        assert data["v"] == 1
+        run = data["runs"][0]
+        assert run["complete"] is True
+        assert run["representatives_written"] == len(read_mgf(str(out)))
+
+    def test_stats_fails_on_schema_violation(self, tmp_path, capsys):
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(
+            '{"v": 1, "ts": 1.0, "event": "made_up_event"}\n'
+            "not json at all\n"
+        )
+        rc = run_stats([str(bad)])
+        assert rc == 1
+
+    def test_stats_survives_corrupt_lines(self, tmp_path, capsys):
+        """Post-mortem inputs are exactly the corrupt ones: a record with
+        no 'event', a truncated chunk_done — stats must report violations
+        and exit 1, never traceback."""
+        bad = tmp_path / "corrupt.jsonl"
+        bad.write_text(
+            '{"v": 1, "ts": 1}\n'
+            '{"v": 1, "ts": 2.0, "event": "chunk_done", "chunk_index": 0}\n'
+            '{"v": 1, "ts": 3.0, "event": "resume", "n_done": 2}\n'
+        )
+        rc = run_stats([str(bad)])
+        assert rc == 1
+        out = capsys.readouterr()
+        assert "schema violation" in out.err
+        # the valid resume event still made it into the summary
+        assert "resumes=" in out.out or "INCOMPLETE" in out.out
+
+    def test_stats_splits_appended_runs(self, tmp_path, capsys):
+        """A crashed run resumed with the same --journal path appends a
+        second run to the file; each must be summarized separately, not
+        run 1's heartbeats paired with run 2's run_end."""
+        j = tmp_path / "two.jsonl"
+        with Journal(j) as jj:
+            jj.emit("run_start", command="consensus", method="bin-mean",
+                    backend="tpu", n_clusters=30)
+            jj.emit("chunk_done", chunk_index=0, n_clusters=30,
+                    n_representatives=30, elapsed_s=1.0,
+                    clusters_per_sec=30.0)
+            # crash: no run_end — then the resumed run appends
+            jj.emit("run_start", command="consensus", method="bin-mean",
+                    backend="tpu", n_clusters=10)
+            jj.emit("resume", n_done=30)
+            jj.emit("run_end", counters={"clusters": 10,
+                                         "representatives": 10},
+                    phases_s={}, elapsed_s=1.0,
+                    representatives_written=10,
+                    device=device_summary(None))
+        agg = tmp_path / "agg.json"
+        assert run_stats([str(j)], json_out=str(agg)) == 0
+        data = json.loads(agg.read_text())
+        assert len(data["runs"]) == 2
+        assert data["runs"][0]["complete"] is False
+        assert data["runs"][0]["chunks"] == 1
+        assert data["runs"][1]["complete"] is True
+        assert data["runs"][1]["chunks"] == 0
+        assert data["runs"][1]["resumes"] == 1
+
+    def test_stats_merges_rank_parts(self, tmp_path):
+        base = tmp_path / "multi.jsonl"
+        for rank in range(2):
+            with Journal(f"{base}.part{rank:05d}") as j:
+                j.emit("run_start", command="consensus", method="bin-mean",
+                       backend="tpu", n_clusters=2)
+                j.emit("run_end", counters={"clusters": 2,
+                                            "representatives": 2},
+                       phases_s={}, elapsed_s=1.0,
+                       representatives_written=2,
+                       device=device_summary(None))
+        agg = tmp_path / "agg.json"
+        rc = run_stats([str(base)], json_out=str(agg))
+        assert rc == 0
+        data = json.loads(agg.read_text())
+        assert data["totals"]["n_journals"] == 2
+        assert data["totals"]["representatives_written"] == 4
+
+    def test_incomplete_journal_reported(self, tmp_path, capsys):
+        dead = tmp_path / "dead.jsonl"
+        with Journal(dead) as j:
+            j.emit("run_start", command="consensus", method="bin-mean",
+                   backend="tpu", n_clusters=10)
+            j.emit("chunk_done", chunk_index=0, n_clusters=5,
+                   n_representatives=5, elapsed_s=0.5,
+                   clusters_per_sec=10.0)
+        rc = run_stats([str(dead)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "INCOMPLETE" in out
+        assert "chunk 0" in out
+
+
+# ---------------------------------------------------------------------------
+# Event spec hygiene
+# ---------------------------------------------------------------------------
+
+def test_event_spec_covers_all_emitters():
+    """Every event name the codebase emits must be in EVENT_FIELDS (the
+    docs page and validator both key off it)."""
+    import re
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        ["grep", "-rhoE", r'emit\(\s*"[a-z_]+"', "--include=*.py",
+         os.path.join(root, "specpride_tpu"), os.path.join(root, "bench.py")],
+        capture_output=True, text=True,
+    ).stdout
+    emitted = set(re.findall(r'"([a-z_]+)"', out))
+    assert emitted <= set(EVENT_FIELDS), (
+        f"events emitted but not in EVENT_FIELDS: "
+        f"{emitted - set(EVENT_FIELDS)}"
+    )
