@@ -402,6 +402,11 @@ def main() -> None:
         help="stream per-run phase telemetry as JSONL bench_run events "
         "(default with --report: <report>.journal.jsonl)",
     )
+    ap.add_argument(
+        "--trace-dir", metavar="DIR", default=None,
+        help="capture a jax.profiler device trace of the bench compute "
+        "into this directory (view with TensorBoard / Perfetto)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -419,12 +424,22 @@ def main() -> None:
         f"built in {time.perf_counter() - t0:.1f}s"
     )
 
-    from specpride_tpu.observability import device_summary, open_journal
+    from specpride_tpu.observability import (
+        Tracer,
+        device_summary,
+        device_trace,
+        open_journal,
+    )
+    from specpride_tpu.observability import tracing
 
     journal_path = args.journal or (
         args.report + ".journal.jsonl" if args.report else None
     )
     journal = open_journal(journal_path)
+    if journal.enabled:
+        # span events ride the bench journal too, so BENCH_*.json rounds
+        # carry per-kernel dispatch timelines (`specpride trace`-able)
+        tracing.set_current(Tracer(journal=journal))
     journal.emit(
         "run_start", command="bench", method=args.method,
         backend="tpu", n_clusters=len(clusters),
@@ -438,81 +453,84 @@ def main() -> None:
         journal=journal,
     )
 
-    if args.report:
-        import os
+    with device_trace(args.trace_dir):
+        if args.report:
+            import os
 
-        report = {
-            "workload": {
-                "n_clusters": len(clusters),
-                "n_spectra": n_spectra,
-                "seed": args.seed,
-            },
-            "jax_devices": [str(d) for d in jax.devices()],
-            # the host core count bounds every threaded native path: on a
-            # 1-core bench host the C++ kernels win by cache locality and
-            # allocation avoidance only, never by parallelism
-            "host_cpu_cores": len(os.sched_getaffinity(0)),
-            "methods": [],
-        }
-        import gc
+            report = {
+                "workload": {
+                    "n_clusters": len(clusters),
+                    "n_spectra": n_spectra,
+                    "seed": args.seed,
+                },
+                "jax_devices": [str(d) for d in jax.devices()],
+                # the host core count bounds every threaded native path: on
+                # a 1-core bench host the C++ kernels win by cache locality
+                # and allocation avoidance only, never by parallelism
+                "host_cpu_cores": len(os.sched_getaffinity(0)),
+                "methods": [],
+            }
+            import gc
 
-        for method in ("bin_mean", "gap_average", "medoid", "pipeline"):
-            report["methods"].append(
-                bench_method(
-                    method, clusters, backend, nb,
+            for method in ("bin_mean", "gap_average", "medoid", "pipeline"):
+                report["methods"].append(
+                    bench_method(
+                        method, clusters, backend, nb,
+                        numpy_sample=len(clusters), seed=args.seed,
+                        journal=journal,
+                    )
+                )
+                # back-to-back methods in one process measurably degrade on
+                # tunneled hosts (leftover device buffers + queue state); a
+                # collection pass between methods keeps runs comparable to
+                # standalone --method invocations
+                gc.collect()
+            # the measured-choice default ("auto") runs K1/K2b on the host
+            # mesh-less; keep the DEVICE flat paths measured too, so the
+            # device-vs-host decision stays pinned to current numbers
+            dev_backend = TpuBackend(
+                batch_config=BatchConfig(clusters_per_batch=4096),
+                layout="flat",
+                sync_timing=args.sync_timing,
+                journal=journal,
+                # one registry across both backends: run_end.device must
+                # cover the flat-layout benches too, not just the default
+                # backend's
+                metrics=backend.metrics,
+            )
+            for method in ("bin_mean", "pipeline"):
+                entry = bench_method(
+                    method, clusters, dev_backend, nb,
                     numpy_sample=len(clusters), seed=args.seed,
                     journal=journal,
                 )
+                entry["method"] += "_device_flat"
+                entry["metric"] += " [device flat layout]"
+                report["methods"].append(entry)
+                gc.collect()
+            report["sweep"] = bench_sweep(clusters, backend, nb)
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as workdir:
+                report["end_to_end"] = bench_end_to_end(clusters, workdir)
+            ab = pallas_ab(clusters)
+            if ab is not None:
+                report["pallas_ab"] = ab
+            with open(args.report, "w") as f:
+                json.dump(report, f, indent=2)
+                f.write("\n")
+            eprint(f"wrote {args.report}")
+            head = next(
+                r for r in report["methods"] if r["method"] == "pipeline"
             )
-            # back-to-back methods in one process measurably degrade on
-            # tunneled hosts (leftover device buffers + queue state); a
-            # collection pass between methods keeps runs comparable to
-            # standalone --method invocations
-            gc.collect()
-        # the measured-choice default ("auto") runs K1/K2b on the host
-        # mesh-less; keep the DEVICE flat paths measured too, so the
-        # device-vs-host decision stays pinned to current numbers
-        dev_backend = TpuBackend(
-            batch_config=BatchConfig(clusters_per_batch=4096),
-            layout="flat",
-            sync_timing=args.sync_timing,
-            journal=journal,
-            # one registry across both backends: run_end.device must cover
-            # the flat-layout benches too, not just the default backend's
-            metrics=backend.metrics,
-        )
-        for method in ("bin_mean", "pipeline"):
-            entry = bench_method(
-                method, clusters, dev_backend, nb,
-                numpy_sample=len(clusters), seed=args.seed,
+        else:
+            head = bench_method(
+                args.method, clusters, backend, nb,
+                numpy_sample=args.numpy_sample, seed=args.seed,
                 journal=journal,
             )
-            entry["method"] += "_device_flat"
-            entry["metric"] += " [device flat layout]"
-            report["methods"].append(entry)
-            gc.collect()
-        report["sweep"] = bench_sweep(clusters, backend, nb)
-        import tempfile
 
-        with tempfile.TemporaryDirectory() as workdir:
-            report["end_to_end"] = bench_end_to_end(clusters, workdir)
-        ab = pallas_ab(clusters)
-        if ab is not None:
-            report["pallas_ab"] = ab
-        with open(args.report, "w") as f:
-            json.dump(report, f, indent=2)
-            f.write("\n")
-        eprint(f"wrote {args.report}")
-        head = next(
-            r for r in report["methods"] if r["method"] == "pipeline"
-        )
-    else:
-        head = bench_method(
-            args.method, clusters, backend, nb,
-            numpy_sample=args.numpy_sample, seed=args.seed,
-            journal=journal,
-        )
-
+    tracing.set_current(None)
     journal.emit(
         "run_end",
         counters={"clusters": len(clusters), "spectra": n_spectra},

@@ -12,17 +12,41 @@ cd "$(dirname "$0")/.."
 echo "== pytest =="
 python -m pytest tests/ -x -q
 
-echo "== observability: journal-producing pipeline + specpride stats =="
-# one real CLI run must produce a schema-valid journal and metrics file;
-# `specpride stats` exits non-zero on any schema violation
+echo "== observability: journal + chrome-trace pipeline + specpride stats =="
+# one real CLI run must produce a schema-valid journal, metrics file, and
+# well-formed Chrome trace; `specpride stats` exits non-zero on any schema
+# violation
 obs_tmp=$(mktemp -d)
 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     consensus tests/data/golden_clustered.mgf "$obs_tmp/reps.mgf" \
     --method bin-mean --backend tpu \
-    --journal "$obs_tmp/run.jsonl" --metrics-out "$obs_tmp/run.prom"
+    --journal "$obs_tmp/run.jsonl" --metrics-out "$obs_tmp/run.prom" \
+    --chrome-trace "$obs_tmp/run.trace.json"
 test -s "$obs_tmp/run.prom"
+python - "$obs_tmp/run.trace.json" <<'EOF'
+import json, sys
+trace = json.load(open(sys.argv[1]))
+events = trace["traceEvents"]
+assert events, "empty traceEvents"
+for e in events:
+    assert {"ph", "ts", "pid"} <= set(e), f"missing trace keys: {e}"
+assert any(e["ph"] == "X" for e in events), "no span slices"
+print(f"trace OK: {len(events)} events")
+EOF
 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
-    stats "$obs_tmp/run.jsonl" --json "$obs_tmp/agg.json"
+    stats "$obs_tmp/run.jsonl" --json "$obs_tmp/agg.json" --top-spans 5
+echo "== observability: specpride trace over a 2-shard .part journal pair =="
+cp "$obs_tmp/run.jsonl" "$obs_tmp/multi.jsonl.part00000"
+cp "$obs_tmp/run.jsonl" "$obs_tmp/multi.jsonl.part00001"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    trace "$obs_tmp/multi.jsonl" -o "$obs_tmp/multi.trace.json"
+python - "$obs_tmp/multi.trace.json" <<'EOF'
+import json, sys
+events = json.load(open(sys.argv[1]))["traceEvents"]
+pids = {e["pid"] for e in events if e["ph"] == "X"}
+assert pids == {0, 1}, f"expected both ranks on the timeline, got {pids}"
+print("multi-host trace merge OK")
+EOF
 rm -rf "$obs_tmp"
 
 if [ "${1:-}" != "--fast" ]; then

@@ -406,8 +406,11 @@ def average_cosine(
 # telemetry lives here.  It records the SAME metric families the device
 # backend does (device-only series — compiles, H2D/D2H bytes, padding —
 # simply stay zero), so an oracle run's --metrics-out and run_end.device
-# diff cleanly against a device run's.
+# diff cleanly against a device run's.  The method-level tracing spans
+# below likewise share names with TpuBackend's (labeled backend="numpy"
+# vs "tpu"), so oracle and device traces diff cleanly too.
 from specpride_tpu.observability import MetricsRegistry as _MetricsRegistry
+from specpride_tpu.observability import tracing
 
 metrics = _MetricsRegistry()
 
@@ -419,12 +422,14 @@ def _count_run(method: str, n: int) -> None:
     ).inc(n, method=method)
 
 
+@tracing.traced("method:bin_mean", backend="numpy")
 def run_bin_mean(clusters: list[Cluster], config: BinMeanConfig = BinMeanConfig()) -> list[Spectrum]:
     """Per-cluster loop of ref src/binning.py:291-297."""
     _count_run("bin_mean", len(clusters))
     return [bin_mean_consensus(c.members, config, c.cluster_id) for c in clusters]
 
 
+@tracing.traced("method:gap_average", backend="numpy")
 def run_gap_average(
     clusters: list[Cluster], config: GapAverageConfig = GapAverageConfig()
 ) -> list[Spectrum]:
@@ -441,6 +446,7 @@ def run_gap_average(
     return out
 
 
+@tracing.traced("method:medoid", backend="numpy")
 def run_medoid(
     clusters: list[Cluster], config: MedoidConfig = MedoidConfig()
 ) -> list[Spectrum]:
@@ -449,6 +455,7 @@ def run_medoid(
     return [c.members[medoid_index(c.members, config)] for c in clusters]
 
 
+@tracing.traced("method:best", backend="numpy")
 def run_best_spectrum(
     clusters: list[Cluster],
     scores: dict[str, float],

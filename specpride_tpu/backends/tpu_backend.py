@@ -37,6 +37,7 @@ from specpride_tpu.data.peaks import Cluster, Spectrum
 from specpride_tpu.ops import quantize
 from specpride_tpu.backends import numpy_backend
 from specpride_tpu.observability import MetricsRegistry, NullJournal, RunStats
+from specpride_tpu.observability import tracing
 
 
 _cache_configured = False
@@ -263,13 +264,17 @@ class TpuBackend:
     def _note_dispatch(
         self, kernel: str, shape_key: tuple, *, rows: int, padded_rows: int,
         real_elems=None, padded_elems: int | None = None,
-        seconds: float | None = None,
+        seconds: float | None = None, t_start: float | None = None,
     ) -> None:
         """Record one device dispatch: per-kernel dispatch/compile counters,
         bucket occupancy (real vs padded rows), pack padding waste (real vs
-        padded elements), dispatch-call latency, and the journal events an
+        padded elements), dispatch-call latency, the journal events an
         operator tails (``compile`` once per new shape class, ``dispatch``
-        per call).
+        per call), and — when a tracer is installed — one ``kernel:<name>``
+        span per dispatch, annotated with the bucket shape class,
+        compile-vs-cached, and real/padded element counts (``t_start`` is
+        the ``perf_counter`` at dispatch start, so the span lands inside
+        the "dispatch" phase span that contained the call).
 
         ``real_elems`` may be a zero-arg callable deferring an expensive
         host reduction; it is evaluated only when pack accounting is on."""
@@ -282,7 +287,8 @@ class TpuBackend:
                 else None
             )
         key = (kernel, *shape_key)
-        if key not in self._seen_shapes:
+        is_new_shape = key not in self._seen_shapes
+        if is_new_shape:
             self._seen_shapes.add(key)
             m.counter(
                 "specpride_compiles_total",
@@ -320,14 +326,21 @@ class TpuBackend:
                 "dispatch-call wall time (async: excludes device execution "
                 "unless sync_timing)", labels=("kernel",),
             ).observe(seconds, kernel=kernel)
+        pack_labels = (
+            {"real_elems": int(real_elems), "padded_elems": int(padded_elems)}
+            if real_elems is not None and padded_elems else {}
+        )
         self.journal.emit(
             "dispatch", kernel=kernel, rows=rows, padded_rows=padded_rows,
-            **(
-                {"real_elems": int(real_elems),
-                 "padded_elems": int(padded_elems)}
-                if real_elems is not None and padded_elems else {}
-            ),
+            **pack_labels,
         )
+        if seconds is not None and t_start is not None:
+            tracing.current().complete(
+                f"kernel:{kernel}", t_start, seconds,
+                kernel=kernel, shape_key=list(shape_key), rows=rows,
+                padded_rows=padded_rows, compile=is_new_shape,
+                **pack_labels,
+            )
 
     def _note_d2h(self, arrays) -> None:
         self.metrics.counter(
@@ -432,6 +445,10 @@ class TpuBackend:
 
     # -- binned-mean consensus (K1) -------------------------------------
 
+    # method-level spans share names with the numpy oracle's (labeled
+    # backend="tpu" vs "numpy") so oracle and device traces diff cleanly
+
+    @tracing.traced("method:bin_mean", backend="tpu")
     def run_bin_mean(
         self, clusters: list[Cluster], config: BinMeanConfig = BinMeanConfig()
     ) -> list[Spectrum]:
@@ -475,8 +492,8 @@ class TpuBackend:
                     # pow2: cap is a static jit arg — see _pow2
                     cap = _cap_class(int(dist.sum()), floor=1024)
                 lcap = _pow2(int(batch.n_members.max(initial=1)))
-                t0 = time.perf_counter()
                 with st.phase("dispatch"):
+                    t0 = time.perf_counter()
                     fused = bin_mean_deduped_compact(
                         *self._ship(
                             _pad_axis0(batch.mz[lo:hi], size),
@@ -493,6 +510,10 @@ class TpuBackend:
                         # dedup bounds (row, bin) runs at the member count
                         lcap=lcap,
                     )
+                    # timed INSIDE the phase block so the kernel span's
+                    # end precedes the dispatch span's — time-containment
+                    # nesting (aggregate_spans, Perfetto) depends on it
+                    dt = time.perf_counter() - t0
                 self._note_dispatch(
                     "bin_mean_bucketized", (size, k, cap, lcap),
                     rows=hi - lo, padded_rows=size,
@@ -500,7 +521,7 @@ class TpuBackend:
                         batch.bins[lo:hi] != config.n_bins
                     ).sum(),
                     padded_elems=size * k,
-                    seconds=time.perf_counter() - t0,
+                    seconds=dt, t_start=t0,
                 )
                 pending.append((batch, lo, hi, cap, fused))
 
@@ -579,7 +600,7 @@ class TpuBackend:
             "bin_mean_flat_intensity", (n_pad, cap, rcap, lcap),
             rows=rows, padded_rows=rows,
             real_elems=n, padded_elems=n_pad,
-            seconds=time.perf_counter() - t0,
+            seconds=time.perf_counter() - t0, t_start=t0,
         )
         return fused, aux
 
@@ -712,6 +733,7 @@ class TpuBackend:
 
     # -- gap-average consensus (K3) -------------------------------------
 
+    @tracing.traced("method:gap_average", backend="tpu")
     def run_gap_average(
         self,
         clusters: list[Cluster],
@@ -889,8 +911,8 @@ class TpuBackend:
                 # compacted D2H buffer carries only real output bytes
                 # pow2: cap is a static jit arg — see _pow2
                 cap = _cap_class(int(batch.n_groups[lo:hi].sum()), floor=1024)
-                t0 = time.perf_counter()
                 with st.phase("dispatch"):
+                    t0 = time.perf_counter()
                     fused = gap_average_compact(
                         *self._ship(
                             _pad_axis0(batch.mz[lo:hi], size),
@@ -903,12 +925,13 @@ class TpuBackend:
                         config=config,
                         total_cap=cap,
                     )
+                    dt = time.perf_counter() - t0  # see bin_mean: span nesting
                 self._note_dispatch(
                     "gap_average_compact", (size, k, cap),
                     rows=hi - lo, padded_rows=size,
                     real_elems=lambda lo=lo, hi=hi: batch.n_valid[lo:hi].sum(),
                     padded_elems=size * k,
-                    seconds=time.perf_counter() - t0,
+                    seconds=dt, t_start=t0,
                 )
                 pending.append((batch, lo, hi, cap, fused))
 
@@ -994,8 +1017,8 @@ class TpuBackend:
             chunk = max(1, (4 * self.max_grid_elements) // max(k * m, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                t0 = time.perf_counter()
                 with st.phase("dispatch"):
+                    t0 = time.perf_counter()
                     args = (
                         _pad_axis0(sbins[lo:hi], size, fill=2**30),
                         _pad_axis0(smm[lo:hi], size, fill=m),
@@ -1008,12 +1031,13 @@ class TpuBackend:
                     res = shared_bins_packed(*args, m=m, lcap=lcap)
                     # slice on device first: D2H carries only real rows
                     res = res[: hi - lo]
+                    dt = time.perf_counter() - t0  # see bin_mean: span nesting
                 self._note_dispatch(
                     "shared_bins_packed", (size, k, m, lcap),
                     rows=hi - lo, padded_rows=size,
                     real_elems=lambda lo=lo, hi=hi: (smm[lo:hi] != m).sum(),
                     padded_elems=size * k,
-                    seconds=time.perf_counter() - t0,
+                    seconds=dt, t_start=t0,
                 )
                 pending.append((batch, lo, hi, res))
 
@@ -1081,6 +1105,7 @@ class TpuBackend:
         st.count("clusters", len(clusters))
         return [int(i) for i in indices]
 
+    @tracing.traced("method:medoid", backend="tpu")
     def run_medoid(
         self, clusters: list[Cluster], config: MedoidConfig = MedoidConfig()
     ) -> list[Spectrum]:
@@ -1101,6 +1126,7 @@ class TpuBackend:
 
     # -- quality metrics (K2 cosine) ------------------------------------
 
+    @tracing.traced("method:cosine", backend="tpu")
     def average_cosines(
         self,
         representatives: list[Spectrum],
@@ -1193,8 +1219,8 @@ class TpuBackend:
             chunk = max(1, self.max_grid_elements // max((k + pr) * 6, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
-                t0 = time.perf_counter()
                 with st.phase("dispatch"):
+                    t0 = time.perf_counter()
                     mean, _ = cosine_packed(
                         *self._ship(
                             _pad_axis0(rep_bins[lo:hi], size, fill=2**30),
@@ -1209,12 +1235,13 @@ class TpuBackend:
                         ),
                         m=m,
                     )
+                    dt = time.perf_counter() - t0  # see bin_mean: span nesting
                 self._note_dispatch(
                     "cosine_packed", (size, k, pr, m),
                     rows=hi - lo, padded_rows=size,
                     real_elems=lambda lo=lo, hi=hi: (mem_mm[lo:hi] != m).sum(),
                     padded_elems=size * k,
-                    seconds=time.perf_counter() - t0,
+                    seconds=dt, t_start=t0,
                 )
                 pending.append((idxs, lo, hi, mean))
 
@@ -1225,6 +1252,7 @@ class TpuBackend:
                     out[idxs[lo + ci]] = float(mean[ci])
         return out
 
+    @tracing.traced("method:bin_mean_with_cosines", backend="tpu")
     def run_bin_mean_with_cosines(
         self,
         clusters: list[Cluster],
@@ -1745,8 +1773,8 @@ class TpuBackend:
                     + cut_spec_all[s0:s1] + 1,
                 ).astype(np.int32)
 
-            t0 = time.perf_counter()
             with st.phase("dispatch"):
+                t0 = time.perf_counter()
                 mean = cosine_flat(
                     *self._put_batch([
                         rkey,
@@ -1769,11 +1797,12 @@ class TpuBackend:
                     l_mem=prep["l_mem"],
                     l_members=prep["l_members"],
                 )
+                dt = time.perf_counter() - t0  # see bin_mean: span nesting
             self._note_dispatch(
                 "cosine_flat", (n_pad, nr_pad, rows_cap, s_pad),
                 rows=rows, padded_rows=rows_cap,
                 real_elems=n, padded_elems=n_pad,
-                seconds=time.perf_counter() - t0,
+                seconds=dt, t_start=t0,
             )
             pending.append((lo, rows, mean))
             lo = hi

@@ -37,6 +37,7 @@ from specpride_tpu.observability import (
     MetricsRegistry,
     NullJournal,
     RunStats,
+    Tracer,
     configure_logging,
     device_summary,
     device_trace,
@@ -44,6 +45,7 @@ from specpride_tpu.observability import (
     logger,
     open_journal,
 )
+from specpride_tpu.observability import tracing
 
 
 def _add_backend(p: argparse.ArgumentParser) -> None:
@@ -90,6 +92,15 @@ def _add_observability(p: argparse.ArgumentParser) -> None:
         "--trace-dir", metavar="DIR",
         help="capture a jax.profiler device trace of the compute into "
         "this directory (view with TensorBoard / Perfetto)",
+    )
+    p.add_argument(
+        "--chrome-trace", metavar="FILE",
+        help="export the run's hierarchical span timeline (parse / pack / "
+        "per-kernel dispatch / d2h / write, per chunk) as Chrome "
+        "trace-event JSON, loadable in Perfetto or chrome://tracing; "
+        "multi-host runs write one <FILE>.part<rank> per rank (for a "
+        "single merged timeline run `specpride trace` over the "
+        "--journal shards)",
     )
 
 
@@ -177,6 +188,8 @@ def _shard_for_process(clusters: list, args) -> tuple[list, str]:
         args.journal = f"{args.journal}.part{pid:05d}"
     if getattr(args, "metrics_out", None):
         args.metrics_out = f"{args.metrics_out}.part{pid:05d}"
+    if getattr(args, "chrome_trace", None):
+        args.chrome_trace = f"{args.chrome_trace}.part{pid:05d}"
     logger.info(
         "process %d/%d: %d of %d clusters -> %s",
         pid, nproc, len(mine), len(clusters), part,
@@ -472,104 +485,126 @@ def _checkpointed_run(
         journal.emit(
             "chunk_start", chunk_index=chunk_index, n_clusters=len(part)
         )
-        chunk_t0 = _time.perf_counter()
-        n_qc_before = len(qc) if qc is not None else 0
+        # the per-chunk span is the trace's unit of progress: everything a
+        # chunk does (compute, QC, write, checkpoint) nests under it, so a
+        # straggler chunk is visible as one long slice on the timeline
+        # (closed in the finally — an abort mid-chunk must not leak an
+        # open span onto the tracer's per-thread stack)
+        chunk_span = tracing.span(
+            "chunk", chunk_index=chunk_index, n_clusters=len(part)
+        )
+        chunk_span.__enter__()
         try:
-            with stats.phase("compute"):
-                reps = _run_method(
-                    backend, method, part, args, scores=scores, qc=qc
-                )
-        except (ValueError, RuntimeError) as e:
-            # per-chunk failure isolation (survey §5 failure detection):
-            # with --on-error skip, a chunk whose input is bad (e.g. mixed
-            # charge states) is retried cluster-by-cluster so only the
-            # offending clusters are dropped — logged and recorded in the
-            # manifest, never silently
-            if on_error != "skip":
-                raise
-            logger.warning(
-                "chunk of %d clusters failed (%s); retrying one by one",
-                len(part), e,
-            )
-            reps, bad_part = [], []
-            with stats.phase("compute"):
-                for c in part:
-                    try:
-                        reps.extend(
-                            _run_method(
-                                backend, method, [c], args,
-                                scores=scores, qc=qc,
-                            )
-                        )
-                    except (ValueError, RuntimeError) as ce:
-                        logger.warning(
-                            "skipping cluster %s: %s", c.cluster_id, ce
-                        )
-                        bad_part.append(c.cluster_id)
-            failed.update(dict.fromkeys(bad_part))
-            stats.count("clusters_failed", len(bad_part))
-        if qc is not None and len(qc) == n_qc_before and reps:
-            # ONE QC site for every non-fused method (the fused bin-mean
-            # path appends inside _run_method, detected by len(qc)):
-            # align reps to clusters by id — best-spectrum may drop
-            # scoreless clusters — and never let a QC failure veto the
-            # representatives the method already produced
+            chunk_t0 = _time.perf_counter()
+            n_qc_before = len(qc) if qc is not None else 0
             try:
-                by_id = {r.cluster_id: r for r in reps}
-                kept = [c for c in part if c.cluster_id in by_id]
                 with stats.phase("compute"):
-                    _append_qc_rows(
-                        qc, kept,
-                        _cosines_of(
-                            backend,
-                            [by_id[c.cluster_id] for c in kept], kept,
-                            _cosine_config(args),
-                        ),
+                    reps = _run_method(
+                        backend, method, part, args, scores=scores, qc=qc
                     )
             except (ValueError, RuntimeError) as e:
+                # per-chunk failure isolation (survey §5 failure
+                # detection): with --on-error skip, a chunk whose input is
+                # bad (e.g. mixed charge states) is retried
+                # cluster-by-cluster so only the offending clusters are
+                # dropped — logged and recorded in the manifest, never
+                # silently
+                if on_error != "skip":
+                    raise
                 logger.warning(
-                    "QC cosines failed for a %d-cluster chunk (%s); "
-                    "their rows are omitted from the report", len(part), e,
+                    "chunk of %d clusters failed (%s); retrying one by one",
+                    len(part), e,
                 )
-                # machine-readable trace for the report summary: consumers
-                # must be able to tell "row dropped by the method" from
-                # "QC itself failed" (advisor r4)
-                qc_failed.update(dict.fromkeys(c.cluster_id for c in part))
-                journal.emit(
-                    "qc_failure",
-                    cluster_ids=[c.cluster_id for c in part],
-                    error=str(e),
-                )
-        with stats.phase("write"):
-            write_mgf(reps, args.output, append=not first_write)
-        first_write = False
-        stats.count("clusters", len(part))
-        stats.count("representatives", len(reps))
-        done.update(c.cluster_id for c in part)
-        chunk_dt = _time.perf_counter() - chunk_t0
-        journal.emit(
-            "chunk_done", chunk_index=chunk_index, n_clusters=len(part),
-            n_representatives=len(reps), elapsed_s=round(chunk_dt, 4),
-            clusters_per_sec=round(len(part) / chunk_dt, 2)
-            if chunk_dt > 0 else 0.0,
-        )
-        if args.checkpoint:
-            output_bytes = os.path.getsize(args.output)
-            tmp = args.checkpoint + ".tmp"
-            with open(tmp, "w") as fh:
-                json.dump(
-                    {
-                        "done": sorted(done),
-                        "output_bytes": output_bytes,
-                        **({"failed": sorted(failed)} if failed else {}),
-                    },
-                    fh,
-                )
-            os.replace(tmp, args.checkpoint)
+                reps, bad_part = [], []
+                with stats.phase("compute"):
+                    for c in part:
+                        try:
+                            reps.extend(
+                                _run_method(
+                                    backend, method, [c], args,
+                                    scores=scores, qc=qc,
+                                )
+                            )
+                        except (ValueError, RuntimeError) as ce:
+                            logger.warning(
+                                "skipping cluster %s: %s", c.cluster_id, ce
+                            )
+                            bad_part.append(c.cluster_id)
+                failed.update(dict.fromkeys(bad_part))
+                stats.count("clusters_failed", len(bad_part))
+            if qc is not None and len(qc) == n_qc_before and reps:
+                # ONE QC site for every non-fused method (the fused
+                # bin-mean path appends inside _run_method, detected by
+                # len(qc)): align reps to clusters by id — best-spectrum
+                # may drop scoreless clusters — and never let a QC failure
+                # veto the representatives the method already produced
+                try:
+                    by_id = {r.cluster_id: r for r in reps}
+                    kept = [c for c in part if c.cluster_id in by_id]
+                    with stats.phase("compute"), tracing.span(
+                        "qc", n_clusters=len(kept)
+                    ):
+                        _append_qc_rows(
+                            qc, kept,
+                            _cosines_of(
+                                backend,
+                                [by_id[c.cluster_id] for c in kept], kept,
+                                _cosine_config(args),
+                            ),
+                        )
+                except (ValueError, RuntimeError) as e:
+                    logger.warning(
+                        "QC cosines failed for a %d-cluster chunk (%s); "
+                        "their rows are omitted from the report",
+                        len(part), e,
+                    )
+                    # machine-readable trace for the report summary:
+                    # consumers must be able to tell "row dropped by the
+                    # method" from "QC itself failed" (advisor r4)
+                    qc_failed.update(
+                        dict.fromkeys(c.cluster_id for c in part)
+                    )
+                    journal.emit(
+                        "qc_failure",
+                        cluster_ids=[c.cluster_id for c in part],
+                        error=str(e),
+                    )
+            with stats.phase("write"):
+                write_mgf(reps, args.output, append=not first_write)
+            first_write = False
+            stats.count("clusters", len(part))
+            stats.count("representatives", len(reps))
+            done.update(c.cluster_id for c in part)
+            chunk_dt = _time.perf_counter() - chunk_t0
             journal.emit(
-                "checkpoint_write", n_done=len(done),
-                output_bytes=output_bytes,
+                "chunk_done", chunk_index=chunk_index, n_clusters=len(part),
+                n_representatives=len(reps), elapsed_s=round(chunk_dt, 4),
+                clusters_per_sec=round(len(part) / chunk_dt, 2)
+                if chunk_dt > 0 else 0.0,
             )
+            if args.checkpoint:
+                output_bytes = os.path.getsize(args.output)
+                with tracing.span("checkpoint_write", n_done=len(done)):
+                    tmp = args.checkpoint + ".tmp"
+                    with open(tmp, "w") as fh:
+                        json.dump(
+                            {
+                                "done": sorted(done),
+                                "output_bytes": output_bytes,
+                                **(
+                                    {"failed": sorted(failed)}
+                                    if failed else {}
+                                ),
+                            },
+                            fh,
+                        )
+                    os.replace(tmp, args.checkpoint)
+                journal.emit(
+                    "checkpoint_write", n_done=len(done),
+                    output_bytes=output_bytes,
+                )
+        finally:
+            chunk_span.__exit__(None, None, None)
     if failed:
         logger.warning(
             "%d clusters failed and were skipped: %s%s",
@@ -678,9 +713,36 @@ def _clusters_from_mzml(path: str, args, stats: RunStats) -> list[Cluster]:
     return group_into_clusters(out)
 
 
+_TRACER_UNSET = object()
+
+
+def _install_tracer_early(args) -> None:
+    """Install the span tracer BEFORE input parsing so the parse phase —
+    often the largest — is on the timeline too (the acceptance bar is
+    spans covering >=95% of phase-timer time).  Parse-time spans buffer
+    in memory until ``_open_run_journal`` attaches the journal and
+    replays them.  Callers must pair this with ``_restore_tracer`` in a
+    ``finally`` — an early exit (bad input, SystemExit) must not leak a
+    process-global tracer."""
+    chrome = getattr(args, "chrome_trace", None)
+    if getattr(args, "journal", None) or chrome:
+        args._prev_tracer = tracing.set_current(Tracer(keep=True))
+
+
+def _restore_tracer(args) -> None:
+    """Restore the tracer saved by ``_install_tracer_early`` /
+    ``_open_run_journal``.  Idempotent: ``_finish_run`` restores on the
+    happy path; the command's ``finally`` catches every early exit."""
+    prev = args.__dict__.pop("_prev_tracer", _TRACER_UNSET)
+    if prev is not _TRACER_UNSET:
+        tracing.set_current(prev)
+
+
 def _open_run_journal(args, backend, command: str, n_clusters: int):
     """Open the --journal stream (NullJournal when absent), hook it into
-    the backend's dispatch instrumentation, and emit ``run_start``."""
+    the backend's dispatch instrumentation, install the span tracer
+    (journal-fed and/or in-memory for ``--chrome-trace``), and emit
+    ``run_start``."""
     journal = open_journal(getattr(args, "journal", None))
     if hasattr(backend, "journal"):  # TpuBackend; the numpy module has none
         backend.journal = journal
@@ -694,12 +756,30 @@ def _open_run_journal(args, backend, command: str, n_clusters: int):
         backend=getattr(args, "backend", "numpy"),
         n_clusters=int(n_clusters), output=args.output,
     )
+    chrome = getattr(args, "chrome_trace", None)
+    if journal.enabled or chrome:
+        # spans ride the SAME journal stream as the v1 events; kept in
+        # memory only when a direct --chrome-trace export needs them.
+        # The previous tracer is restored by _finish_run (or the
+        # command's finally), so a nested cli_main (bench.py's
+        # end-to-end section) cannot clobber its caller's tracer.
+        if hasattr(args, "_prev_tracer"):
+            # _install_tracer_early already traced the parse phase: its
+            # buffered spans replay into the journal here (after
+            # run_start, so journal consumers see a well-ordered run;
+            # each keeps its original `mono`, so the timeline is exact)
+            tracing.current().attach_journal(journal, keep=bool(chrome))
+        else:
+            args._prev_tracer = tracing.set_current(
+                Tracer(journal=journal, keep=bool(chrome))
+            )
     return journal
 
 
 def _finish_run(args, backend, stats: RunStats, journal) -> None:
     """Emit ``run_end`` (full summary + the device-telemetry dict both
-    backends share) and write the Prometheus textfile if requested."""
+    backends share), write the Chrome trace and the Prometheus textfile
+    if requested, and uninstall the run's tracer."""
     device = device_summary(getattr(backend, "metrics", None))
     journal.emit(
         "run_end",
@@ -710,7 +790,15 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         clusters_per_sec=round(stats.throughput("clusters"), 2),
         device=device,
     )
+    tracer = tracing.current()
+    _restore_tracer(args)  # only uninstalls what this run installed
     journal.close()
+    chrome = getattr(args, "chrome_trace", None)
+    if chrome and tracer.enabled:
+        n = tracer.write_chrome_trace(
+            chrome, pid=tracing.rank_of_path(chrome)
+        )
+        logger.info("chrome trace (%d spans) -> %s", n, chrome)
     if getattr(args, "metrics_out", None):
         registry = getattr(backend, "metrics", None) or MetricsRegistry()
         export_run_metrics(registry, stats, device)
@@ -725,61 +813,70 @@ def cmd_consensus(args) -> int:
             _bin_mean_config(args)
         except ValueError as e:
             raise SystemExit(f"invalid bin-mean options: {e}")
-    if _is_mzml(args.input):
-        clusters = _clusters_from_mzml(args.input, args, stats)
-    else:
-        clusters = _load_clusters(
-            args.input, stats, getattr(args, "stream_clusters", "off")
+    _install_tracer_early(args)
+    try:
+        if _is_mzml(args.input):
+            clusters = _clusters_from_mzml(args.input, args, stats)
+        else:
+            clusters = _load_clusters(
+                args.input, stats, getattr(args, "stream_clusters", "off")
+            )
+        if args.single:
+            # whole file = one cluster; the reference titles the result
+            # with the output filename (ref
+            # average_spectrum_clustering.py:203-205).  Zero input spectra
+            # stay zero clusters — a truly empty cluster would crash the
+            # backends.
+            spectra = [s for c in clusters for s in c.members]
+            clusters = [Cluster(args.output, spectra)] if spectra else []
+        backend = _get_backend(args)
+        clusters, args.output = _shard_for_process(clusters, args)
+        journal = _open_run_journal(args, backend, "consensus", len(clusters))
+        qc = [] if getattr(args, "qc_report", None) else None
+        with device_trace(getattr(args, "trace_dir", None)):
+            resumed, failed, qc_failed = _checkpointed_run(
+                backend, args.method, clusters, args, stats, qc=qc,
+                journal=journal,
+            )
+        if qc is not None:
+            _write_qc_report(args, backend, clusters, qc, stats, resumed,
+                             failed, qc_failed)
+        logger.info(
+            "consensus done: %.1f clusters/sec", stats.throughput("clusters")
         )
-    if args.single:
-        # whole file = one cluster; the reference titles the result with
-        # the output filename (ref average_spectrum_clustering.py:203-205).
-        # Zero input spectra stay zero clusters — a truly empty cluster
-        # would crash the backends.
-        spectra = [s for c in clusters for s in c.members]
-        clusters = [Cluster(args.output, spectra)] if spectra else []
-    backend = _get_backend(args)
-    clusters, args.output = _shard_for_process(clusters, args)
-    journal = _open_run_journal(args, backend, "consensus", len(clusters))
-    qc = [] if getattr(args, "qc_report", None) else None
-    with device_trace(getattr(args, "trace_dir", None)):
-        resumed, failed, qc_failed = _checkpointed_run(
-            backend, args.method, clusters, args, stats, qc=qc,
-            journal=journal,
-        )
-    if qc is not None:
-        _write_qc_report(args, backend, clusters, qc, stats, resumed,
-                         failed, qc_failed)
-    logger.info(
-        "consensus done: %.1f clusters/sec", stats.throughput("clusters")
-    )
-    _finish_run(args, backend, stats, journal)
+        _finish_run(args, backend, stats, journal)
+    finally:
+        _restore_tracer(args)  # no-op after a clean _finish_run
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0
 
 
 def cmd_select(args) -> int:
     stats = RunStats()
-    if _is_mzml(args.input):
-        clusters = _clusters_from_mzml(args.input, args, stats)
-    else:
-        clusters = _load_clusters(
-            args.input, stats, getattr(args, "stream_clusters", "off")
-        )
-    backend = _get_backend(args)
-    scores = _load_scores(args) if args.method == "best" else None
-    clusters, args.output = _shard_for_process(clusters, args)
-    journal = _open_run_journal(args, backend, "select", len(clusters))
-    qc = [] if getattr(args, "qc_report", None) else None
-    with device_trace(getattr(args, "trace_dir", None)):
-        resumed, failed, qc_failed = _checkpointed_run(
-            backend, args.method, clusters, args, stats, scores, qc=qc,
-            journal=journal,
-        )
-    if qc is not None:
-        _write_qc_report(args, backend, clusters, qc, stats, resumed,
-                         failed, qc_failed)
-    _finish_run(args, backend, stats, journal)
+    _install_tracer_early(args)
+    try:
+        if _is_mzml(args.input):
+            clusters = _clusters_from_mzml(args.input, args, stats)
+        else:
+            clusters = _load_clusters(
+                args.input, stats, getattr(args, "stream_clusters", "off")
+            )
+        backend = _get_backend(args)
+        scores = _load_scores(args) if args.method == "best" else None
+        clusters, args.output = _shard_for_process(clusters, args)
+        journal = _open_run_journal(args, backend, "select", len(clusters))
+        qc = [] if getattr(args, "qc_report", None) else None
+        with device_trace(getattr(args, "trace_dir", None)):
+            resumed, failed, qc_failed = _checkpointed_run(
+                backend, args.method, clusters, args, stats, scores, qc=qc,
+                journal=journal,
+            )
+        if qc is not None:
+            _write_qc_report(args, backend, clusters, qc, stats, resumed,
+                             failed, qc_failed)
+        _finish_run(args, backend, stats, journal)
+    finally:
+        _restore_tracer(args)  # no-op after a clean _finish_run
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0
 
@@ -787,7 +884,39 @@ def cmd_select(args) -> int:
 def cmd_stats(args) -> int:
     from specpride_tpu.observability.stats_cli import run_stats
 
-    return run_stats(args.journals, json_out=args.json)
+    return run_stats(
+        args.journals, json_out=args.json, top_spans=args.top_spans
+    )
+
+
+def cmd_trace(args) -> int:
+    """Reconstruct a Chrome trace from one or more run journals, merging
+    multi-host ``.part<rank>`` shards onto a single timeline (pid = rank).
+    A post-mortem tool: schema violations (e.g. the torn final line of a
+    killed run) are reported on stderr and dropped, never fatal."""
+    from specpride_tpu.observability.tracing import build_chrome_trace
+
+    n_spans, n_files, warnings, violations = build_chrome_trace(
+        args.journals, args.out
+    )
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    for v in violations:
+        print(f"dropped: {v}", file=sys.stderr)
+    if n_files == 0:
+        # nothing readable at all: no journal and no shards
+        print("no journal files to read", file=sys.stderr)
+        return 1
+    if n_spans == 0 and violations:
+        # every span line was invalid — almost always the wrong input
+        # (e.g. chrome-trace .part files instead of the journal shards)
+        print(
+            "no valid span events read — pass the --journal files, not "
+            "the --chrome-trace output", file=sys.stderr,
+        )
+        return 1
+    print(f"{n_spans} spans -> {args.out}", file=sys.stderr)
+    return 0
 
 
 def cmd_merge_parts(args) -> int:
@@ -862,7 +991,8 @@ def cmd_evaluate(args) -> int:
     clusters = _load_clusters(args.clustered, stats)
     pairs = [(reps[c.cluster_id], c) for c in clusters if c.cluster_id in reps]
     stats.count("clusters_missing_rep", len(clusters) - len(pairs))
-    with stats.phase("evaluate"):
+    with device_trace(getattr(args, "trace_dir", None)), \
+            stats.phase("evaluate"):
         results = metrics.evaluate(
             [p[0] for p in pairs],
             [p[1] for p in pairs],
@@ -1063,6 +1193,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="intensity transform for the cosine metric",
     )
     pe.add_argument("--format", choices=["json", "csv"], default="json")
+    pe.add_argument(
+        "--trace-dir", metavar="DIR",
+        help="capture a jax.profiler device trace of the evaluate compute "
+        "into this directory (view with TensorBoard / Perfetto)",
+    )
     pe.set_defaults(fn=cmd_evaluate)
 
     pm = sub.add_parser(
@@ -1086,7 +1221,27 @@ def build_parser() -> argparse.ArgumentParser:
                      help="journal file(s) from --journal runs")
     pst.add_argument("--json", metavar="FILE",
                      help="also write the machine-readable aggregate here")
+    pst.add_argument(
+        "--top-spans", type=int, default=0, metavar="N",
+        help="also render the N slowest tracing spans (self time, count, "
+        "p50/p99) from the journals' v2 span events",
+    )
     pst.set_defaults(fn=cmd_stats)
+
+    pt = sub.add_parser(
+        "trace",
+        help="reconstruct a Chrome trace-event JSON from run journals "
+        "(multi-host .part<rank> shards merge onto one timeline, "
+        "pid = rank; view in Perfetto or chrome://tracing)",
+    )
+    pt.add_argument(
+        "journals", nargs="+",
+        help="journal file(s) or base paths from --journal runs "
+        "(a base path expands to its .part<rank> shards)",
+    )
+    pt.add_argument("-o", "--out", default="trace.json",
+                    help="trace-event JSON output path (default trace.json)")
+    pt.set_defaults(fn=cmd_trace)
 
     pp = sub.add_parser("plot", help="mirror plots for one cluster")
     pp.add_argument("clustered",

@@ -38,6 +38,7 @@ import numpy as np
 from specpride_tpu.config import BatchConfig
 from specpride_tpu.data.peaks import Cluster
 from specpride_tpu.data.table import ClusterIndex, SpectraTable
+from specpride_tpu.observability import tracing
 
 
 def _as_table(clusters_or_table) -> SpectraTable:
@@ -238,6 +239,7 @@ def _peak_layout(table: SpectraTable, idx: ClusterIndex, plan: _BucketPlan):
 # ---------------------------------------------------------------------------
 
 
+@tracing.traced("pack:bucketize")
 def pack_bucketize(
     clusters_or_table,
     config: BatchConfig = BatchConfig(),
@@ -380,6 +382,7 @@ def _bin_quantize_dedup(table: SpectraTable, config):
     return bins64, kept_src, kept_counts, kept_offsets, kept_totals
 
 
+@tracing.traced("pack:bucketize_bin_mean")
 def pack_bucketize_bin_mean(
     clusters_or_table,
     bin_config,
@@ -476,6 +479,7 @@ class FlatBinBatch:
     source_indices: list[int]
 
 
+@tracing.traced("pack:flat_bin_mean")
 def pack_flat_bin_mean(
     clusters_or_table,
     bin_config,
@@ -575,6 +579,7 @@ def pack_flat_bin_mean(
 # ---------------------------------------------------------------------------
 
 
+@tracing.traced("pack:gap_segments")
 def gap_global_segments(table, idx, config) -> dict:
     """Sort + f64 gap-segment EVERY cluster in one vectorized global pass
     (same grouping semantics as ``ops.quantize.gap_segments`` — the numpy
@@ -654,6 +659,7 @@ def gap_global_segments(table, idx, config) -> dict:
     )
 
 
+@tracing.traced("pack:bucketize_gap")
 def pack_bucketize_gap(
     clusters_or_table,
     config,

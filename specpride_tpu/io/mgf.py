@@ -28,6 +28,7 @@ from typing import IO, Iterator, Sequence
 import numpy as np
 
 from specpride_tpu.data.peaks import Cluster, Spectrum, parse_title
+from specpride_tpu.observability import tracing
 
 
 def _open_text(path: str | os.PathLike) -> IO[str]:
@@ -111,25 +112,32 @@ def read_mgf(path: str | os.PathLike, use_native: bool | None = None) -> list[Sp
     auto-build on the default path with ``SPECPRIDE_NATIVE_BUILD=1`` (the
     CLI and bench harness call ``native.ensure_built()`` explicitly).
     """
-    if use_native is not False:
-        try:
-            from specpride_tpu.io import native
+    with tracing.span("parse:mgf", path=os.fspath(path)) as sp:
+        if use_native is not False:
+            try:
+                from specpride_tpu.io import native
 
-            auto_build = os.environ.get("SPECPRIDE_NATIVE_BUILD", "") == "1"
-            ok = (
-                native.ensure_built()
-                if (use_native or auto_build)
-                else native.available()
-            )
-            if ok:
-                return native.read_mgf_native(os.fspath(path))
-            if use_native:
-                raise RuntimeError("native MGF parser requested but not built")
-        except ImportError:
-            if use_native:
-                raise
-    with _open_text(path) as fh:
-        return list(parse_mgf_stream(fh))
+                auto_build = os.environ.get("SPECPRIDE_NATIVE_BUILD", "") == "1"
+                ok = (
+                    native.ensure_built()
+                    if (use_native or auto_build)
+                    else native.available()
+                )
+                if ok:
+                    spectra = native.read_mgf_native(os.fspath(path))
+                    sp.note(n_spectra=len(spectra), parser="native")
+                    return spectra
+                if use_native:
+                    raise RuntimeError(
+                        "native MGF parser requested but not built"
+                    )
+            except ImportError:
+                if use_native:
+                    raise
+        with _open_text(path) as fh:
+            spectra = list(parse_mgf_stream(fh))
+        sp.note(n_spectra=len(spectra), parser="python")
+        return spectra
 
 
 class IndexedMGF:
@@ -228,6 +236,7 @@ class StreamedClusters:
         self._cache_lo = -1
         self._cache: list[Cluster] = []
 
+    @tracing.traced("parse:mgf_index")
     def _scan(self) -> list[tuple[str, int, int]]:
         records = []
         with open(self.path, "rb") as fh:
@@ -284,6 +293,7 @@ class StreamedClusters:
         for i in range(len(self._groups)):
             yield self[i]
 
+    @tracing.traced("parse:mgf_window")
     def _materialize(self, groups) -> list[Cluster]:
         # merge exactly-adjacent byte ranges so a cluster-contiguous file
         # (the common convert output) reads as a handful of large spans
@@ -367,7 +377,12 @@ def write_mgf(
             path_or_file.write(format_spectrum(s))  # type: ignore[union-attr]
         return None
     mode = "a" if append else "w"
-    with open(os.fspath(path_or_file), mode, encoding="utf-8") as fh:
-        for s in spectra:
-            fh.write(format_spectrum(s))
+    with tracing.span("write:mgf", path=os.fspath(path_or_file),
+                      append=append) as sp:
+        n = 0
+        with open(os.fspath(path_or_file), mode, encoding="utf-8") as fh:
+            for s in spectra:
+                fh.write(format_spectrum(s))
+                n += 1
+        sp.note(n_spectra=n)
     return None

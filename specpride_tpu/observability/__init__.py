@@ -13,6 +13,11 @@ Four pieces:
 * ``stats_cli``— the ``specpride stats`` command over one or more
                  journals (multi-host ``.part<id>`` shards merge
                  rank-aware like ``merge-parts``)
+* ``tracing``  — hierarchical span tracer: nested, labeled, monotonic
+                 spans journaled as v2 ``span`` events, exported as
+                 Chrome trace-event JSON (``--chrome-trace`` /
+                 ``specpride trace``), aggregated by
+                 ``specpride stats --top-spans``
 """
 
 from specpride_tpu.observability.journal import (
@@ -24,6 +29,11 @@ from specpride_tpu.observability.journal import (
     open_journal,
     read_events,
     validate_event,
+)
+from specpride_tpu.observability.tracing import (
+    NullTracer,
+    Tracer,
+    build_chrome_trace,
 )
 from specpride_tpu.observability.registry import (
     MetricsRegistry,
@@ -43,7 +53,10 @@ __all__ = [
     "Journal",
     "MetricsRegistry",
     "NullJournal",
+    "NullTracer",
     "RunStats",
+    "Tracer",
+    "build_chrome_trace",
     "configure_logging",
     "device_summary",
     "device_trace",

@@ -1,9 +1,13 @@
 """Run journal: append-only JSONL event stream for live tailing and
 post-mortems.
 
-Every event is one JSON object per line with three envelope fields —
-``v`` (schema version, pinned at 1), ``ts`` (unix seconds), ``event``
-(type name) — plus the per-type payload listed in ``EVENT_FIELDS``.
+Every event is one JSON object per line with four envelope fields —
+``v`` (schema version, currently 2), ``ts`` (unix seconds), ``mono``
+(``time.perf_counter()`` seconds: monotonic, so interval reconstruction
+— span timelines, event spacing — is immune to wall-clock jumps; only
+comparable within one process run, anchored to ``ts`` at ``run_start``),
+``event`` (type name) — plus the per-type payload listed in
+``EVENT_FIELDS``.  v1 journals (no ``mono``) still read and validate.
 An operator can ``tail -f`` a live run's journal (every line is flushed
 as it is written) or feed one or more finished/dead journals to
 ``specpride stats`` for an aggregate post-mortem.
@@ -20,10 +24,15 @@ import json
 import os
 import time
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
-# event type -> required payload fields (the envelope v/ts/event is implied;
-# extra fields are allowed — the schema is additive within a version)
+# versions read_events accepts: v2 added the monotonic `mono` envelope
+# field and the `span` event; v1 events remain valid (additive change)
+ACCEPTED_VERSIONS = frozenset({1, SCHEMA_VERSION})
+
+# event type -> required payload fields (the envelope v/ts/mono/event is
+# implied; extra fields are allowed — the schema is additive within a
+# version)
 EVENT_FIELDS: dict[str, frozenset] = {
     "run_start": frozenset({"command", "method", "backend", "n_clusters"}),
     "chunk_start": frozenset({"chunk_index", "n_clusters"}),
@@ -39,6 +48,10 @@ EVENT_FIELDS: dict[str, frozenset] = {
     "skipped_clusters": frozenset({"cluster_ids"}),
     "bench_run": frozenset({"method", "phases_s"}),
     "run_end": frozenset({"counters", "phases_s", "elapsed_s", "device"}),
+    # v2: one finished tracing span (observability.tracing).  The span's
+    # end time is the envelope `mono`; start = mono - dur_s.  Optional
+    # `labels` carries the per-span annotations (kernel, rows, ...).
+    "span": frozenset({"name", "dur_s", "depth"}),
 }
 
 
@@ -76,7 +89,12 @@ class Journal:
             pass
 
     def emit(self, event: str, **fields) -> dict:
-        rec = {"v": SCHEMA_VERSION, "ts": time.time(), "event": event}
+        rec = {
+            "v": SCHEMA_VERSION,
+            "ts": time.time(),
+            "mono": time.perf_counter(),
+            "event": event,
+        }
         rec.update(fields)
         self._fh.write(json.dumps(rec, default=_json_default) + "\n")
         return rec
@@ -121,10 +139,12 @@ def validate_event(rec: object) -> list[str]:
     problems: list[str] = []
     if not isinstance(rec, dict):
         return [f"event is not an object: {rec!r}"]
-    if rec.get("v") != SCHEMA_VERSION:
+    if rec.get("v") not in ACCEPTED_VERSIONS:
         problems.append(f"unsupported schema version {rec.get('v')!r}")
     if not isinstance(rec.get("ts"), (int, float)):
         problems.append("missing/non-numeric 'ts'")
+    if rec.get("v") == 2 and not isinstance(rec.get("mono"), (int, float)):
+        problems.append("missing/non-numeric 'mono' (required in v2)")
     event = rec.get("event")
     required = EVENT_FIELDS.get(event)
     if required is None:

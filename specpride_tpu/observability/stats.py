@@ -17,6 +17,8 @@ import sys
 import time
 from collections import defaultdict
 
+from specpride_tpu.observability import tracing
+
 logger = logging.getLogger("specpride_tpu")
 
 
@@ -63,9 +65,13 @@ class RunStats:
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        # every phase interval is also a tracing span: the span timeline
+        # covers 100% of phase-timer time by construction, so a Chrome
+        # trace always accounts for what the phase sums report
         t0 = time.perf_counter()
         try:
-            yield
+            with tracing.span(name):
+                yield
         finally:
             self.phases[name] += time.perf_counter() - t0
 

@@ -5,6 +5,10 @@ Accepts base journal paths (multi-host ``.part<id>`` shards resolve
 rank-aware like ``merge-parts``) or explicit files.  Exits non-zero on
 schema violations — CI runs this over a pipeline invocation's journal,
 so a silently drifting event schema fails the build instead of rotting.
+
+``--top-spans N`` additionally renders the N slowest tracing spans
+(self time, count, p50/p99) from the journals' v2 ``span`` events, so a
+perf regression is diagnosable without opening a trace UI.
 """
 
 from __future__ import annotations
@@ -13,6 +17,10 @@ import json
 import sys
 
 from specpride_tpu.observability.journal import expand_parts, read_events
+from specpride_tpu.observability.tracing import (
+    aggregate_spans,
+    render_top_spans,
+)
 
 
 def _split_runs(events: list[dict]) -> list[list[dict]]:
@@ -134,7 +142,8 @@ def _render_run(run: dict, out) -> None:
 
 
 def run_stats(
-    journal_paths: list[str], json_out: str | None = None, out=None
+    journal_paths: list[str], json_out: str | None = None, out=None,
+    top_spans: int = 0,
 ) -> int:
     out = out or sys.stdout
     files: list[str] = []
@@ -151,9 +160,11 @@ def run_stats(
 
     runs: list[dict] = []
     violations: list[str] = []
+    events_per_file: list[list[dict]] = []
     for path in files:
         events, bad = read_events(path)
         violations.extend(bad)
+        events_per_file.append(events)
         segments = _split_runs(events) or [[]]
         for i, seg in enumerate(segments):
             label = path if len(segments) == 1 else f"{path}#run{i}"
@@ -161,6 +172,9 @@ def run_stats(
 
     for run in runs:
         _render_run(run, out)
+    span_rows = aggregate_spans(events_per_file) if top_spans else []
+    if top_spans:
+        render_top_spans(span_rows, top_spans, out)
     totals = {
         "n_journals": len(files),
         "n_runs_complete": sum(r["complete"] for r in runs),
@@ -181,8 +195,11 @@ def run_stats(
             f"{totals['compile_count']} compiles", file=out,
         )
     if json_out:
+        agg = {"v": 1, "runs": runs, "totals": totals}
+        if top_spans:
+            agg["top_spans"] = span_rows[:top_spans]
         with open(json_out, "w", encoding="utf-8") as fh:
-            json.dump({"v": 1, "runs": runs, "totals": totals}, fh, indent=1)
+            json.dump(agg, fh, indent=1)
             fh.write("\n")
     if violations:
         for v in violations:

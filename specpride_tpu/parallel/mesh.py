@@ -23,6 +23,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from specpride_tpu.observability import tracing
+
 CLUSTER_AXIS = "clusters"
 
 
@@ -91,7 +93,11 @@ def shard_batch_arrays(mesh: Mesh, *arrays: np.ndarray) -> tuple[jax.Array, ...]
     ``pad_to_multiple``).  Returns committed sharded jax.Arrays; passing
     them into a jitted kernel makes XLA partition the whole program.
     """
-    out = []
-    for a in arrays:
-        out.append(jax.device_put(a, cluster_sharding(mesh, a.ndim)))
-    return tuple(out)
+    with tracing.span(
+        "h2d:shard", n_arrays=len(arrays),
+        bytes=int(sum(int(a.nbytes) for a in arrays)),
+    ):
+        out = []
+        for a in arrays:
+            out.append(jax.device_put(a, cluster_sharding(mesh, a.ndim)))
+        return tuple(out)

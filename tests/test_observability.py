@@ -490,6 +490,344 @@ class TestCliJournal:
 
 
 # ---------------------------------------------------------------------------
+# Hierarchical span tracing (v2)
+# ---------------------------------------------------------------------------
+
+class TestTracer:
+    def test_nested_spans_journal_valid_v2_events(self, tmp_path):
+        from specpride_tpu.observability import Tracer
+
+        jpath = tmp_path / "t.jsonl"
+        with Journal(jpath) as j:
+            tracer = Tracer(journal=j)
+            with tracer.span("outer", chunk=0):
+                with tracer.span("inner") as sp:
+                    sp.note(rows=7)
+        events, violations = read_events(str(jpath))
+        assert violations == []
+        # children close (and journal) before their parents
+        assert [(e["name"], e["depth"]) for e in events] == [
+            ("inner", 1), ("outer", 0)
+        ]
+        inner, outer = events
+        assert inner["labels"] == {"rows": 7}
+        assert outer["labels"] == {"chunk": 0}
+        # envelope: monotonic end time present, duration sane, nested
+        assert all(isinstance(e["mono"], float) for e in events)
+        assert inner["dur_s"] <= outer["dur_s"]
+
+    def test_complete_records_retroactive_span(self, tmp_path):
+        import time
+
+        from specpride_tpu.observability import Tracer
+
+        jpath = tmp_path / "t.jsonl"
+        with Journal(jpath) as j:
+            tracer = Tracer(journal=j)
+            t0 = time.perf_counter() - 0.25
+            tracer.complete("kernel:k1", t0, 0.25, compile=True)
+        events, violations = read_events(str(jpath))
+        assert violations == []
+        assert events[0]["name"] == "kernel:k1"
+        assert events[0]["dur_s"] == pytest.approx(0.25)
+        assert events[0]["labels"]["compile"] is True
+
+    def test_module_helpers_noop_without_tracer(self):
+        from specpride_tpu.observability import tracing
+
+        assert tracing.current().enabled is False
+        with tracing.span("anything", x=1) as sp:
+            sp.note(y=2)  # must not raise
+        tracing.current().complete("k", 0.0, 1.0)
+
+        calls = []
+
+        @tracing.traced("fn")
+        def fn(a):
+            calls.append(a)
+            return a * 2
+
+        assert fn(21) == 42 and calls == [21]
+
+    def test_set_current_returns_previous(self):
+        from specpride_tpu.observability import Tracer, tracing
+
+        t1 = Tracer()
+        prev = tracing.set_current(t1)
+        try:
+            assert prev.enabled is False
+            assert tracing.current() is t1
+            assert tracing.set_current(None) is t1
+            assert tracing.current().enabled is False
+        finally:
+            tracing.set_current(None)
+
+    def test_aggregate_spans_self_time_and_percentiles(self):
+        from specpride_tpu.observability.tracing import aggregate_spans
+
+        def span(name, start, dur):
+            return {"v": 2, "ts": start + dur, "mono": start + dur,
+                    "event": "span", "name": name,
+                    "dur_s": dur, "depth": 0}
+
+        # parent [0, 1.0] containing child [0.2, 0.5]: parent self time
+        # must exclude the contained child
+        events = [
+            span("child", 0.2, 0.3),
+            span("parent", 0.0, 1.0),
+            span("child", 2.0, 0.1),
+        ]
+        rows = {r["name"]: r for r in aggregate_spans([events])}
+        assert rows["parent"]["self_s"] == pytest.approx(0.7)
+        assert rows["parent"]["total_s"] == pytest.approx(1.0)
+        assert rows["child"]["count"] == 2
+        assert rows["child"]["self_s"] == pytest.approx(0.4)
+        assert rows["child"]["p50_s"] in (0.1, 0.3)
+        assert rows["child"]["max_s"] == pytest.approx(0.3)
+
+    def test_rank_of_path(self):
+        from specpride_tpu.observability.tracing import rank_of_path
+
+        assert rank_of_path("j.jsonl.part0") == 0
+        assert rank_of_path("j.jsonl.part00003") == 3
+        assert rank_of_path("j.jsonl", default=7) == 7
+
+
+class TestChromeTrace:
+    def run_traced_consensus(self, tmp_path):
+        out = tmp_path / "reps.mgf"
+        jpath = tmp_path / "run.jsonl"
+        tpath = tmp_path / "trace.json"
+        rc = cli_main([
+            "consensus", GOLDEN, str(out), "--method", "bin-mean",
+            "--backend", "tpu", "--journal", str(jpath),
+            "--chrome-trace", str(tpath),
+        ])
+        assert rc == 0
+        return jpath, tpath
+
+    def test_chrome_trace_is_wellformed(self, tmp_path):
+        _, tpath = self.run_traced_consensus(tmp_path)
+        trace = json.loads(tpath.read_text())
+        events = trace["traceEvents"]
+        assert events
+        for e in events:
+            assert {"ph", "ts", "pid"} <= set(e)
+        spans = [e for e in events if e["ph"] == "X"]
+        assert all(e["dur"] >= 0 for e in spans)
+        names = {e["name"] for e in spans}
+        assert {"parse", "compute", "write", "chunk"} <= names
+
+    def test_spans_cover_phase_timer_time(self, tmp_path):
+        """Acceptance bar: the trace's phase-named spans account for
+        >=95% of the summed phase-timer seconds (they are the same
+        intervals by construction — RunStats.phase opens a span)."""
+        jpath, tpath = self.run_traced_consensus(tmp_path)
+        events, _ = read_events(str(jpath))
+        end = next(e for e in events if e["event"] == "run_end")
+        phase_total = sum(end["phases_s"].values())
+        spans = [
+            e for e in json.loads(tpath.read_text())["traceEvents"]
+            if e["ph"] == "X" and e["name"] in end["phases_s"]
+        ]
+        span_total = sum(e["dur"] for e in spans) / 1e6
+        assert span_total >= 0.95 * phase_total
+
+    def test_journal_spans_match_kept_spans(self, tmp_path):
+        """`specpride trace` over the journal reconstructs exactly the
+        spans the in-process --chrome-trace export kept — including the
+        parse spans, which finish before the journal opens and replay
+        into it when it attaches (attach_journal)."""
+        jpath, tpath = self.run_traced_consensus(tmp_path)
+        recon = tmp_path / "recon.json"
+        rc = cli_main(["trace", str(jpath), "-o", str(recon)])
+        assert rc == 0
+        direct = sorted(
+            e["name"]
+            for e in json.loads(tpath.read_text())["traceEvents"]
+            if e["ph"] == "X"
+        )
+        rebuilt = sorted(
+            e["name"]
+            for e in json.loads(recon.read_text())["traceEvents"]
+            if e["ph"] == "X"
+        )
+        assert any(n.startswith("parse") for n in rebuilt)
+        assert rebuilt == direct
+
+    def test_kernel_spans_nest_inside_dispatch_phase(self, tmp_path, rng):
+        """Retroactive kernel:<name> spans must END no later than the
+        dispatch phase span that contained the call — time-containment
+        nesting (aggregate_spans self time, Perfetto) depends on it."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+        from specpride_tpu.observability import RunStats, Tracer
+        from specpride_tpu.observability import tracing
+
+        clusters = [
+            make_cluster(rng, f"c{i}", n_members=3, n_peaks=40)
+            for i in range(4)
+        ]
+        jpath = tmp_path / "k.jsonl"
+        backend = TpuBackend(layout="bucketized", journal=Journal(jpath))
+        prev = tracing.set_current(Tracer(journal=backend.journal))
+        try:
+            backend.stats = RunStats()
+            backend.run_bin_mean(clusters)
+        finally:
+            tracing.set_current(prev)
+            backend.journal.close()
+        events, violations = read_events(str(jpath))
+        assert violations == []
+        spans = [e for e in events if e["event"] == "span"]
+        kernels = [s for s in spans if s["name"].startswith("kernel:")]
+        dispatches = [s for s in spans if s["name"] == "dispatch"]
+        assert kernels and dispatches
+        tol = 1e-6  # dur_s is journaled at 1us precision
+        for k in kernels:
+            host = next(
+                (d for d in dispatches
+                 if d["mono"] - d["dur_s"] <= k["mono"] - k["dur_s"] + tol
+                 and k["mono"] <= d["mono"] + tol),
+                None,
+            )
+            assert host is not None, (
+                f"kernel span {k['name']} not contained by any "
+                f"dispatch phase span"
+            )
+
+    def test_trace_merges_rank_parts_onto_one_timeline(self, tmp_path):
+        from specpride_tpu.observability import Tracer
+
+        base = tmp_path / "multi.jsonl"
+        for rank in range(2):
+            with Journal(f"{base}.part{rank}") as j:
+                j.emit("run_start", command="consensus", method="bin-mean",
+                       backend="tpu", n_clusters=2)
+                tracer = Tracer(journal=j)
+                with tracer.span("compute"):
+                    pass
+        out = tmp_path / "merged.json"
+        # explicit shard names, as in the acceptance example
+        rc = cli_main([
+            "trace", f"{base}.part0", f"{base}.part1", "-o", str(out),
+        ])
+        assert rc == 0
+        events = json.loads(out.read_text())["traceEvents"]
+        spans = [e for e in events if e["ph"] == "X"]
+        assert sorted(e["pid"] for e in spans) == [0, 1]
+        # base path expands to the same shard pair
+        out2 = tmp_path / "merged2.json"
+        assert cli_main(["trace", str(base), "-o", str(out2)]) == 0
+        spans2 = [
+            e for e in json.loads(out2.read_text())["traceEvents"]
+            if e["ph"] == "X"
+        ]
+        assert sorted(e["pid"] for e in spans2) == [0, 1]
+
+    def test_trace_exits_nonzero_without_journals(self, tmp_path, capsys):
+        out = tmp_path / "none.json"
+        rc = cli_main([
+            "trace", str(tmp_path / "missing.jsonl"), "-o", str(out),
+        ])
+        assert rc == 1
+        assert not out.exists()
+
+    def test_trace_rejects_chrome_trace_input(self, tmp_path, capsys):
+        """Feeding `specpride trace` a --chrome-trace output (instead of
+        the journal it reads) must exit nonzero, not silently write a
+        span-less trace."""
+        _, tpath = self.run_traced_consensus(tmp_path)
+        capsys.readouterr()
+        out = tmp_path / "wrong.json"
+        rc = cli_main(["trace", str(tpath), "-o", str(out)])
+        assert rc == 1
+        assert "not" in capsys.readouterr().err.lower()
+
+    def test_torn_span_line_heals_and_drops_deterministically(
+        self, tmp_path, capsys
+    ):
+        """A run killed mid-`span`-write leaves a torn final line.  The
+        journal must reopen cleanly (resume appends on a fresh line) and
+        `specpride trace` must drop exactly the torn record — same trace
+        every time — while still rendering everything readable."""
+        from specpride_tpu.observability import Tracer
+
+        jpath = tmp_path / "killed.jsonl"
+        with Journal(jpath) as j:
+            j.emit("run_start", command="consensus", method="bin-mean",
+                   backend="tpu", n_clusters=4)
+            tracer = Tracer(journal=j)
+            with tracer.span("compute"):
+                pass
+        with open(jpath, "a", encoding="utf-8") as fh:
+            fh.write('{"v": 2, "ts": 9.9, "mono": 9.9, "event": "span", '
+                     '"name": "kern')  # torn: killed mid-write
+        # reopen heals the seam; the resumed run's events stay parseable
+        with Journal(jpath) as j:
+            j.emit("resume", n_done=4)
+        outs = []
+        for i in range(2):  # deterministic: identical trace both times
+            out = tmp_path / f"trace{i}.json"
+            rc = cli_main(["trace", str(jpath), "-o", str(out)])
+            assert rc == 0
+            outs.append(json.loads(out.read_text()))
+        assert outs[0] == outs[1]
+        err = capsys.readouterr().err
+        assert "dropped" in err and "invalid JSON" in err
+        spans = [
+            e for e in outs[0]["traceEvents"] if e["ph"] == "X"
+        ]
+        assert [e["name"] for e in spans] == ["compute"]  # torn span gone
+
+
+class TestTopSpans:
+    def test_stats_top_spans_table(self, tmp_path, capsys):
+        out = tmp_path / "reps.mgf"
+        jpath = tmp_path / "run.jsonl"
+        rc = cli_main([
+            "consensus", GOLDEN, str(out), "--method", "bin-mean",
+            "--backend", "tpu", "--journal", str(jpath),
+        ])
+        assert rc == 0
+        capsys.readouterr()
+        agg = tmp_path / "agg.json"
+        rc = cli_main([
+            "stats", str(jpath), "--top-spans", "10", "--json", str(agg),
+        ])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "TOP" in text and "self_s" in text and "p99_ms" in text
+        data = json.loads(agg.read_text())
+        rows = data["top_spans"]
+        assert rows and {"name", "count", "total_s", "self_s",
+                         "p50_s", "p99_s", "max_s"} <= set(rows[0])
+        # sorted by self time, descending
+        selfs = [r["self_s"] for r in rows]
+        assert selfs == sorted(selfs, reverse=True)
+
+    def test_stats_top_spans_still_fails_on_violations(self, tmp_path):
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(
+            '{"v": 2, "ts": 1.0, "mono": 1.0, "event": "span", '
+            '"name": "x", "dur_s": 0.5, "depth": 0}\n'
+            '{"v": 1, "ts": 1.0, "event": "made_up_event"}\n'
+        )
+        assert run_stats([str(bad)], top_spans=5) == 1
+
+    def test_span_event_requires_fields(self):
+        assert validate_event(
+            {"v": 2, "ts": 1.0, "mono": 1.0, "event": "span",
+             "name": "x", "dur_s": 0.1, "depth": 0}
+        ) == []
+        assert validate_event(
+            {"v": 2, "ts": 1.0, "mono": 1.0, "event": "span", "name": "x"}
+        )  # missing dur_s/depth
+        assert validate_event(
+            {"v": 2, "ts": 1.0, "event": "resume", "n_done": 1}
+        )  # v2 requires mono
+
+
+# ---------------------------------------------------------------------------
 # Event spec hygiene
 # ---------------------------------------------------------------------------
 
