@@ -246,6 +246,122 @@ def bench_end_to_end(clusters, workdir: str, runs: int = 2) -> dict:
     }
 
 
+def bench_prefetch_sweep(
+    clusters, workdir: str, prefetches=(0, 1, 2, 4)
+) -> list[dict]:
+    """Pipelined chunk executor (``cli._checkpointed_run`` + ``--prefetch``)
+    measured end to end through the CLI, per method x prefetch depth.
+
+    Every run chunks identically (``--checkpoint-every 256``) so serial
+    and pipelined schedules process the same worklist; outputs are byte-
+    compared against the prefetch-0 run.  Two rates per row: ``wall``
+    includes the upfront eager parse (identical across depths, so it
+    dilutes the speedup), ``executor`` is the post-parse chunk loop the
+    pipeline actually changed.  ``overlap_efficiency`` = 1 −
+    device_idle/wall from the run journal's pipeline summary."""
+    import os
+
+    from specpride_tpu.cli import main as cli_main
+    from specpride_tpu.io.mgf import write_mgf
+
+    src = os.path.join(workdir, "prefetch_clustered.mgf")
+    if not os.path.exists(src):
+        write_mgf([s for c in clusters for s in c.members], src)
+    rows = []
+    for method, command in (
+        ("bin-mean", "consensus"),
+        ("gap-average", "consensus"),
+        ("medoid", "select"),
+    ):
+        base_bytes = base_exec = None
+        for p in prefetches:
+            tag = f"{method.replace('-', '_')}_p{p}"
+            out = os.path.join(workdir, f"pf_{tag}.mgf")
+            journal = os.path.join(workdir, f"pf_{tag}.jsonl")
+            t0 = time.perf_counter()
+            rc = cli_main([
+                command, src, out, "--method", method,
+                "--prefetch", str(p),
+                "--checkpoint", os.path.join(workdir, f"pf_{tag}.ck.json"),
+                "--checkpoint-every", "256",
+                "--journal", journal,
+            ])
+            wall = time.perf_counter() - t0
+            assert rc == 0
+            with open(journal) as fh:
+                events = [json.loads(line) for line in fh]
+            end = [e for e in events if e["event"] == "run_end"][-1]
+            pipe = end.get("pipeline") or {}
+            executor_s = end["elapsed_s"] - end["phases_s"].get("parse", 0.0)
+            data = open(out, "rb").read()
+            if base_bytes is None:
+                base_bytes, base_exec = data, executor_s
+            row = {
+                "method": method,
+                "prefetch": p,
+                "wall_s": round(wall, 3),
+                "clusters_per_sec_wall": round(len(clusters) / wall, 2),
+                "executor_s": round(executor_s, 3),
+                "clusters_per_sec_executor": round(
+                    len(clusters) / executor_s, 2
+                ),
+                "executor_speedup_vs_serial": round(base_exec / executor_s, 3),
+                "device_idle_s": pipe.get("device_idle_s"),
+                "overlap_efficiency": pipe.get("overlap_efficiency"),
+                "identical_to_serial": data == base_bytes,
+            }
+            rows.append(row)
+            eprint(
+                f"[prefetch:{method} p={p}] wall "
+                f"{row['clusters_per_sec_wall']:.0f} cl/s, executor "
+                f"{row['clusters_per_sec_executor']:.0f} cl/s "
+                f"({row['executor_speedup_vs_serial']}x vs serial), "
+                f"idle={row['device_idle_s']} "
+                f"overlap={row['overlap_efficiency']} "
+                f"identical={row['identical_to_serial']}"
+            )
+    return rows
+
+
+def bench_medoid_d2h(clusters) -> dict:
+    """Medoid device path D2H bytes: index-only selection
+    (``medoid_device_select``, the default) vs the count-matrix fetch it
+    replaced — the acceptance bar is a >= 10x byte drop."""
+    from specpride_tpu.backends.tpu_backend import TpuBackend
+    from specpride_tpu.config import BatchConfig
+
+    out: dict = {}
+    for select, key in ((True, "index_only"), (False, "count_matrix")):
+        backend = TpuBackend(
+            batch_config=BatchConfig(clusters_per_batch=4096),
+            layout="bucketized",
+            medoid_device_select=select,
+        )
+        t0 = time.perf_counter()
+        reps = backend.run_medoid(clusters)
+        assert len(reps) == len(clusters)
+        out[key] = {
+            "d2h_bytes": int(
+                backend.metrics.counter(
+                    "specpride_bytes_d2h_total",
+                    "bytes fetched device->host",
+                ).value()
+            ),
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+    out["d2h_reduction_x"] = round(
+        out["count_matrix"]["d2h_bytes"]
+        / max(out["index_only"]["d2h_bytes"], 1),
+        1,
+    )
+    eprint(
+        f"[medoid d2h] index-only {out['index_only']['d2h_bytes']} B vs "
+        f"counts {out['count_matrix']['d2h_bytes']} B "
+        f"({out['d2h_reduction_x']}x fewer)"
+    )
+    return out
+
+
 def bench_sweep(clusters, backend, nb) -> dict:
     """BASELINE configs[3]: the ppm-tolerance grid sweep and the sqrt/log
     intensity-normalization sweep.  Grid rows time the bin-mean method on
@@ -253,7 +369,7 @@ def bench_sweep(clusters, backend, nb) -> dict:
     run); normalization rows time the fused pipeline per transform and
     record the mean QC cosine so the knob's effect is visible."""
     from specpride_tpu.config import BinMeanConfig, CosineConfig
-    from specpride_tpu.utils.observe import RunStats
+    from specpride_tpu.observability import RunStats
 
     grid_rows = []
     for label, cfg in [
@@ -509,10 +625,14 @@ def main() -> None:
                 report["methods"].append(entry)
                 gc.collect()
             report["sweep"] = bench_sweep(clusters, backend, nb)
+            report["medoid_d2h"] = bench_medoid_d2h(clusters)
             import tempfile
 
             with tempfile.TemporaryDirectory() as workdir:
                 report["end_to_end"] = bench_end_to_end(clusters, workdir)
+                report["prefetch_sweep"] = bench_prefetch_sweep(
+                    clusters, workdir
+                )
             ab = pallas_ab(clusters)
             if ab is not None:
                 report["pallas_ab"] = ab

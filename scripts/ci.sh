@@ -49,6 +49,34 @@ print("multi-host trace merge OK")
 EOF
 rm -rf "$obs_tmp"
 
+echo "== pipelined executor: --prefetch 2 parity + pipeline telemetry =="
+# the pipelined chunk executor must produce byte-identical output to the
+# serial path, and its journal must carry `pipeline` spans plus a
+# device_idle_s summary in run_end (docs/performance.md)
+pf_tmp=$(mktemp -d)
+for P in 0 2; do
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        consensus tests/data/golden_clustered.mgf "$pf_tmp/reps_p$P.mgf" \
+        --method bin-mean --backend tpu --prefetch "$P" \
+        --checkpoint "$pf_tmp/ck_p$P.json" --checkpoint-every 1 \
+        --journal "$pf_tmp/run_p$P.jsonl"
+done
+cmp "$pf_tmp/reps_p0.mgf" "$pf_tmp/reps_p2.mgf"
+python - "$pf_tmp/run_p2.jsonl" <<'EOF'
+import json, sys
+events = [json.loads(l) for l in open(sys.argv[1])]
+spans = [e for e in events if e["event"] == "span"
+         and e["name"].startswith("pipeline")]
+assert spans, "no pipeline spans in the prefetch journal"
+end = [e for e in events if e["event"] == "run_end"][-1]
+pipe = end.get("pipeline") or {}
+assert "device_idle_s" in pipe, f"run_end missing pipeline.device_idle_s: {end}"
+assert end["phases_s"].get("pack", 0) > 0, "packer time not journaled as pack"
+print(f"pipeline OK: {len(spans)} pipeline spans, "
+      f"device_idle_s={pipe['device_idle_s']}")
+EOF
+rm -rf "$pf_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

@@ -422,6 +422,25 @@ def _count_run(method: str, n: int) -> None:
     ).inc(n, method=method)
 
 
+def prepare_chunk(method, clusters, config, cos_config=None, stats=None):
+    """Two-phase chunk protocol, oracle side: the numpy backend has no
+    pack stage — every ``run_*`` below is a per-cluster loop with no
+    device inputs to build — so phase 1 is always empty and the pipelined
+    CLI executor falls back to the one-shot path.  It still wins on
+    streamed inputs: chunk MATERIALIZATION (the MGF window parse) runs on
+    the packer thread either way.  Mirrors ``TpuBackend.prepare_chunk``
+    so callers can duck-type both backends."""
+    return None
+
+
+def supports_prepare(method) -> bool:
+    """The other half of the duck-typed protocol (see
+    ``TpuBackend.supports_prepare``): never — so the executor keeps the
+    oracle's historical single-chunk execution instead of forcing
+    checkpoint-interval chunking for zero overlap gain."""
+    return False
+
+
 @tracing.traced("method:bin_mean", backend="numpy")
 def run_bin_mean(clusters: list[Cluster], config: BinMeanConfig = BinMeanConfig()) -> list[Spectrum]:
     """Per-cluster loop of ref src/binning.py:291-297."""

@@ -206,6 +206,26 @@ def _pad_axis0(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
 
 
 @dataclasses.dataclass
+class PreparedChunk:
+    """Host-side pack product of ``TpuBackend.prepare_chunk`` — phase 1 of
+    the two-phase chunk protocol the pipelined CLI executor drives.
+
+    Everything in ``data`` is pure host numpy output (packed batches,
+    cosine member prep, ordered-peak views): building it touches no device
+    and no backend mutable state beyond the ``stats`` object the caller
+    passed, so it is safe to construct on the executor's background packer
+    thread while the consumer thread dispatches the previous chunk.
+    ``run_prepared`` consumes it on the dispatch thread."""
+
+    method: str  # "bin-mean" | "gap-average" | "medoid"
+    kind: str  # concrete execution path the data was packed for
+    clusters: list
+    config: object
+    cos_config: object | None = None
+    data: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class TpuBackend:
     """Device-execution backend (``--backend=tpu``).
 
@@ -244,6 +264,11 @@ class TpuBackend:
     journal: object = dataclasses.field(
         default_factory=NullJournal, repr=False
     )
+    # medoid: finalize the winning member index ON DEVICE and fetch one
+    # int32 per cluster instead of the (B, M, M) uint16 count matrices
+    # (device f32 finalize; see ops.similarity.medoid_select_packed for
+    # the tie semantics).  False restores the host-f64 count finalize.
+    medoid_device_select: bool = True
     # pack-waste accounting is an O(rows*k) host reduction per dispatch
     # (the lazy ``real_elems`` callables below), so it runs only when the
     # numbers are consumed: a journal is attached, or the CLI flips this
@@ -443,6 +468,97 @@ class TpuBackend:
         self._note_d2h(out)
         return out
 
+    # -- two-phase chunk protocol (pipelined CLI executor) ---------------
+
+    def prepare_chunk(
+        self, method: str, clusters: list[Cluster], config,
+        cos_config=None, stats: RunStats | None = None,
+    ) -> PreparedChunk | None:
+        """Phase 1: build every host-side packed input ``method`` needs,
+        off the dispatch thread.
+
+        The pipelined executor calls this from its background packer
+        thread with a PRIVATE ``stats`` (merged into the run's stats at
+        handoff, so packer time is attributed to the ``pack`` phase
+        instead of being swallowed into the consumer's ``compute`` wall
+        time).  Only pure host work happens here — tables, flat packs,
+        cosine member prep — never a device dispatch or a mutation of
+        backend state.
+
+        Returns ``None`` when the method/path has no pack stage worth
+        splitting: mesh and bucketized layouts interleave packing with
+        per-bucket dispatch, best-spectrum is a trivial join, and the
+        device medoid path packs per bucket.  Callers then fall back to
+        the one-shot ``run_*`` entry points (the executor still wins by
+        materializing the chunk's clusters ahead of time)."""
+        if not self.supports_prepare(method) or not clusters:
+            return None
+        st = stats if stats is not None else self.stats
+        if method == "bin-mean":
+            return self._prepare_bin_mean(clusters, config, cos_config, st)
+        if method == "gap-average":
+            return self._prepare_gap_average(clusters, config, st)
+        if method == "medoid":
+            return self._prepare_medoid(clusters, config, st)
+        return None
+
+    def supports_prepare(self, method: str) -> bool:
+        """True when ``prepare_chunk`` has a real pack stage for ``method``
+        on this backend's configuration — the pipelined executor uses this
+        to decide whether forcing chunked execution buys any overlap.
+        Must mirror the serial path selection exactly: medoid is prepared
+        only on the layout="auto" native path, because layouts that force
+        the device kernel must keep using it under prefetch (identical
+        outputs at every depth is the executor's contract)."""
+        if self.mesh is not None or self.layout == "bucketized":
+            return False
+        if method in ("bin-mean", "gap-average"):
+            return True
+        if method == "medoid":
+            from specpride_tpu.ops import medoid_native
+
+            return self.layout == "auto" and medoid_native.available()
+        return False
+
+    def run_prepared(
+        self, prepared: PreparedChunk
+    ) -> tuple[list[Spectrum], np.ndarray | None]:
+        """Phase 2: dispatch + finalize a ``prepare_chunk`` product on the
+        caller's (dispatch) thread.  Returns ``(representatives,
+        cosines-or-None)`` — cosines only for the fused bin-mean + QC
+        path, mirroring ``run_bin_mean_with_cosines``.
+
+        Opens the SAME ``method:*`` span the one-shot ``run_*`` entry
+        points are decorated with (oracle and device traces must diff
+        cleanly whether or not a run was pipelined); under prefetch the
+        span covers the compute stage only — pack time lives in the
+        packer lane's ``pipeline:pack`` spans."""
+        if prepared.method == "bin-mean":
+            name = (
+                "method:bin_mean_with_cosines"
+                if prepared.cos_config is not None else "method:bin_mean"
+            )
+            with tracing.span(name, backend="tpu", prepared=True):
+                return self._finish_bin_mean(prepared)
+        if prepared.method == "gap-average":
+            with tracing.span(
+                "method:gap_average", backend="tpu", prepared=True
+            ):
+                return self._finish_gap_average(prepared), None
+        if prepared.method == "medoid":
+            with tracing.span(
+                "method:medoid", backend="tpu", prepared=True
+            ):
+                indices = self._finish_medoid_indices(prepared)
+                return (
+                    [
+                        c.members[i]
+                        for c, i in zip(prepared.clusters, indices)
+                    ],
+                    None,
+                )
+        raise ValueError(prepared.method)
+
     # -- binned-mean consensus (K1) -------------------------------------
 
     # method-level spans share names with the numpy oracle's (labeled
@@ -464,14 +580,16 @@ class TpuBackend:
         from specpride_tpu.data.packed import pack_bucketize_bin_mean
         from specpride_tpu.ops.binning import bin_mean_deduped_compact
 
+        if self.mesh is None and self.layout != "bucketized":
+            # host ("auto") / flat-device paths; validation happens in the
+            # shared pack stage (_prepare_bin_mean)
+            return self._finish_bin_mean(
+                self._prepare_bin_mean(clusters, config, None, self.stats)
+            )[0]
+
         _check_no_empty(clusters)
         for c in clusters:
             numpy_backend.check_uniform_charge(c.members)
-
-        if self.mesh is None and self.layout == "auto":
-            return self._run_bin_mean_host(clusters, config)
-        if self.mesh is None and self.layout != "bucketized":
-            return self._run_bin_mean_flat(clusters, config)
 
         out: list[Spectrum | None] = [None] * len(clusters)
         pending = []
@@ -546,12 +664,115 @@ class TpuBackend:
                     title=batch.cluster_ids[lo + ci],
                 )
 
-    def _run_bin_mean_flat(
-        self, clusters: list[Cluster], config: BinMeanConfig
-    ) -> list[Spectrum]:
-        """Flat zero-padding K1 path (see ``data.packed.FlatBinBatch``)."""
-        pending = self._bin_mean_flat_dispatch(clusters, config)
-        return self._bin_mean_flat_finish(pending, clusters)
+    def _prepare_bin_mean(
+        self, clusters: list[Cluster], config: BinMeanConfig,
+        cos_config, st: RunStats, member_prep: bool = True,
+    ) -> PreparedChunk:
+        """Pack stage shared by the host ("auto") and flat-device K1
+        paths: input validation, the flat zero-padding pack, and — when a
+        fused QC is requested — the representative-independent half of
+        the cosine prep.  Under the pipelined executor all of this runs
+        on the packer thread.  ``member_prep=False`` defers the flat
+        member-cosine prep to ``_finish_bin_mean`` — serial callers pass
+        it so that prep keeps overlapping the in-flight D2H stream as it
+        did before the split (the pipelined executor preps eagerly
+        instead, overlapping the previous chunk's dispatch)."""
+        from specpride_tpu.data.packed import _as_table, pack_flat_bin_mean
+
+        _check_no_empty(clusters)
+        for c in clusters:
+            numpy_backend.check_uniform_charge(c.members)
+        kind = "bin_mean_host" if self.layout == "auto" else "bin_mean_flat"
+        native = False
+        if kind == "bin_mean_host" and cos_config is not None:
+            from specpride_tpu.ops import cosine_native
+
+            native = cosine_native.available()
+        data: dict = {}
+        with st.phase("pack"):
+            table = _as_table(clusters)
+            data["batches"] = pack_flat_bin_mean(
+                table, config, max_elements=self.max_grid_elements // 4
+            )
+            if cos_config is not None:
+                if native:
+                    data["mprep"] = self._prep_cosine_native(
+                        table, cos_config
+                    )
+                elif member_prep:
+                    # host consensus without the C++ cosine, or the flat
+                    # device layout: the device flat cosine path's member
+                    # half (rep half needs the representatives)
+                    data["mprep_flat"] = self._prep_cosine_members(
+                        clusters, cos_config
+                    )
+        return PreparedChunk(
+            "bin-mean", kind, clusters, config, cos_config, data
+        )
+
+    def _finish_bin_mean(
+        self, prepared: PreparedChunk
+    ) -> tuple[list[Spectrum], np.ndarray | None]:
+        """Compute stage for ``_prepare_bin_mean`` output: host run
+        reductions (+ interleaved native QC cosines) on the "auto"
+        layout, device dispatch + async D2H on the flat layout."""
+        clusters, config = prepared.clusters, prepared.config
+        ccfg = prepared.cos_config
+        batches = prepared.data["batches"]
+        st = self.stats
+        if prepared.kind == "bin_mean_flat":
+            pending = self._dispatch_flat_batches(batches, config)
+            mprep_flat = prepared.data.get("mprep_flat")
+            if ccfg is not None and mprep_flat is None:
+                # deferred (serial) member prep: runs while the bin-mean
+                # kernel and its async D2H stream are in flight
+                with st.phase("pack"):
+                    mprep_flat = self._prep_cosine_members(clusters, ccfg)
+            reps = self._bin_mean_flat_finish(pending, clusters)
+            if ccfg is None:
+                return reps, None
+            return reps, self._cosines_from_member_prep(
+                reps, mprep_flat, ccfg
+            )
+        # host path: per-chunk host run reductions; the native C++ cosine
+        # interleaves per batch so the working set stays in cache (the
+        # measured mesh-less winner — see run_gap_average for the link
+        # economics that make host reductions beat device round trips)
+        out: list[Spectrum | None] = [None] * len(clusters)
+        mprep = prepared.data.get("mprep")
+        cosines = (
+            np.zeros(len(clusters), dtype=np.float64)
+            if mprep is not None else None
+        )
+        for batch in batches:
+            self._host_bin_mean_chunk(batch, config, clusters, out)
+            if mprep is not None:
+                lo = batch.source_indices[0]
+                hi = batch.source_indices[-1] + 1
+                with st.phase("compute"):
+                    cosines[lo:hi] = self._cosine_native_rows(
+                        out[lo:hi], mprep, ccfg, lo, hi
+                    )
+        st.count("clusters", len(clusters))
+        reps = [s for s in out if s is not None]
+        if ccfg is not None and mprep is None:
+            # no C++ cosine built: device flat cosine over the host reps
+            mprep_flat = prepared.data.get("mprep_flat")
+            if mprep_flat is None:  # deferred by a serial caller
+                with st.phase("pack"):
+                    mprep_flat = self._prep_cosine_members(clusters, ccfg)
+            cosines = self._cosines_from_member_prep(
+                reps, mprep_flat, ccfg
+            )
+        return reps, cosines
+
+    def _cosines_from_member_prep(
+        self, reps: list[Spectrum], mprep_flat: dict, ccfg: CosineConfig
+    ) -> np.ndarray:
+        """Finish the flat device cosine from a prepacked member half."""
+        with self.stats.phase("pack"):
+            prep = self._prep_cosine_reps(reps, mprep_flat, ccfg)
+        return self._dispatch_cosine_flat(prep)
 
     def _flat_chunk_dispatch(self, batch, config: BinMeanConfig):
         """One flat chunk: host run pass (counts, oracle-exact quorum,
@@ -660,49 +881,22 @@ class TpuBackend:
         with st.phase("finalize"):
             self._emit_bin_mean_rows(batch, kept_int, aux, clusters, out)
 
-    def _run_bin_mean_host(
-        self, clusters: list[Cluster], config: BinMeanConfig
-    ) -> list[Spectrum]:
-        """Full host K1 (mesh-less ``layout="auto"`` — the measured
-        choice, same economics as gap-average): after the packer's sorted
-        pass, the per-run host work already includes counts, quorum and
-        m/z means; the only remaining reduction is ONE intensity reduceat
-        (~20 ms for 2.8M peaks), ~20x cheaper than shipping ~25 MB over
-        the tunneled link for the device to do it (round-5 profile).  The
-        device flat path stays selectable (``layout="flat"``) and the
-        bucketized path carries mesh runs, where sharding changes the
-        economics."""
-        from specpride_tpu.data.packed import pack_flat_bin_mean
+    # NOTE on the host K1 economics (mesh-less ``layout="auto"``, the
+    # measured choice — round-5 profile): after the packer's sorted pass
+    # the per-run host work already includes counts, quorum and m/z means;
+    # the only remaining reduction is ONE intensity reduceat (~20 ms for
+    # 2.8M peaks), ~20x cheaper than shipping ~25 MB over the tunneled
+    # link for the device to do it.  The device flat path stays selectable
+    # (``layout="flat"``) and the bucketized path carries mesh runs, where
+    # sharding changes the economics.  Both now route through
+    # ``_prepare_bin_mean`` / ``_finish_bin_mean``.
 
-        _check_no_empty(clusters)
-        for c in clusters:
-            numpy_backend.check_uniform_charge(c.members)
-        st = self.stats
-        with st.phase("pack"):
-            batches = pack_flat_bin_mean(
-                clusters, config, max_elements=self.max_grid_elements // 4
-            )
-        out: list[Spectrum | None] = [None] * len(clusters)
-        for batch in batches:
-            self._host_bin_mean_chunk(batch, config, clusters, out)
-        st.count("clusters", len(clusters))
-        return [s for s in out if s is not None]
-
-    def _bin_mean_flat_dispatch(
-        self, clusters: list[Cluster], config: BinMeanConfig
-    ):
-        """Pack + dispatch all chunks asynchronously and start their D2H
-        copies; returns the pending list for ``_bin_mean_flat_finish``."""
-        from specpride_tpu.data.packed import pack_flat_bin_mean
-
+    def _dispatch_flat_batches(self, batches, config: BinMeanConfig):
+        """Dispatch prepacked flat chunks asynchronously and start their
+        D2H copies; returns the pending list for
+        ``_bin_mean_flat_finish``."""
         pending = []
         st = self.stats
-        # the pack call is eager (one vectorized pass over all clusters), so
-        # time the call itself, not just iteration
-        with st.phase("pack"):
-            batches = pack_flat_bin_mean(
-                clusters, config, max_elements=self.max_grid_elements // 4
-            )
         for batch in batches:
             with st.phase("dispatch"):
                 fused, aux = self._flat_chunk_dispatch(batch, config)
@@ -764,35 +958,71 @@ class TpuBackend:
     ) -> list[Spectrum]:
         """Exact-f64 host consensus (see ``run_gap_average``): the
         multithreaded C++ grouping when built (``ops.gap_native``), else
-        one vectorized numpy pass.
+        one vectorized numpy pass — split into ``_prepare_gap_average``
+        (pack: table build + gathers / global segmentation) and
+        ``_finish_gap_average`` (grouping + finalize) so the pipelined
+        executor can pack ahead on its background thread.
 
-        Measured bound (round 5): the bench host exposes ONE cpu core
+        Measured bound (round 5): the bench host exposed ONE cpu core
         (``os.sched_getaffinity``), so the C++ path's modest ~1.3x over
-        the oracle is the single-core ceiling — its win is allocation
+        the oracle was the single-core ceiling — its win is allocation
         avoidance and cache locality, and the thread pool only pays off
         on multi-core hosts.  The remaining per-run cost splits roughly
         pack 0.10s (columnar table build + gathers) / compute 0.075s
         (C++ sort+group) / finalize 0.04s (Spectrum assembly) for 2000
-        clusters — no single component dominates."""
+        clusters — no single component dominates, which is exactly why
+        overlapping pack with compute across chunks pays."""
+        return self._finish_gap_average(
+            self._prepare_gap_average(clusters, config, self.stats)
+        )
+
+    def _prepare_gap_average(
+        self, clusters: list[Cluster], config: GapAverageConfig,
+        st: RunStats,
+    ) -> PreparedChunk:
+        """Pack stage of the host gap-average paths: the columnar table
+        plus either the native path's ordered-peak views or the full
+        vectorized f64 segmentation."""
         from specpride_tpu.data.packed import _as_table, gap_global_segments
         from specpride_tpu.ops import gap_native
 
         _check_no_empty(clusters)
-        get_pepmass, get_rt = numpy_backend.resolve_gap_estimators(config)
-        st = self.stats
-
-        if gap_native.available():
-            with st.phase("pack"):
-                table = _as_table(clusters)
-                idx = table.cluster_order()
+        data: dict = {}
+        with st.phase("pack"):
+            table = _as_table(clusters)
+            idx = table.cluster_order()
+            if gap_native.available():
+                kind = "gap_native"
                 # member-concatenation order per cluster (the oracle's
                 # input to its stable sort); zero-copy when contiguous
                 mz_c, int_c, _ = self._cluster_ordered_peaks(table, idx)
                 offs = np.zeros(table.n_clusters + 1, dtype=np.int64)
                 np.cumsum(idx.total_peaks, out=offs[1:])
+                data.update(idx=idx, mz_c=mz_c, int_c=int_c, offs=offs)
+            else:
+                kind = "gap_vector"
+                g = gap_global_segments(table, idx, config)
+                data.update(
+                    idx=idx, g=g, s_int=table.intensity[g["order"]]
+                )
+        return PreparedChunk("gap-average", kind, clusters, config, None, data)
+
+    def _finish_gap_average(
+        self, prepared: PreparedChunk
+    ) -> list[Spectrum]:
+        clusters, config = prepared.clusters, prepared.config
+        get_pepmass, get_rt = numpy_backend.resolve_gap_estimators(config)
+        st = self.stats
+        d = prepared.data
+        idx = d["idx"]
+        if prepared.kind == "gap_native":
+            from specpride_tpu.ops import gap_native
+
+            offs = d["offs"]
             with st.phase("compute"):
                 out_mz, out_int, out_counts = gap_native.gap_average_groups(
-                    mz_c, int_c, offs, idx.n_members.astype(np.int64),
+                    d["mz_c"], d["int_c"], offs,
+                    idx.n_members.astype(np.int64),
                     config.mz_accuracy,
                     config.tail_mode == "reference",
                     config.min_fraction, config.dyn_range,
@@ -820,13 +1050,10 @@ class TpuBackend:
                 st.count("clusters", len(clusters))
             return out
 
-        with st.phase("pack"):
-            table = _as_table(clusters)
-            idx = table.cluster_order()
-            g = gap_global_segments(table, idx, config)
-            order, s_cluster, s_mz = g["order"], g["s_cluster"], g["s_mz"]
-            n_groups = g["n_groups"]
-            s_int = table.intensity[order]
+        g = d["g"]
+        s_cluster, s_mz = g["s_cluster"], g["s_mz"]
+        n_groups = g["n_groups"]
+        s_int = d["s_int"]
 
         with st.phase("compute"):
             # per-group f64 sums over the globally sorted axis: group starts
@@ -959,17 +1186,27 @@ class TpuBackend:
     ) -> list[int]:
         """Per-cluster medoid member index (ref
         src/most_similar_representative.py:87-110 semantics): packed
-        occupancy scatter + batched gram matmul on device, exact float64
-        finalize on host."""
+        occupancy scatter + batched gram matmul on device; by default the
+        winning index is ALSO selected on device (``medoid_device_select``)
+        so D2H carries one int32 per cluster instead of (B, M, M) uint16
+        count matrices — with the count fetch the transfer was the medoid
+        path's largest cost on slow links.  ``medoid_device_select=False``
+        restores the count fetch + exact float64 host finalize."""
         from specpride_tpu.data.packed import pack_bucketize
-        from specpride_tpu.ops.similarity import medoid_finalize, shared_bins_packed
+        from specpride_tpu.ops.similarity import (
+            medoid_finalize,
+            medoid_select_packed,
+            shared_bins_packed,
+        )
 
-        _check_no_empty(clusters)
         if self.mesh is None and self.layout == "auto":
             from specpride_tpu.ops import medoid_native
 
             if medoid_native.available():
+                # validation happens in _prepare_medoid (shared with the
+                # pipelined prepare path) — no second scan here
                 return self._medoid_indices_native(clusters, config)
+        _check_no_empty(clusters)  # device path validates here
         out: list[int] = [0] * len(clusters)
         pending = []
         st = self.stats
@@ -1023,17 +1260,32 @@ class TpuBackend:
                         _pad_axis0(sbins[lo:hi], size, fill=2**30),
                         _pad_axis0(smm[lo:hi], size, fill=m),
                     )
+                    if self.medoid_device_select:
+                        # finalize inputs ride the same H2D put: tiny
+                        # (B, M) metadata vs the (B, M, M) counts they
+                        # replace on the D2H side.  Phantom rows carry
+                        # all-False masks -> argmin 0, sliced away below.
+                        args = args + (
+                            _pad_axis0(batch.n_peaks[lo:hi], size),
+                            _pad_axis0(batch.member_mask[lo:hi], size),
+                            _pad_axis0(batch.n_members[lo:hi], size, fill=1),
+                        )
                     args = (
                         self._ship(*args)
                         if self.mesh is not None
                         else self._put_batch(list(args))
                     )
-                    res = shared_bins_packed(*args, m=m, lcap=lcap)
+                    if self.medoid_device_select:
+                        res = medoid_select_packed(*args, m=m, lcap=lcap)
+                    else:
+                        res = shared_bins_packed(*args, m=m, lcap=lcap)
                     # slice on device first: D2H carries only real rows
                     res = res[: hi - lo]
                     dt = time.perf_counter() - t0  # see bin_mean: span nesting
                 self._note_dispatch(
-                    "shared_bins_packed", (size, k, m, lcap),
+                    "medoid_select_packed" if self.medoid_device_select
+                    else "shared_bins_packed",
+                    (size, k, m, lcap),
                     rows=hi - lo, padded_rows=size,
                     real_elems=lambda lo=lo, hi=hi: (smm[lo:hi] != m).sum(),
                     padded_elems=size * k,
@@ -1041,12 +1293,17 @@ class TpuBackend:
                 )
                 pending.append((batch, lo, hi, res))
 
-        shareds = self._collect([p[-1] for p in pending])
+        fetched = self._collect([p[-1] for p in pending])
         with st.phase("finalize"):
-            for (batch, lo, hi, _), shared in zip(pending, shareds):
+            for (batch, lo, hi, _), res in zip(pending, fetched):
+                if self.medoid_device_select:
+                    # res IS the winning index per cluster row
+                    for ci in range(hi - lo):
+                        out[batch.source_indices[lo + ci]] = int(res[ci])
+                    continue
                 # widen uint16 counts for the f64 finalize
                 idx = medoid_finalize(
-                    shared.astype(np.int64),
+                    res.astype(np.int64),
                     batch.n_peaks[lo:hi],
                     batch.member_mask[lo:hi],
                     batch.n_members[lo:hi],
@@ -1063,13 +1320,28 @@ class TpuBackend:
         clusters — mesh-less the link transfer dwarfs the gram matmul's
         FLOPs (round-4 bench: the device path spent more time in dispatch
         round trips than compute).  The float64 finalize is the SAME
-        ``medoid_finalize`` the device path uses, so both paths share one
-        fp semantics; the bucketized MXU path still carries mesh runs."""
+        ``medoid_finalize`` the device path uses (grouped by member count
+        in ``ops.medoid_native.finalize_indices``), so both paths share
+        one fp semantics; the bucketized MXU path still carries mesh
+        runs.  Split prepare/finish for the pipelined executor."""
+        prepared = self._prepare_medoid(clusters, config, self.stats)
+        if prepared is None:  # native lib raced away; callers checked
+            raise RuntimeError("native medoid not built (make -C native)")
+        return self._finish_medoid_indices(prepared)
+
+    def _prepare_medoid(
+        self, clusters: list[Cluster], config: MedoidConfig, st: RunStats
+    ) -> PreparedChunk | None:
+        """Pack stage of the native medoid path: the columnar table and
+        its cluster-ordered peak views.  Returns None when the C++
+        counter is unavailable — the bucketized device path packs per
+        bucket and stays one-shot."""
         from specpride_tpu.data.packed import _as_table
         from specpride_tpu.ops import medoid_native
-        from specpride_tpu.ops.similarity import medoid_finalize
 
-        st = self.stats
+        if not medoid_native.available():
+            return None
+        _check_no_empty(clusters)
         with st.phase("pack"):
             table = _as_table(clusters)
             idx = table.cluster_order()
@@ -1078,31 +1350,26 @@ class TpuBackend:
             np.cumsum(cnt, out=spec_offsets[1:])
             cso = np.zeros(table.n_clusters + 1, dtype=np.int64)
             np.cumsum(idx.n_members, out=cso[1:])
+        return PreparedChunk(
+            "medoid", "medoid_native", clusters, config, None,
+            dict(mz_c=mz_c, cnt=cnt, spec_offsets=spec_offsets, cso=cso),
+        )
+
+    def _finish_medoid_indices(self, prepared: PreparedChunk) -> list[int]:
+        from specpride_tpu.ops import medoid_native
+
+        d = prepared.data
+        st = self.stats
         with st.phase("compute"):
             shared_flat, out_offsets = medoid_native.shared_bin_counts(
-                mz_c, spec_offsets, cso, config.bin_size
+                d["mz_c"], d["spec_offsets"], d["cso"],
+                prepared.config.bin_size,
             )
         with st.phase("finalize"):
-            # identical math to the device path, grouped by member count:
-            # a single globally-padded (B, Mmax, Mmax) batch would inflate
-            # memory quadratically for every cluster off one big outlier
-            # (advisor r5) — equal-M groups stack with ZERO padding
-            m_per = np.diff(cso)
-            b = table.n_clusters
-            indices = np.zeros(b, dtype=np.int64)
-            for m in np.unique(m_per):
-                sel = np.flatnonzero(m_per == m)
-                g = sel.size
-                take = out_offsets[sel][:, None] + np.arange(m * m)
-                shared = shared_flat[take].reshape(g, m, m).astype(np.int64)
-                n_peaks = cnt[cso[sel][:, None] + np.arange(m)]
-                indices[sel] = medoid_finalize(
-                    shared,
-                    n_peaks,
-                    np.ones((g, m), dtype=bool),
-                    np.full(g, m, dtype=np.int64),
-                )
-        st.count("clusters", len(clusters))
+            indices = medoid_native.finalize_indices(
+                shared_flat, out_offsets, d["cnt"], d["cso"]
+            )
+        st.count("clusters", len(prepared.clusters))
         return [int(i) for i in indices]
 
     @tracing.traced("method:medoid", backend="tpu")
@@ -1273,68 +1540,16 @@ class TpuBackend:
             reps = self.run_bin_mean(clusters, bin_config)
             return reps, self.average_cosines(reps, clusters, cos_config)
 
-        _check_no_empty(clusters)
-        for c in clusters:
-            numpy_backend.check_uniform_charge(c.members)
-
-        if self.layout == "auto":
-            from specpride_tpu.ops import cosine_native
-
-            if cosine_native.available():
-                return self._run_pipeline_host(
-                    clusters, bin_config, cos_config
-                )
-            # no C++ cosine built: host consensus + device flat cosine
-            reps = self._run_bin_mean_host(clusters, bin_config)
-            return reps, self._average_cosines_flat(
-                reps, clusters, cos_config
-            )
-
-        st = self.stats
-        pending = self._bin_mean_flat_dispatch(clusters, bin_config)
-        with st.phase("pack"):
-            mprep = self._prep_cosine_members(clusters, cos_config)
-        reps = self._bin_mean_flat_finish(pending, clusters)
-        with st.phase("pack"):
-            prep = self._prep_cosine_reps(reps, mprep, cos_config)
-        cosines = self._dispatch_cosine_flat(prep)
+        # host ("auto") and flat layouts: one shared pack stage, then the
+        # kind-matched compute stage (host run reductions + interleaved
+        # native C++ cosine, or flat device dispatch + async D2H).
+        # member_prep=False: serially, the flat member-cosine prep belongs
+        # AFTER dispatch, hidden under the consensus D2H stream.
+        prepared = self._prepare_bin_mean(
+            clusters, bin_config, cos_config, self.stats, member_prep=False
+        )
+        reps, cosines = self._finish_bin_mean(prepared)
         return reps, cosines
-
-    def _run_pipeline_host(
-        self,
-        clusters: list[Cluster],
-        bin_config: BinMeanConfig,
-        cos_config: CosineConfig,
-    ) -> tuple[list[Spectrum], np.ndarray]:
-        """Fully host consensus+QC (mesh-less ``auto`` — the measured
-        choice): one packed sort pass, per-chunk host run reductions
-        (``_run_bin_mean_host``), and the native C++ cosine per chunk.
-        With host reductions ~20x cheaper than the link transfer they
-        would replace (see ``_run_bin_mean_host``), no device round trip
-        survives on this path; the chunk loop keeps the working set in
-        cache and matches the streaming-ingest window."""
-        from specpride_tpu.data.packed import _as_table, pack_flat_bin_mean
-
-        st = self.stats
-        with st.phase("pack"):
-            table = _as_table(clusters)
-            batches = pack_flat_bin_mean(
-                table, bin_config, max_elements=self.max_grid_elements // 4
-            )
-            mprep = self._prep_cosine_native(table, cos_config)
-
-        out: list[Spectrum | None] = [None] * len(clusters)
-        cosines = np.zeros(len(clusters), dtype=np.float64)
-        for batch in batches:
-            self._host_bin_mean_chunk(batch, bin_config, clusters, out)
-            lo = batch.source_indices[0]
-            hi = batch.source_indices[-1] + 1
-            with st.phase("compute"):
-                cosines[lo:hi] = self._cosine_native_rows(
-                    out[lo:hi], mprep, cos_config, lo, hi
-                )
-        st.count("clusters", len(clusters))
-        return [s for s in out if s is not None], cosines
 
     def _emit_bin_mean_rows(self, batch, fused, aux, clusters, out) -> None:
         """Assemble one flat chunk's Spectrum slots from the HOST m/z means

@@ -75,6 +75,34 @@ def _add_backend(p: argparse.ArgumentParser) -> None:
                    help="multi-host: this process's rank")
 
 
+def _add_execution(p: argparse.ArgumentParser) -> None:
+    """Chunked-execution flags shared VERBATIM by consensus and select
+    (checkpointing, the pipelined executor, failure policy, streamed
+    ingest) — one definition so the two commands can never drift."""
+    p.add_argument("--append", action="store_true",
+                   help="append to the output instead of replacing it")
+    p.add_argument("--checkpoint", help="resume manifest path")
+    p.add_argument("--checkpoint-every", type=int, default=512)
+    p.add_argument(
+        "--prefetch", type=int, default=2, metavar="N",
+        help="pipelined chunk executor: a background packer thread builds "
+        "up to N chunks' device inputs ahead of dispatch (bounded queue; "
+        "0 = serial; output is byte-identical either way — see "
+        "docs/performance.md)",
+    )
+    p.add_argument(
+        "--on-error", choices=["abort", "skip"], default="abort",
+        help="chunk failure handling: abort (default) or retry the chunk "
+        "cluster-by-cluster, log + record failures, and continue",
+    )
+    p.add_argument(
+        "--stream-clusters", default="auto", metavar="N|auto|off",
+        help="bounded-memory ingest: parse member spectra in windows of N "
+        "clusters off a byte index instead of loading the whole MGF "
+        "(default auto: streams inputs over 256 MB)",
+    )
+
+
 def _add_observability(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--journal", metavar="FILE",
@@ -336,10 +364,29 @@ def _bin_mean_config(args) -> BinMeanConfig:
     )
 
 
+def _method_config(method: str, args):
+    """The method's config object, built once per chunk — shared by the
+    serial ``_run_method`` and the pipelined executor's packer thread
+    (``TpuBackend.prepare_chunk`` takes the same object)."""
+    if method == "bin-mean":
+        return _bin_mean_config(args)
+    if method == "gap-average":
+        return GapAverageConfig(
+            mz_accuracy=args.mz_accuracy, dyn_range=args.dyn_range,
+            min_fraction=args.min_fraction, tail_mode=args.tail_mode,
+            pepmass=args.pepmass, rt=args.rt,
+        )
+    if method == "medoid":
+        return MedoidConfig(bin_size=args.xcorr_bin)
+    if method == "best":
+        return BestSpectrumConfig(px_accession=args.px_accession)
+    raise ValueError(method)
+
+
 def _run_method(backend, method: str, clusters, args, scores=None,
                 qc: list | None = None):
+    config = _method_config(method, args)
     if method == "bin-mean":
-        config = _bin_mean_config(args)
         if qc is not None and hasattr(backend, "run_bin_mean_with_cosines"):
             # fused consensus + QC: the cosine member prep overlaps the
             # consensus D2H stream (see TpuBackend.run_bin_mean_with_cosines)
@@ -350,23 +397,143 @@ def _run_method(backend, method: str, clusters, args, scores=None,
             return reps
         return backend.run_bin_mean(clusters, config)
     if method == "gap-average":
-        config = GapAverageConfig(
-            mz_accuracy=args.mz_accuracy, dyn_range=args.dyn_range,
-            min_fraction=args.min_fraction, tail_mode=args.tail_mode,
-            pepmass=args.pepmass, rt=args.rt,
-        )
         return backend.run_gap_average(clusters, config)
     if method == "medoid":
-        return backend.run_medoid(
-            clusters, MedoidConfig(bin_size=args.xcorr_bin)
-        )
+        return backend.run_medoid(clusters, config)
     if method == "best":
         if scores is None:
             scores = _load_scores(args)
-        return backend.run_best_spectrum(
-            clusters, scores, BestSpectrumConfig(px_accession=args.px_accession)
-        )
+        return backend.run_best_spectrum(clusters, scores, config)
     raise ValueError(method)
+
+
+class _ChunkItem:
+    """One unit of work flowing from the packer lane to the dispatch lane
+    of the pipelined chunk executor (or yielded inline when serial)."""
+
+    __slots__ = (
+        "index", "idxs", "part", "prepared", "pack_stats", "error", "wait_s"
+    )
+
+    def __init__(self, index: int, idxs: list[int]):
+        self.index = index
+        self.idxs = idxs
+        self.part = None  # materialized clusters (None if packing died)
+        self.prepared = None  # backend PreparedChunk (None = no split)
+        self.pack_stats = None  # packer-thread RunStats to merge at handoff
+        self.error = None  # exception raised while packing
+        self.wait_s = 0.0  # consumer starvation waiting for this item
+
+
+def _serial_chunks(clusters, worklist):
+    """--prefetch 0: materialize each chunk inline, exactly the pre-
+    pipeline execution order."""
+    for chunk_index, idxs in worklist:
+        item = _ChunkItem(chunk_index, idxs)
+        item.part = [clusters[i] for i in idxs]
+        yield item
+
+
+def _pipelined_chunks(
+    clusters, worklist, backend, method, args, prefetch: int, want_qc: bool
+):
+    """Producer–consumer pipeline over the chunk worklist.
+
+    A single background packer thread runs ahead of the dispatch lane:
+    it materializes each chunk's clusters (for streamed inputs this is
+    the MGF window parse) and runs the backend's host pack stage
+    (``prepare_chunk``), pushing finished chunks through a bounded queue
+    of depth ``prefetch``.  The consumer (this generator, resumed on the
+    caller's thread) pops in FIFO order, so chunk writes stay in input
+    order by construction and the crash-safety contract of
+    ``_checkpointed_run`` is untouched.
+
+    Threading contract: the packer touches only host numpy (tables, flat
+    packs, cosine member prep) plus a PRIVATE per-chunk RunStats; all
+    device dispatch, QC, writes and checkpointing stay on the consumer
+    thread.  Pack failures are delivered as ``item.error`` so
+    ``--on-error skip`` keeps its per-cluster serial-retry isolation; an
+    aborting consumer sets ``stop`` and drains the queue so the packer
+    can never deadlock on a full queue.
+
+    Telemetry: each pack runs under a ``pipeline:pack`` span (packer
+    lane); consumer starvation >= 1 ms is recorded as a
+    ``pipeline:idle`` span and summed into the run's ``device_idle_s``."""
+    import queue
+    import threading
+    import time as _time
+
+    q: queue.Queue = queue.Queue(maxsize=max(prefetch, 1))
+    stop = threading.Event()
+    config = _method_config(method, args)
+    cos_config = (
+        _cosine_config(args) if want_qc and method == "bin-mean" else None
+    )
+    prepare = getattr(backend, "prepare_chunk", None)
+
+    def _put(obj) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(obj, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _packer() -> None:
+        try:
+            for chunk_index, idxs in worklist:
+                if stop.is_set():
+                    return
+                item = _ChunkItem(chunk_index, idxs)
+                pack_stats = RunStats()
+                try:
+                    with tracing.span(
+                        "pipeline:pack", chunk_index=chunk_index,
+                        n_clusters=len(idxs),
+                    ):
+                        with pack_stats.phase("pack"):
+                            item.part = [clusters[i] for i in idxs]
+                        if prepare is not None:
+                            item.prepared = prepare(
+                                method, item.part, config,
+                                cos_config=cos_config, stats=pack_stats,
+                            )
+                except Exception as e:  # noqa: BLE001 - handed to consumer
+                    item.error = e
+                item.pack_stats = pack_stats
+                if not _put(item):
+                    return
+        finally:
+            _put(None)
+
+    t = threading.Thread(
+        target=_packer, name="specpride-packer", daemon=True
+    )
+    t.start()
+    try:
+        while True:
+            t0 = _time.perf_counter()
+            item = q.get()
+            waited = _time.perf_counter() - t0
+            if item is None:
+                break
+            item.wait_s = waited
+            if waited >= 1e-3:
+                # the dispatch lane sat starved waiting for the packer —
+                # visible as its own gap span on the trace timeline
+                tracing.current().complete(
+                    "pipeline:idle", t0, waited, chunk_index=item.index
+                )
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join()
 
 
 def _checkpointed_run(
@@ -379,7 +546,16 @@ def _checkpointed_run(
     manifest records {done ids, output byte size} atomically.  A crash in
     between leaves output past the manifest's recorded size; resume
     truncates back to that offset before appending, so the re-run chunk is
-    never duplicated (the advisor's r1 duplicate-append window)."""
+    never duplicated (the advisor's r1 duplicate-append window).
+
+    With ``--prefetch N`` (default 2) chunks flow through the pipelined
+    executor (``_pipelined_chunks``): a background packer thread
+    materializes and packs up to N chunks ahead while this thread
+    dispatches, QCs, writes and checkpoints the current one.  Results are
+    consumed in FIFO order, so the in-order append + manifest contract
+    above is preserved verbatim; ``--prefetch 0`` is the serial path.
+    Output is chunk-invariant (every method is per-cluster), so pipelined
+    and serial runs produce byte-identical files."""
     journal = journal if journal is not None else NullJournal()
     done: set[str] = set()
     output_bytes: int | None = None  # None: manifest predates offset tracking
@@ -458,12 +634,24 @@ def _checkpointed_run(
         # ref average_spectrum_clustering.py:183-184,198: mode 'wa'[append]
         first_write = False
     # chunk size: the checkpoint interval, else the stream window (so a
-    # streamed run stays memory-bounded even without --checkpoint), else
-    # everything at once
+    # streamed run stays memory-bounded even without --checkpoint), else —
+    # when the pipelined executor can actually pack this method ahead —
+    # the checkpoint interval anyway (one monolithic chunk would leave
+    # the packer nothing to run ahead of).  Backends/paths with no pack
+    # stage (numpy oracle, mesh/bucketized layouts, best-spectrum) keep
+    # the old single-chunk execution: forcing small chunks there would
+    # shrink device batches for zero overlap gain.
+    prefetch = max(int(getattr(args, "prefetch", 0) or 0), 0)
+    can_prepare = prefetch > 0 and getattr(
+        backend, "supports_prepare", lambda _m: False
+    )(method)
     chunk = (
         args.checkpoint_every
         if args.checkpoint
-        else getattr(clusters, "window", 0) or len(todo_idx) or 1
+        else getattr(clusters, "window", 0)
+        or (getattr(args, "checkpoint_every", 512) if can_prepare else 0)
+        or len(todo_idx)
+        or 1
     )
 
     if not todo_idx:
@@ -480,10 +668,33 @@ def _checkpointed_run(
     on_error = getattr(args, "on_error", "abort")
     import time as _time
 
-    for chunk_index, start in enumerate(range(0, len(todo_idx), chunk)):
-        part = [clusters[i] for i in todo_idx[start : start + chunk]]
+    worklist = [
+        (chunk_index, todo_idx[start : start + chunk])
+        for chunk_index, start in enumerate(range(0, len(todo_idx), chunk))
+    ]
+    # the pipeline needs >= 2 chunks to overlap anything; a single-chunk
+    # run takes the serial path so it never pays for a packer thread
+    pipelined = prefetch > 0 and len(worklist) > 1
+    if pipelined:
+        items = _pipelined_chunks(
+            clusters, worklist, backend, method, args, prefetch,
+            qc is not None,
+        )
+    else:
+        items = _serial_chunks(clusters, worklist)
+    idle_s = 0.0
+    loop_t0 = _time.perf_counter()
+
+    for item in items:
+        chunk_index, part = item.index, item.part
+        idle_s += item.wait_s
+        if item.pack_stats is not None:
+            # packer-thread time lands in the run's `pack` phase (NOT in
+            # the consumer's compute wall time), so the phase report and
+            # the compute+write throughput stay truthful under prefetch
+            stats.merge(item.pack_stats)
         journal.emit(
-            "chunk_start", chunk_index=chunk_index, n_clusters=len(part)
+            "chunk_start", chunk_index=chunk_index, n_clusters=len(item.idxs)
         )
         # the per-chunk span is the trace's unit of progress: everything a
         # chunk does (compute, QC, write, checkpoint) nests under it, so a
@@ -491,17 +702,29 @@ def _checkpointed_run(
         # (closed in the finally — an abort mid-chunk must not leak an
         # open span onto the tracer's per-thread stack)
         chunk_span = tracing.span(
-            "chunk", chunk_index=chunk_index, n_clusters=len(part)
+            "chunk", chunk_index=chunk_index, n_clusters=len(item.idxs)
         )
         chunk_span.__enter__()
         try:
             chunk_t0 = _time.perf_counter()
             n_qc_before = len(qc) if qc is not None else 0
             try:
-                with stats.phase("compute"):
-                    reps = _run_method(
-                        backend, method, part, args, scores=scores, qc=qc
-                    )
+                if item.error is not None:
+                    # a pack-stage failure surfaces here so --on-error
+                    # keeps one policy for the whole chunk lifecycle
+                    raise item.error
+                if item.prepared is not None:
+                    with stats.phase("compute"):
+                        reps, chunk_cosines = backend.run_prepared(
+                            item.prepared
+                        )
+                    if qc is not None and chunk_cosines is not None:
+                        _append_qc_rows(qc, part, chunk_cosines)
+                else:
+                    with stats.phase("compute"):
+                        reps = _run_method(
+                            backend, method, part, args, scores=scores, qc=qc
+                        )
             except (ValueError, RuntimeError) as e:
                 # per-chunk failure isolation (survey §5 failure
                 # detection): with --on-error skip, a chunk whose input is
@@ -511,6 +734,10 @@ def _checkpointed_run(
                 # silently
                 if on_error != "skip":
                     raise
+                if part is None:
+                    # the packer died while materializing this chunk; the
+                    # serial retry below needs the clusters themselves
+                    part = [clusters[i] for i in item.idxs]
                 logger.warning(
                     "chunk of %d clusters failed (%s); retrying one by one",
                     len(part), e,
@@ -605,6 +832,21 @@ def _checkpointed_run(
                 )
         finally:
             chunk_span.__exit__(None, None, None)
+    if pipelined:
+        # device_idle_s: time the dispatch lane sat starved waiting on the
+        # packer — the overlap shortfall.  Journaled in run_end (and
+        # surfaced by `specpride stats`) so the pipeline's win/loss is
+        # measurable per run: overlap_efficiency = 1 - idle / wall.
+        wall = _time.perf_counter() - loop_t0
+        stats.pipeline = {
+            "prefetch": prefetch,
+            "n_chunks": len(worklist),
+            "device_idle_s": round(idle_s, 4),
+            "wall_s": round(wall, 4),
+            "overlap_efficiency": (
+                round(1.0 - idle_s / wall, 4) if wall > 0 else None
+            ),
+        }
     if failed:
         logger.warning(
             "%d clusters failed and were skipped: %s%s",
@@ -789,6 +1031,11 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         representatives_written=stats.counters.get("representatives", 0),
         clusters_per_sec=round(stats.throughput("clusters"), 2),
         device=device,
+        # pipelined executor summary (absent on serial runs): prefetch
+        # depth, device_idle_s, overlap_efficiency — see _checkpointed_run
+        **({"pipeline": stats.pipeline} if getattr(
+            stats, "pipeline", None
+        ) else {}),
     )
     tracer = tracing.current()
     _restore_tracer(args)  # only uninstalls what this run installed
@@ -1095,26 +1342,12 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--single", action="store_true",
                     help="treat the whole input file as one cluster "
                          "(ref average_spectrum_clustering.py:172-176)")
-    pc.add_argument("--append", action="store_true",
-                    help="append to the output instead of replacing it")
-    pc.add_argument("--checkpoint", help="resume manifest path")
-    pc.add_argument("--checkpoint-every", type=int, default=512)
-    pc.add_argument(
-        "--on-error", choices=["abort", "skip"], default="abort",
-        help="chunk failure handling: abort (default) or retry the chunk "
-        "cluster-by-cluster, log + record failures, and continue",
-    )
+    _add_execution(pc)
     pc.add_argument(
         "--qc-report", metavar="FILE",
         help="also compute each representative's mean member cosine in the "
         "same pass (bin-mean: fused with the consensus dispatch) and write "
         "the per-cluster QC report here",
-    )
-    pc.add_argument(
-        "--stream-clusters", default="auto", metavar="N|auto|off",
-        help="bounded-memory ingest: parse member spectra in windows of N "
-        "clusters off a byte index instead of loading the whole MGF "
-        "(default auto: streams inputs over 256 MB)",
     )
     pc.add_argument(
         "--clusters",
@@ -1141,25 +1374,11 @@ def build_parser() -> argparse.ArgumentParser:
                                        "(default: basename of its 'file' column)")
     ps.add_argument("--px-accession", default="PXD004732")
     ps.add_argument("--xcorr-bin", type=float, default=0.1)
-    ps.add_argument("--append", action="store_true",
-                    help="append to the output instead of replacing it")
-    ps.add_argument("--checkpoint", help="resume manifest path")
-    ps.add_argument("--checkpoint-every", type=int, default=512)
-    ps.add_argument(
-        "--on-error", choices=["abort", "skip"], default="abort",
-        help="chunk failure handling: abort (default) or retry the chunk "
-        "cluster-by-cluster, log + record failures, and continue",
-    )
+    _add_execution(ps)
     ps.add_argument(
         "--qc-report", metavar="FILE",
         help="also compute each representative's mean member cosine and "
         "write the per-cluster QC report here",
-    )
-    ps.add_argument(
-        "--stream-clusters", default="auto", metavar="N|auto|off",
-        help="bounded-memory ingest: parse member spectra in windows of N "
-        "clusters off a byte index instead of loading the whole MGF "
-        "(default auto: streams inputs over 256 MB)",
     )
     ps.add_argument(
         "--clusters",

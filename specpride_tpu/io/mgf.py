@@ -233,8 +233,17 @@ class StreamedClusters:
                 cid, _ = parse_title(title)
                 by_id.setdefault(cid, []).append((begin, end))
             self._groups = list(by_id.items())
-        self._cache_lo = -1
-        self._cache: list[Cluster] = []
+        # TWO cached windows keyed by window start, not one: under the
+        # pipelined executor the packer thread materializes window W+1
+        # ahead while the consumer may re-walk window W cluster by
+        # cluster for its serial retry (--on-error skip) — a single slot
+        # would ping-pong and re-parse a full window per index access.
+        # Peak RSS stays O(index + 2 windows); the lock serializes the
+        # cache against the same two threads.
+        self._windows: dict[int, list[Cluster]] = {}
+        import threading
+
+        self._cache_lock = threading.RLock()
 
     @tracing.traced("parse:mgf_index")
     def _scan(self) -> list[tuple[str, int, int]]:
@@ -282,12 +291,27 @@ class StreamedClusters:
         if not 0 <= i < len(self._groups):
             raise IndexError(key)
         lo = (i // self.window) * self.window
-        if lo != self._cache_lo:
-            self._cache_lo = lo
-            self._cache = self._materialize(
-                self._groups[lo : lo + self.window]
-            )
-        return self._cache[i - lo]
+        with self._cache_lock:
+            cached = self._windows.get(lo)
+            if cached is not None:
+                # LRU touch: a window being re-walked by the consumer's
+                # retry must not be the one evicted by the packer's
+                # lookahead inserts (dict preserves insertion order)
+                self._windows.pop(lo)
+                self._windows[lo] = cached
+                return cached[i - lo]
+        # parse OUTSIDE the lock: holding it across a full window parse
+        # would stall the other pipeline lane's cache HITS for hundreds
+        # of ms — the very overlap the two-slot cache exists for.  Two
+        # threads racing on the same cold window parse it twice (wasted
+        # work, identical result); the re-check keeps one copy.
+        parsed = self._materialize(self._groups[lo : lo + self.window])
+        with self._cache_lock:
+            cached = self._windows.pop(lo, parsed)
+            while len(self._windows) >= 2:  # evict least-recently USED
+                self._windows.pop(next(iter(self._windows)))
+            self._windows[lo] = cached
+            return cached[i - lo]
 
     def __iter__(self):
         for i in range(len(self._groups)):

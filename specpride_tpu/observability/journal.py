@@ -22,6 +22,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import threading
 import time
 
 SCHEMA_VERSION = 2
@@ -74,6 +75,10 @@ class Journal:
 
     def __init__(self, path: str | os.PathLike):
         self.path = str(path)
+        # one journal is shared by the CLI thread, the pipelined executor's
+        # packer thread, and the fetch pool; a lock keeps each event line
+        # whole (TextIOWrapper gives no cross-thread write atomicity)
+        self._lock = threading.Lock()
         self._fh = open(self.path, "a", buffering=1, encoding="utf-8")
         # a kill mid-write leaves a torn final line with no newline; a
         # resumed run appending straight onto it would corrupt BOTH its
@@ -96,12 +101,15 @@ class Journal:
             "event": event,
         }
         rec.update(fields)
-        self._fh.write(json.dumps(rec, default=_json_default) + "\n")
+        line = json.dumps(rec, default=_json_default) + "\n"
+        with self._lock:
+            self._fh.write(line)
         return rec
 
     def close(self) -> None:
-        if not self._fh.closed:
-            self._fh.close()
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
 
     def __enter__(self) -> "Journal":
         return self

@@ -88,6 +88,13 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
                 "device_peak_bytes_in_use", 0
             ),
         )
+        pipeline = end.get("pipeline")
+        if pipeline:
+            # pipelined chunk executor (--prefetch): how starved the
+            # dispatch lane was while the packer thread ran ahead
+            run["prefetch"] = pipeline.get("prefetch")
+            run["device_idle_s"] = pipeline.get("device_idle_s")
+            run["overlap_efficiency"] = pipeline.get("overlap_efficiency")
     else:
         # dead run: the heartbeats are all we have — surface the last one
         run["compile_count"] = compiles
@@ -130,6 +137,12 @@ def _render_run(run: dict, out) -> None:
             "  phases: "
             + " ".join(f"{k}={v:.3f}s" for k, v in sorted(phases.items())),
             file=out,
+        )
+    if run.get("device_idle_s") is not None:
+        print(
+            f"  pipeline: prefetch={run.get('prefetch')} "
+            f"device_idle_s={run['device_idle_s']:.3f} "
+            f"overlap_efficiency={run.get('overlap_efficiency')}", file=out,
         )
     print(
         f"  device: compile_count={run['compile_count']} "

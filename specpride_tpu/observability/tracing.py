@@ -171,7 +171,18 @@ class Tracer:
 
     def _record(self, name: str, mono_end: float, dur_s: float,
                 depth: int, labels: dict) -> None:
-        rec = {"name": name, "dur_s": round(dur_s, 6), "depth": depth}
+        # `tid`: the recording thread's lane.  The pipelined executor's
+        # packer thread emits spans that GENUINELY overlap the dispatch
+        # lane's — without a lane id the Chrome view would stack both
+        # lanes on one track and time-containment nesting
+        # (aggregate_spans) would credit a packer span running inside a
+        # consumer span's interval as its child.  Spans are recorded on
+        # their owning thread (Span.__exit__) or the dispatching thread
+        # (complete()), so the current thread's lane is the right one.
+        rec = {
+            "name": name, "dur_s": round(dur_s, 6), "depth": depth,
+            "tid": _lane_of_thread(),
+        }
         if labels:
             rec["labels"] = dict(labels)
         # the envelope `mono` must be the span's END, not the emit time:
@@ -192,6 +203,22 @@ class Tracer:
         meta = [_chrome_process_meta(pid, f"rank {pid}")]
         _dump_trace(meta + events, path)
         return len(events)
+
+
+# small sequential lane id per recording thread (0 = first recorder, in
+# practice the main thread): raw thread idents are pthread addresses whose
+# truncation could collide, and full idents make unreadable Chrome tids
+_TID_LANES: dict[int, int] = {}
+_TID_LANES_LOCK = threading.Lock()
+
+
+def _lane_of_thread() -> int:
+    ident = threading.get_ident()
+    lane = _TID_LANES.get(ident)
+    if lane is None:
+        with _TID_LANES_LOCK:
+            lane = _TID_LANES.setdefault(ident, len(_TID_LANES))
+    return lane
 
 
 # -- current-tracer plumbing ---------------------------------------------
@@ -272,7 +299,10 @@ def _chrome_span(rec: dict, wall_end: float, pid: int) -> dict:
         "ts": (wall_end - dur) * 1e6,
         "dur": dur * 1e6,
         "pid": pid,
-        "tid": 0,
+        # one Chrome track per recording thread: pipelined runs put the
+        # packer lane and the dispatch lane on separate rows (v1 spans
+        # without tid all land on track 0, as before)
+        "tid": rec.get("tid", 0),
         "args": {**rec.get("labels", {}), "depth": rec.get("depth", 0)},
     }
 
@@ -392,14 +422,21 @@ def aggregate_spans(event_lists: list[list[dict]]) -> list[dict]:
             dur = float(e["dur_s"])
             spans.append({
                 "name": e["name"], "start": wall - dur, "end": wall,
-                "dur": dur, "child": 0.0,
+                "dur": dur, "child": 0.0, "tid": e.get("tid", 0),
             })
-        spans.sort(key=lambda s: (s["start"], -s["end"]))
+        # containment runs PER LANE: a packer-thread span genuinely
+        # overlapping a dispatch-lane span (pipelined runs) is parallel
+        # work, not a child — cross-lane containment would deflate the
+        # containing span's self time by work it never did
+        spans.sort(key=lambda s: (s["tid"], s["start"], -s["end"]))
         stack: list[dict] = []
         # 1us containment tolerance: dur_s is journaled at 1us precision,
         # so reconstructed start times carry sub-us rounding error
         for s in spans:
-            while stack and stack[-1]["end"] <= s["start"] + 1e-6:
+            while stack and (
+                stack[-1]["tid"] != s["tid"]
+                or stack[-1]["end"] <= s["start"] + 1e-6
+            ):
                 stack.pop()
             if stack and s["end"] <= stack[-1]["end"] + 1e-6:
                 stack[-1]["child"] += s["dur"]

@@ -93,3 +93,40 @@ def shared_bin_counts(
     if rc != 0:
         raise RuntimeError(f"native medoid failed (rc={rc})")
     return out, out_offsets
+
+
+def finalize_indices(
+    shared_flat: np.ndarray,  # flat per-cluster (M, M) count matrices
+    out_offsets: np.ndarray,  # (C + 1,) i64 extents into shared_flat
+    n_peaks: np.ndarray,  # (S,) i64 raw peak counts, cluster-contiguous
+    cluster_spec_offsets: np.ndarray,  # (C + 1,) i64 spectrum extents/cluster
+) -> np.ndarray:
+    """Winning member index per cluster from ``shared_bin_counts`` output.
+
+    Identical float64 math to the device path (``ops.similarity
+    .medoid_finalize``), grouped by member count: a single globally-padded
+    (B, Mmax, Mmax) batch would inflate memory quadratically for every
+    cluster off one big outlier — equal-M groups stack with ZERO padding.
+    Lives here so both halves of the native medoid protocol (counts +
+    finalize) stay in one module; the import is lazy because
+    ``ops.similarity`` pulls in jax and this module's count path is
+    jax-free."""
+    from specpride_tpu.ops.similarity import medoid_finalize
+
+    cso = cluster_spec_offsets
+    m_per = np.diff(cso)
+    b = cso.size - 1
+    indices = np.zeros(b, dtype=np.int64)
+    for m in np.unique(m_per):
+        sel = np.flatnonzero(m_per == m)
+        g = sel.size
+        take = out_offsets[sel][:, None] + np.arange(m * m)
+        shared = shared_flat[take].reshape(g, m, m).astype(np.int64)
+        counts = n_peaks[cso[sel][:, None] + np.arange(m)]
+        indices[sel] = medoid_finalize(
+            shared,
+            counts,
+            np.ones((g, m), dtype=bool),
+            np.full(g, m, dtype=np.int64),
+        )
+    return indices

@@ -107,6 +107,49 @@ def shared_bins_packed(
     return jnp.einsum("bkm,bkn->bmn", v, v).astype(jnp.uint16)
 
 
+@functools.partial(jax.jit, static_argnames=("m", "lcap"))
+def medoid_select_packed(
+    bins: jax.Array,  # (B, K) i32 GLOBAL bins, PRE-SORTED (bin, member)
+    member_id: jax.Array,  # (B, K) i32 in [0, m], same order, padding = m
+    n_peaks: jax.Array,  # (B, M) i32 raw per-member peak counts
+    member_mask: jax.Array,  # (B, M) bool
+    n_members: jax.Array,  # (B,) i32
+    m: int,
+    lcap: int | None = None,
+) -> jax.Array:
+    """Winning medoid member index per cluster, selected ON DEVICE.
+
+    Composes ``shared_bins_packed`` with the finalize reduction so D2H
+    carries one int32 per cluster instead of the (B, M, M) uint16 count
+    matrices — the medoid path's device→host bytes were its largest cost
+    on slow links (BENCH r06: 0.68 s of d2h), and the counts were only
+    ever reduced to an argmin on the host anyway.
+
+    The math mirrors ``medoid_finalize`` (prescore = shared / min raw
+    counts, distance = 1 − prescore, row sum + double-counted diagonal,
+    first-minimum argmin) but runs in device f32 rather than host f64.
+    Exact ties — identical members, every 2-member cluster — evaluate
+    bitwise-identically on both sides and keep the lowest-index winner;
+    f32 rounding can flip a winner only when two members' mean distances
+    agree to ~1e-6 relative.  ``TpuBackend(medoid_device_select=False)``
+    restores the host-f64 finalize if that margin ever matters."""
+    shared = shared_bins_packed(bins, member_id, m, lcap).astype(jnp.float32)
+    n = n_peaks.astype(jnp.float32)
+    min_n = jnp.minimum(n[:, :, None], n[:, None, :])
+    prescore = jnp.where(
+        min_n > 0, shared / jnp.maximum(min_n, 1.0), 0.0
+    )
+    dist = 1.0 - prescore
+    pair_ok = member_mask[:, :, None] & member_mask[:, None, :]
+    dist = jnp.where(pair_ok, dist, 0.0)
+    diag = jnp.einsum("bii->bi", dist)
+    total = (dist.sum(axis=2) + diag) / jnp.maximum(
+        n_members.astype(jnp.float32)[:, None], 1.0
+    )
+    total = jnp.where(member_mask, total, jnp.inf)
+    return jnp.argmin(total, axis=1).astype(jnp.int32)
+
+
 def medoid_finalize(
     shared: "np.ndarray",  # (B, M, M) int
     n_peaks: "np.ndarray",  # (B, M) int raw peak counts

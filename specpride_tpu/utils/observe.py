@@ -1,14 +1,2 @@
-"""Compatibility shim: the observability subsystem moved to
-``specpride_tpu.observability`` (run journal, metrics registry, stats CLI).
-Import from there; this module re-exports the original names so existing
-imports keep working."""
-
-from specpride_tpu.observability.stats import (  # noqa: F401
-    RunStats,
-    _JsonFormatter,
-    configure_logging,
-    device_trace,
-    logger,
-)
-
-__all__ = ["RunStats", "configure_logging", "device_trace", "logger"]
+"""DEPRECATED shim — import from ``specpride_tpu.observability`` instead."""
+from specpride_tpu.observability.stats import RunStats, configure_logging, device_trace, logger  # noqa: F401,E501

@@ -234,17 +234,19 @@ class TestStreamedClusters:
                 s.title for s in b.members
             ]
 
-    def test_only_one_window_cached(self, tmp_path, rng):
-        """Peak memory is one window of parsed clusters, not the file."""
+    def test_window_cache_stays_bounded(self, tmp_path, rng):
+        """Peak memory is at most TWO windows of parsed clusters (one per
+        pipelined-executor lane), never the file."""
         from specpride_tpu.io.mgf import StreamedClusters
 
         path, _ = self._write(tmp_path, rng, n_clusters=12)
         streamed = StreamedClusters(path, window=4)
         for c in streamed:
-            assert len(streamed._cache) <= 4
+            assert len(streamed._windows) <= 2
+            assert all(len(w) <= 4 for w in streamed._windows.values())
         # jumping back re-materialises the earlier window
         first = streamed[0]
-        assert streamed._cache_lo == 0
+        assert 0 in streamed._windows
         assert first.cluster_id == "cluster-0"
 
     def test_slicing_returns_view(self, tmp_path, rng):

@@ -1,0 +1,342 @@
+"""Pipelined chunk executor (--prefetch): output parity with the serial
+path, crash/resume and --on-error skip behavior under prefetch, pack-phase
+attribution, pipeline telemetry, the bucket-plan cache, and the medoid
+index-only device transfer."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.io.mgf import read_mgf, write_mgf
+
+from conftest import make_cluster
+
+
+def _workload(rng, n=9, **kw):
+    return [
+        make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=25, **kw)
+        for i in range(n)
+    ]
+
+
+def _write(tmp_path, clusters):
+    path = tmp_path / "clustered.mgf"
+    write_mgf([s for c in clusters for s in c.members], path)
+    return path
+
+
+class TestPrefetchParity:
+    @pytest.mark.parametrize("method,command", [
+        ("bin-mean", "consensus"),
+        ("gap-average", "consensus"),
+        ("medoid", "select"),
+    ])
+    def test_byte_identical_output_and_checkpoint(
+        self, tmp_path, rng, method, command
+    ):
+        """--prefetch 0/1/4 must produce byte-identical MGF output AND
+        identical checkpoint manifests for every method (the executor
+        changes scheduling, never results)."""
+        clustered = _write(tmp_path, _workload(rng))
+        outputs, manifests = {}, {}
+        for p in (0, 1, 4):
+            out = tmp_path / f"out_p{p}.mgf"
+            ckpt = tmp_path / f"ckpt_p{p}.json"
+            assert cli_main([
+                command, str(clustered), str(out), "--method", method,
+                "--prefetch", str(p),
+                "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+            ]) == 0
+            outputs[p] = out.read_bytes()
+            manifests[p] = json.loads(ckpt.read_text())
+        assert outputs[0] == outputs[1] == outputs[4]
+        assert manifests[0] == manifests[1] == manifests[4]
+
+    def test_qc_report_identical(self, tmp_path, rng):
+        """The fused bin-mean + QC path rides prepare_chunk/run_prepared;
+        the report must match the serial run exactly."""
+        clustered = _write(tmp_path, _workload(rng))
+        reports = {}
+        for p in (0, 4):
+            out = tmp_path / f"o{p}.mgf"
+            qc = tmp_path / f"qc{p}.json"
+            assert cli_main([
+                "consensus", str(clustered), str(out), "--prefetch", str(p),
+                "--checkpoint", str(tmp_path / f"c{p}.json"),
+                "--checkpoint-every", "3", "--qc-report", str(qc),
+            ]) == 0
+            reports[p] = qc.read_bytes()
+        assert reports[0] == reports[4]
+
+    def test_kill_resume_under_prefetch(self, tmp_path, rng):
+        """A mid-run kill (simulated as a committed partial manifest plus
+        an orphaned appended chunk) resumed WITH prefetch must converge to
+        the serial golden bytes — the crash-safety contract is scheduling-
+        independent."""
+        clusters = _workload(rng, n=8)
+        clustered = _write(tmp_path, clusters)
+
+        golden = tmp_path / "golden.mgf"
+        assert cli_main([
+            "consensus", str(clustered), str(golden), "--prefetch", "0",
+            "--checkpoint", str(tmp_path / "g.json"),
+            "--checkpoint-every", "2",
+        ]) == 0
+        golden_bytes = golden.read_bytes()
+
+        # crashed state: chunk 1 committed (same backend as the golden run,
+        # via the CLI on a 2-cluster input — per-cluster output makes its
+        # bytes the golden prefix), then an orphaned partial append that
+        # the manifest never recorded (the classic torn window)
+        head_src = tmp_path / "head.mgf"
+        write_mgf([s for c in clusters[:2] for s in c.members], head_src)
+        out = tmp_path / "out.mgf"
+        assert cli_main([
+            "consensus", str(head_src), str(out), "--prefetch", "0",
+        ]) == 0
+        committed = out.stat().st_size
+        assert golden_bytes.startswith(out.read_bytes())
+        with open(out, "ab") as fh:
+            fh.write(b"BEGIN IONS\nTITLE=torn-orphan\n")
+        ckpt = tmp_path / "ckpt.json"
+        ckpt.write_text(json.dumps({
+            "done": ["cluster-0", "cluster-1"], "output_bytes": committed,
+        }))
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--prefetch", "4",
+            "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+        ]) == 0
+        assert out.read_bytes() == golden_bytes
+
+    def test_on_error_skip_under_prefetch(self, tmp_path, rng):
+        """--on-error skip with a poisoned cluster: the pipelined run must
+        isolate exactly the bad cluster (serial per-cluster retry of the
+        failing chunk) and keep every good one — same output and failure
+        record as the serial run.  The failure surfaces on the PACKER
+        thread (check_uniform_charge runs in prepare_chunk) and must
+        still route through the consumer's skip path."""
+        good = _workload(rng, n=5)
+        bad = make_cluster(rng, "cluster-bad", n_members=2, n_peaks=15)
+        bad.members[1].precursor_charge = bad.members[0].precursor_charge + 1
+        clusters = good[:2] + [bad] + good[2:]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        outs = {}
+        for p in (0, 2):
+            out = tmp_path / f"out_p{p}.mgf"
+            ckpt = tmp_path / f"ck_p{p}.json"
+            assert cli_main([
+                "consensus", str(clustered), str(out), "--prefetch", str(p),
+                "--on-error", "skip", "--checkpoint", str(ckpt),
+                "--checkpoint-every", "2",
+            ]) == 0
+            outs[p] = out.read_bytes()
+            assert json.loads(ckpt.read_text())["failed"] == ["cluster-bad"]
+        assert outs[0] == outs[2]
+        assert sorted(s.title for s in read_mgf(tmp_path / "out_p2.mgf")) \
+            == sorted(c.cluster_id for c in good)
+
+    def test_pack_materialization_failure_rebuilds_part(self, tmp_path, rng):
+        """A packer-thread failure DURING chunk materialization delivers
+        item.part = None; under --on-error skip the consumer must rebuild
+        the chunk itself and run the per-cluster serial retry (the only
+        path where the executor re-touches the input)."""
+        from specpride_tpu import cli as cli_mod
+        from specpride_tpu.backends import numpy_backend as nb
+        from specpride_tpu.observability import RunStats
+
+        clusters = _workload(rng, n=6)
+
+        class FlakyList(list):
+            """Fails the FIRST materialization of cluster 3 (that access
+            happens on the packer thread); the consumer's rebuild and the
+            retry then succeed."""
+
+            tripped = False
+
+            def __getitem__(self, i):
+                if i == 3 and not self.tripped:
+                    FlakyList.tripped = True
+                    raise RuntimeError("flaky materialization")
+                return super().__getitem__(i)
+
+        out = tmp_path / "out.mgf"
+        args = cli_mod.build_parser().parse_args([
+            "consensus", "in.mgf", str(out),
+            "--backend", "numpy", "--prefetch", "2",
+            "--on-error", "skip",
+            "--checkpoint", str(tmp_path / "ck.json"),
+            "--checkpoint-every", "2",
+        ])
+        _, failed, qc_failed = cli_mod._checkpointed_run(
+            nb, "bin-mean", FlakyList(clusters), args, RunStats()
+        )
+        assert failed == [] and qc_failed == []
+        assert [s.title for s in read_mgf(out)] == [
+            c.cluster_id for c in clusters
+        ]
+
+    def test_flat_layout_medoid_keeps_device_path(self, tmp_path, rng):
+        """--layout flat forces the device medoid kernel; the pipelined
+        executor must NOT silently reroute it to the host-native path
+        (prepare_chunk returns None there), so prefetch 0 and 2 agree."""
+        clustered = _write(tmp_path, _workload(rng))
+        outs = {}
+        for p in (0, 2):
+            out = tmp_path / f"flat_p{p}.mgf"
+            assert cli_main([
+                "select", str(clustered), str(out), "--method", "medoid",
+                "--layout", "flat", "--prefetch", str(p),
+                "--checkpoint", str(tmp_path / f"fc{p}.json"),
+                "--checkpoint-every", "3",
+            ]) == 0
+            outs[p] = out.read_bytes()
+        assert outs[0] == outs[2]
+
+    def test_abort_propagates_and_shuts_down(self, tmp_path, rng):
+        """Default --on-error abort under prefetch: the pack-stage error
+        propagates to the caller (and the packer thread is reaped, not
+        left deadlocked on its queue)."""
+        bad = make_cluster(rng, "cluster-bad", n_members=2, n_peaks=15)
+        bad.members[1].precursor_charge = bad.members[0].precursor_charge + 1
+        clusters = _workload(rng, n=4) + [bad]
+        clustered = _write(tmp_path, clusters)
+        with pytest.raises(ValueError):
+            cli_main([
+                "consensus", str(clustered), str(tmp_path / "x.mgf"),
+                "--prefetch", "2", "--checkpoint", str(tmp_path / "c.json"),
+                "--checkpoint-every", "1",
+            ])
+        import threading
+
+        assert not [
+            t for t in threading.enumerate()
+            if t.name == "specpride-packer" and t.is_alive()
+        ]
+
+
+class TestPipelineTelemetry:
+    def test_journal_pipeline_summary_and_spans(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng))
+        journal = tmp_path / "run.jsonl"
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "o.mgf"),
+            "--prefetch", "2", "--checkpoint", str(tmp_path / "c.json"),
+            "--checkpoint-every", "2", "--journal", str(journal),
+        ]) == 0
+        events = [json.loads(l) for l in journal.read_text().splitlines()]
+        end = [e for e in events if e["event"] == "run_end"][-1]
+        pipe = end.get("pipeline")
+        assert pipe and pipe["prefetch"] == 2
+        assert pipe["device_idle_s"] >= 0.0
+        assert pipe["overlap_efficiency"] is None or (
+            pipe["overlap_efficiency"] <= 1.0
+        )
+        span_names = {
+            e["name"] for e in events if e["event"] == "span"
+        }
+        assert any(n.startswith("pipeline") for n in span_names)
+        # satellite: packer time journaled as `pack`, not swallowed into
+        # compute — and throughput still divides by compute+write only
+        phases = end["phases_s"]
+        assert phases.get("pack", 0.0) > 0.0
+        want = end["counters"]["clusters"] / (
+            phases.get("compute", 0.0) + phases.get("write", 0.0)
+        )
+        assert end["clusters_per_sec"] == pytest.approx(want, rel=0.05)
+
+    def test_serial_run_has_no_pipeline_field(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=4))
+        journal = tmp_path / "run.jsonl"
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "o.mgf"),
+            "--prefetch", "0", "--journal", str(journal),
+        ]) == 0
+        events = [json.loads(l) for l in journal.read_text().splitlines()]
+        end = [e for e in events if e["event"] == "run_end"][-1]
+        assert "pipeline" not in end
+
+    def test_stats_cli_surfaces_device_idle(self, tmp_path, rng, capsys):
+        clustered = _write(tmp_path, _workload(rng))
+        journal = tmp_path / "run.jsonl"
+        agg = tmp_path / "agg.json"
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "o.mgf"),
+            "--prefetch", "2", "--checkpoint", str(tmp_path / "c.json"),
+            "--checkpoint-every", "2", "--journal", str(journal),
+        ]) == 0
+        assert cli_main([
+            "stats", str(journal), "--json", str(agg),
+        ]) == 0
+        run = json.loads(agg.read_text())["runs"][0]
+        assert "device_idle_s" in run and "overlap_efficiency" in run
+        assert "device_idle_s" in capsys.readouterr().out
+
+
+class TestPlanCache:
+    def test_repeated_pack_hits_cache(self, rng):
+        from specpride_tpu.data import packed
+
+        clusters = _workload(rng, n=6)
+        packed.clear_plan_cache()
+        a = packed.pack_bucketize(clusters)
+        misses = packed.plan_cache_info()["misses"]
+        b = packed.pack_bucketize(clusters)
+        info = packed.plan_cache_info()
+        assert info["misses"] == misses  # second pack re-planned nothing
+        assert info["hits"] >= 1
+        assert [x.cluster_ids for x in a] == [x.cluster_ids for x in b]
+        np.testing.assert_array_equal(a[0].mz, b[0].mz)
+
+    def test_different_inputs_miss(self, rng):
+        from specpride_tpu.data import packed
+
+        packed.clear_plan_cache()
+        packed.pack_bucketize(_workload(rng, n=3))
+        before = packed.plan_cache_info()["misses"]
+        packed.pack_bucketize(_workload(rng, n=4))
+        assert packed.plan_cache_info()["misses"] > before
+
+
+class TestMedoidDeviceSelect:
+    def test_index_only_matches_host_finalize(self, rng):
+        """Device-side medoid selection (index-only D2H) must pick the
+        same winners as the count-matrix fetch + host f64 finalize."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+        from specpride_tpu.backends import numpy_backend as nb
+
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=m, n_peaks=30)
+            for i, m in enumerate([1, 2, 5, 3, 8, 2])
+        ]
+        dev = TpuBackend(layout="bucketized", medoid_device_select=True)
+        host = TpuBackend(layout="bucketized", medoid_device_select=False)
+        oracle = [nb.medoid_index(c.members) for c in clusters]
+        assert dev.medoid_indices(clusters) == oracle
+        assert host.medoid_indices(clusters) == oracle
+
+    def test_d2h_bytes_drop(self, rng):
+        """The whole point: the index transfer must be >= 10x smaller than
+        the count-matrix transfer for the same workload."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+
+        clusters = [
+            make_cluster(rng, f"cluster-{i}", n_members=6, n_peaks=30)
+            for i in range(8)
+        ]
+
+        def d2h_bytes(select: bool) -> int:
+            backend = TpuBackend(
+                layout="bucketized", medoid_device_select=select
+            )
+            backend.medoid_indices(clusters)
+            counter = backend.metrics.counter(
+                "specpride_bytes_d2h_total",
+                "bytes fetched device->host",
+            )
+            return int(counter.value())
+
+        assert d2h_bytes(False) >= 10 * d2h_bytes(True)
