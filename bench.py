@@ -246,6 +246,59 @@ def bench_end_to_end(clusters, workdir: str, runs: int = 2) -> dict:
     }
 
 
+def _sweep_source(clusters, workdir: str) -> str:
+    """The clustered-MGF input shared by the executor sweeps (written
+    once per workdir)."""
+    import os
+
+    from specpride_tpu.io.mgf import write_mgf
+
+    src = os.path.join(workdir, "prefetch_clustered.mgf")
+    if not os.path.exists(src):
+        write_mgf([s for c in clusters for s in c.members], src)
+    return src
+
+
+def _sweep_run(command: str, method: str, src: str, workdir: str,
+               tag: str, flags: list):
+    """One CLI run under the pinned executor-sweep protocol — identical
+    chunking (``--checkpoint-every 256``) and a journal to read the
+    ``run_end`` summary from.  THE one runner both sweeps share, so the
+    measurement protocol cannot drift between them.  Returns
+    ``(wall_s, executor_s, pipeline_summary, output_bytes)``; executor_s
+    is the post-parse chunk loop the executor actually changed."""
+    import os
+
+    from specpride_tpu.cli import main as cli_main
+
+    out = os.path.join(workdir, f"{tag}.mgf")
+    journal = os.path.join(workdir, f"{tag}.jsonl")
+    t0 = time.perf_counter()
+    rc = cli_main([
+        command, src, out, "--method", method,
+        "--checkpoint", os.path.join(workdir, f"{tag}.ck.json"),
+        "--checkpoint-every", "256",
+        "--journal", journal,
+    ] + flags)
+    wall = time.perf_counter() - t0
+    assert rc == 0
+    with open(journal) as fh:
+        events = [json.loads(line) for line in fh]
+    end = [e for e in events if e["event"] == "run_end"][-1]
+    pipe = end.get("pipeline") or {}
+    executor_s = end["elapsed_s"] - end["phases_s"].get("parse", 0.0)
+    with open(out, "rb") as fh:
+        data = fh.read()
+    return wall, executor_s, pipe, data
+
+
+_SWEEP_METHODS = (
+    ("bin-mean", "consensus"),
+    ("gap-average", "consensus"),
+    ("medoid", "select"),
+)
+
+
 def bench_prefetch_sweep(
     clusters, workdir: str, prefetches=(0, 1, 2, 4)
 ) -> list[dict]:
@@ -259,41 +312,15 @@ def bench_prefetch_sweep(
     dilutes the speedup), ``executor`` is the post-parse chunk loop the
     pipeline actually changed.  ``overlap_efficiency`` = 1 −
     device_idle/wall from the run journal's pipeline summary."""
-    import os
-
-    from specpride_tpu.cli import main as cli_main
-    from specpride_tpu.io.mgf import write_mgf
-
-    src = os.path.join(workdir, "prefetch_clustered.mgf")
-    if not os.path.exists(src):
-        write_mgf([s for c in clusters for s in c.members], src)
+    src = _sweep_source(clusters, workdir)
     rows = []
-    for method, command in (
-        ("bin-mean", "consensus"),
-        ("gap-average", "consensus"),
-        ("medoid", "select"),
-    ):
+    for method, command in _SWEEP_METHODS:
         base_bytes = base_exec = None
         for p in prefetches:
-            tag = f"{method.replace('-', '_')}_p{p}"
-            out = os.path.join(workdir, f"pf_{tag}.mgf")
-            journal = os.path.join(workdir, f"pf_{tag}.jsonl")
-            t0 = time.perf_counter()
-            rc = cli_main([
-                command, src, out, "--method", method,
-                "--prefetch", str(p),
-                "--checkpoint", os.path.join(workdir, f"pf_{tag}.ck.json"),
-                "--checkpoint-every", "256",
-                "--journal", journal,
-            ])
-            wall = time.perf_counter() - t0
-            assert rc == 0
-            with open(journal) as fh:
-                events = [json.loads(line) for line in fh]
-            end = [e for e in events if e["event"] == "run_end"][-1]
-            pipe = end.get("pipeline") or {}
-            executor_s = end["elapsed_s"] - end["phases_s"].get("parse", 0.0)
-            data = open(out, "rb").read()
+            tag = f"pf_{method.replace('-', '_')}_p{p}"
+            wall, executor_s, pipe, data = _sweep_run(
+                command, method, src, workdir, tag, ["--prefetch", str(p)]
+            )
             if base_bytes is None:
                 base_bytes, base_exec = data, executor_s
             row = {
@@ -318,6 +345,78 @@ def bench_prefetch_sweep(
                 f"({row['executor_speedup_vs_serial']}x vs serial), "
                 f"idle={row['device_idle_s']} "
                 f"overlap={row['overlap_efficiency']} "
+                f"identical={row['identical_to_serial']}"
+            )
+    return rows
+
+
+def bench_worker_sweep(
+    clusters, workdir: str,
+    combos=((0, "off"), (0, "on"), (1, "on"), (2, "on"), (4, "on")),
+    prefetch: int = 4,
+) -> list[dict]:
+    """Multi-lane executor (``--pack-workers`` x ``--async-write``)
+    measured end to end through the CLI against a serial (``--prefetch
+    0``) baseline, per method.  Same protocol as ``bench_prefetch_sweep``
+    (identical chunking via ``--checkpoint-every 256``, byte comparison
+    against the serial output, one shared ``_sweep_run`` runner); each
+    row additionally records the per-lane busy seconds and the
+    reorder-buffer stall time from the run journal's
+    ``run_end.pipeline`` summary, so the lane balance — not just the
+    headline speedup — is pinned per round."""
+    src = _sweep_source(clusters, workdir)
+    rows = []
+    for method, command in _SWEEP_METHODS:
+        base_bytes = base_exec = None
+        runs = [("serial", 0, 0, "off")] + [
+            (f"pw{pw}_aw_{aw}", prefetch, pw, aw) for pw, aw in combos
+        ]
+        for label, p, pw, aw in runs:
+            tag = f"ws_{method.replace('-', '_')}_{label}"
+            wall, executor_s, pipe, data = _sweep_run(
+                command, method, src, workdir, tag,
+                ["--prefetch", str(p), "--pack-workers", str(pw),
+                 "--async-write", aw],
+            )
+            if base_bytes is None:
+                base_bytes, base_exec = data, executor_s
+            pack_busy = pipe.get("pack_busy_s") or []
+            wall_lane = pipe.get("wall_s") or 0.0
+            row = {
+                "method": method,
+                "prefetch": p,
+                "pack_workers": pw,
+                "async_write": aw,
+                "wall_s": round(wall, 3),
+                "executor_s": round(executor_s, 3),
+                "clusters_per_sec_executor": round(
+                    len(clusters) / executor_s, 2
+                ),
+                "executor_speedup_vs_serial": round(
+                    base_exec / executor_s, 3
+                ),
+                "device_idle_s": pipe.get("device_idle_s"),
+                "overlap_efficiency": pipe.get("overlap_efficiency"),
+                "pack_busy_s": pack_busy,
+                "pack_busy_frac": round(
+                    sum(pack_busy) / (wall_lane * len(pack_busy)), 3
+                ) if wall_lane > 0 and pack_busy else None,
+                "write_busy_s": pipe.get("write_busy_s"),
+                "write_busy_frac": round(
+                    pipe["write_busy_s"] / wall_lane, 3
+                ) if wall_lane > 0 and pipe.get("write_busy_s") is not None
+                else None,
+                "reorder_stall_s": pipe.get("reorder_stall_s"),
+                "identical_to_serial": data == base_bytes,
+            }
+            rows.append(row)
+            eprint(
+                f"[lanes:{method} pw={pw} aw={aw} p={p}] executor "
+                f"{row['clusters_per_sec_executor']:.0f} cl/s "
+                f"({row['executor_speedup_vs_serial']}x vs serial) "
+                f"pack_busy={row['pack_busy_frac']} "
+                f"write_busy={row['write_busy_frac']} "
+                f"stall={row['reorder_stall_s']} "
                 f"identical={row['identical_to_serial']}"
             )
     return rows
@@ -509,6 +608,12 @@ def main() -> None:
         "baselines and write the JSON report here (BENCH_METHODS.json)",
     )
     ap.add_argument(
+        "--sections", default=None, metavar="LIST",
+        help="with --report: comma list of report sections to run "
+        "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
+        "prefetch_sweep,worker_sweep,pallas",
+    )
+    ap.add_argument(
         "--sync-timing", action="store_true",
         help="block after dispatch so the 'device' (H2D+kernel) and 'd2h' "
         "(pure transfer) phases time apart",
@@ -524,6 +629,21 @@ def main() -> None:
         "into this directory (view with TensorBoard / Perfetto)",
     )
     args = ap.parse_args()
+
+    # validate --sections BEFORE the workload is paid for: a typo'd
+    # section name must fail instantly, not after seconds of setup (and
+    # never produce a silently empty report)
+    all_sections = (
+        "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
+        "worker_sweep,pallas"
+    )
+    secs = set((args.sections or all_sections).split(","))
+    unknown = secs - set(all_sections.split(","))
+    if unknown:
+        raise SystemExit(
+            f"unknown --sections {sorted(unknown)}; "
+            f"choose from: {all_sections}"
+        )
 
     import jax
 
@@ -588,60 +708,82 @@ def main() -> None:
             }
             import gc
 
-            for method in ("bin_mean", "gap_average", "medoid", "pipeline"):
-                report["methods"].append(
-                    bench_method(
-                        method, clusters, backend, nb,
+            if "methods" in secs:
+                for method in (
+                    "bin_mean", "gap_average", "medoid", "pipeline"
+                ):
+                    report["methods"].append(
+                        bench_method(
+                            method, clusters, backend, nb,
+                            numpy_sample=len(clusters), seed=args.seed,
+                            journal=journal,
+                        )
+                    )
+                    # back-to-back methods in one process measurably
+                    # degrade on tunneled hosts (leftover device buffers +
+                    # queue state); a collection pass between methods keeps
+                    # runs comparable to standalone --method invocations
+                    gc.collect()
+            if "flat" in secs:
+                # the measured-choice default ("auto") runs K1/K2b on the
+                # host mesh-less; keep the DEVICE flat paths measured too,
+                # so the device-vs-host decision stays pinned to current
+                # numbers
+                dev_backend = TpuBackend(
+                    batch_config=BatchConfig(clusters_per_batch=4096),
+                    layout="flat",
+                    sync_timing=args.sync_timing,
+                    journal=journal,
+                    # one registry across both backends: run_end.device
+                    # must cover the flat-layout benches too, not just the
+                    # default backend's
+                    metrics=backend.metrics,
+                )
+                for method in ("bin_mean", "pipeline"):
+                    entry = bench_method(
+                        method, clusters, dev_backend, nb,
                         numpy_sample=len(clusters), seed=args.seed,
                         journal=journal,
                     )
-                )
-                # back-to-back methods in one process measurably degrade on
-                # tunneled hosts (leftover device buffers + queue state); a
-                # collection pass between methods keeps runs comparable to
-                # standalone --method invocations
-                gc.collect()
-            # the measured-choice default ("auto") runs K1/K2b on the host
-            # mesh-less; keep the DEVICE flat paths measured too, so the
-            # device-vs-host decision stays pinned to current numbers
-            dev_backend = TpuBackend(
-                batch_config=BatchConfig(clusters_per_batch=4096),
-                layout="flat",
-                sync_timing=args.sync_timing,
-                journal=journal,
-                # one registry across both backends: run_end.device must
-                # cover the flat-layout benches too, not just the default
-                # backend's
-                metrics=backend.metrics,
-            )
-            for method in ("bin_mean", "pipeline"):
-                entry = bench_method(
-                    method, clusters, dev_backend, nb,
-                    numpy_sample=len(clusters), seed=args.seed,
-                    journal=journal,
-                )
-                entry["method"] += "_device_flat"
-                entry["metric"] += " [device flat layout]"
-                report["methods"].append(entry)
-                gc.collect()
-            report["sweep"] = bench_sweep(clusters, backend, nb)
-            report["medoid_d2h"] = bench_medoid_d2h(clusters)
+                    entry["method"] += "_device_flat"
+                    entry["metric"] += " [device flat layout]"
+                    report["methods"].append(entry)
+                    gc.collect()
+            if "sweep" in secs:
+                report["sweep"] = bench_sweep(clusters, backend, nb)
+            if "medoid_d2h" in secs:
+                report["medoid_d2h"] = bench_medoid_d2h(clusters)
             import tempfile
 
             with tempfile.TemporaryDirectory() as workdir:
-                report["end_to_end"] = bench_end_to_end(clusters, workdir)
-                report["prefetch_sweep"] = bench_prefetch_sweep(
-                    clusters, workdir
-                )
-            ab = pallas_ab(clusters)
-            if ab is not None:
-                report["pallas_ab"] = ab
+                if "end_to_end" in secs:
+                    report["end_to_end"] = bench_end_to_end(
+                        clusters, workdir
+                    )
+                if "prefetch_sweep" in secs:
+                    report["prefetch_sweep"] = bench_prefetch_sweep(
+                        clusters, workdir
+                    )
+                if "worker_sweep" in secs:
+                    report["worker_sweep"] = bench_worker_sweep(
+                        clusters, workdir
+                    )
+            if "pallas" in secs:
+                ab = pallas_ab(clusters)
+                if ab is not None:
+                    report["pallas_ab"] = ab
             with open(args.report, "w") as f:
                 json.dump(report, f, indent=2)
                 f.write("\n")
             eprint(f"wrote {args.report}")
             head = next(
-                r for r in report["methods"] if r["method"] == "pipeline"
+                (r for r in report["methods"] if r["method"] == "pipeline"),
+                report["methods"][0] if report["methods"] else {
+                    "metric": "partial report (see --sections)",
+                    "device_clusters_per_sec": 0.0,
+                    "speedup_vs_numpy": 0.0,
+                    "device_phases_s": {},
+                },
             )
         else:
             head = bench_method(

@@ -77,6 +77,62 @@ print(f"pipeline OK: {len(spans)} pipeline spans, "
 EOF
 rm -rf "$pf_tmp"
 
+echo "== multi-lane executor: pack-workers x async-write parity matrix =="
+# every (pack-workers, async-write) combination must reproduce the serial
+# output byte for byte; the journal must carry the per-lane run_end
+# summary and prove the commit protocol's order (chunk_done — i.e. the
+# MGF append — strictly before that chunk's checkpoint_write)
+ln_tmp=$(mktemp -d)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus tests/data/golden_clustered.mgf "$ln_tmp/serial.mgf" \
+    --method bin-mean --backend tpu --prefetch 0 \
+    --checkpoint "$ln_tmp/serial.ck.json" --checkpoint-every 1
+for PW in 0 4; do
+    for AW in on off; do
+        env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+            consensus tests/data/golden_clustered.mgf \
+            "$ln_tmp/reps_pw${PW}_$AW.mgf" \
+            --method bin-mean --backend tpu --prefetch 4 \
+            --pack-workers "$PW" --async-write "$AW" \
+            --checkpoint "$ln_tmp/ck_pw${PW}_$AW.json" --checkpoint-every 1 \
+            --journal "$ln_tmp/run_pw${PW}_$AW.jsonl"
+        cmp "$ln_tmp/serial.mgf" "$ln_tmp/reps_pw${PW}_$AW.mgf"
+        cmp "$ln_tmp/serial.ck.json" "$ln_tmp/ck_pw${PW}_$AW.json"
+    done
+done
+python - "$ln_tmp/run_pw4_on.jsonl" <<'EOF'
+import json, sys
+events = [json.loads(l) for l in open(sys.argv[1])]
+end = [e for e in events if e["event"] == "run_end"][-1]
+pipe = end.get("pipeline") or {}
+for key in ("prefetch", "pack_workers", "async_write", "device_idle_s",
+            "wall_s", "pack_busy_s", "write_busy_s", "reorder_stall_s"):
+    assert key in pipe, f"run_end.pipeline missing {key}: {pipe}"
+# pack_workers is the EFFECTIVE pool size (clamped to the chunk count)
+# and must match the per-worker busy list
+assert 1 <= pipe["pack_workers"] <= 4, pipe
+assert len(pipe["pack_busy_s"]) == pipe["pack_workers"], pipe
+assert pipe["async_write"] is True, pipe
+names = {e["name"] for e in events if e["event"] == "span"}
+assert any(n.startswith("pipeline:pack[") for n in names), names
+assert "pipeline:write" in names, names
+# commit protocol: chunk i's MGF append (chunk_done) precedes its
+# checkpoint_write, and n_done/output_bytes only ever grow
+order = [e for e in events if e["event"] in ("chunk_done", "checkpoint_write")]
+n_done = out_bytes = 0
+for prev, cur in zip([None] + order, order):
+    if cur["event"] == "checkpoint_write":
+        assert prev is not None and prev["event"] == "chunk_done", \
+            "checkpoint_write without a preceding chunk_done"
+        assert cur["n_done"] > n_done and cur["output_bytes"] >= out_bytes
+        n_done, out_bytes = cur["n_done"], cur["output_bytes"]
+print(f"lane matrix OK: {len(order)} commit events, "
+      f"pack_busy_s={pipe['pack_busy_s']} write_busy_s={pipe['write_busy_s']}")
+EOF
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$ln_tmp/run_pw4_on.jsonl" | grep -q reorder_stall_s
+rm -rf "$ln_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

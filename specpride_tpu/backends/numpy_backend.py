@@ -428,8 +428,11 @@ def prepare_chunk(method, clusters, config, cos_config=None, stats=None):
     device inputs to build — so phase 1 is always empty and the pipelined
     CLI executor falls back to the one-shot path.  It still wins on
     streamed inputs: chunk MATERIALIZATION (the MGF window parse) runs on
-    the packer thread either way.  Mirrors ``TpuBackend.prepare_chunk``
-    so callers can duck-type both backends."""
+    the pack lane either way — and the pack worker pool may call this
+    from several threads at once, which is trivially safe here (no state
+    is touched; the per-worker ``stats`` is private by contract).
+    Mirrors ``TpuBackend.prepare_chunk`` so callers can duck-type both
+    backends."""
     return None
 
 

@@ -36,7 +36,12 @@ from specpride_tpu.config import (
 from specpride_tpu.data.peaks import Cluster, Spectrum
 from specpride_tpu.ops import quantize
 from specpride_tpu.backends import numpy_backend
-from specpride_tpu.observability import MetricsRegistry, NullJournal, RunStats
+from specpride_tpu.observability import (
+    MetricsRegistry,
+    NullJournal,
+    RunStats,
+    logger,
+)
 from specpride_tpu.observability import tracing
 
 
@@ -92,6 +97,19 @@ def _ensure_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
     except (OSError, AttributeError):
         pass  # unwritable home / older jax: run uncached
+
+
+def _cpu_only_devices() -> bool:
+    """True when every visible jax device is a CPU — i.e. there is no
+    accelerator for a 'device' layout to win on (the platform list is
+    cached by jax, so repeated calls are cheap)."""
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 - bring-up failure: decide nothing
+        return False
+    return bool(devices) and all(d.platform == "cpu" for d in devices)
 
 
 def _chunk_ranges(b: int, chunk: int):
@@ -264,6 +282,12 @@ class TpuBackend:
     journal: object = dataclasses.field(
         default_factory=NullJournal, repr=False
     )
+    # keep --mesh/--layout device kernels selected even when jax exposes
+    # only CPU devices.  By default gap-average re-routes to the
+    # vectorized host consensus there (BENCH_r07: the CPU 'device' path
+    # ran at ~0.3x of numpy) and journals the decision; tests that
+    # exercise the kernels themselves set this.
+    force_device: bool = False
     # medoid: finalize the winning member index ON DEVICE and fetch one
     # int32 per cluster instead of the (B, M, M) uint16 count matrices
     # (device f32 finalize; see ops.similarity.medoid_select_packed for
@@ -278,6 +302,11 @@ class TpuBackend:
     # is a fresh XLA trace, i.e. a compile (an upper bound: the persistent
     # on-disk cache may turn it into a cache load)
     _seen_shapes: set = dataclasses.field(
+        default_factory=set, repr=False
+    )
+    # (method, path) routing decisions already journaled/logged — a
+    # chunked run must not spam one event per chunk
+    _routing_noted: set = dataclasses.field(
         default_factory=set, repr=False
     )
 
@@ -478,12 +507,17 @@ class TpuBackend:
         off the dispatch thread.
 
         The pipelined executor calls this from its background packer
-        thread with a PRIVATE ``stats`` (merged into the run's stats at
-        handoff, so packer time is attributed to the ``pack`` phase
-        instead of being swallowed into the consumer's ``compute`` wall
-        time).  Only pure host work happens here — tables, flat packs,
-        cosine member prep — never a device dispatch or a mutation of
-        backend state.
+        thread — and, with ``--pack-workers N``, from N POOL workers
+        CONCURRENTLY on distinct chunks — each with a PRIVATE per-chunk
+        ``stats`` (merged into the run's stats at handoff, so packer time
+        is attributed to the ``pack`` phase instead of being swallowed
+        into the consumer's ``compute`` wall time).  Only pure host work
+        happens here — tables, flat packs, cosine member prep — never a
+        device dispatch or a mutation of backend state, which is what
+        makes concurrent calls safe: chunks share nothing mutable (the
+        bucket-plan cache and the native-library loaders are
+        lock-protected; ``seg_argsort`` and the C++ kernels take only
+        their arguments).
 
         Returns ``None`` when the method/path has no pack stage worth
         splitting: mesh and bucketized layouts interleave packing with
@@ -948,10 +982,35 @@ class TpuBackend:
         shared with the device packer).  With a mesh, the (B, K) bucketized
         device path shards the segment reductions across devices
         (``ops.gap_average``), where interconnect bandwidth changes the
-        trade-off."""
+        trade-off.
+
+        Device-availability routing: when --mesh/--layout ask for the
+        bucketized device path but jax exposes ONLY CPU devices, there is
+        no accelerator to win on and the kernel measured ~0.3x of the
+        host consensus (BENCH_r07) — so the run is routed to the host
+        path and the decision journaled, unless ``force_device``."""
         if self.mesh is None and self.layout != "bucketized":
             return self._run_gap_average_host(clusters, config)
+        if not self.force_device and _cpu_only_devices():
+            self._note_routing(
+                "gap-average", "host-vectorized", "cpu-only-devices"
+            )
+            return self._run_gap_average_host(clusters, config)
         return self._run_gap_average_mesh(clusters, config)
+
+    def _note_routing(self, method: str, path: str, reason: str) -> None:
+        """Journal/log a device-routing decision ONCE per backend — the
+        operator must be able to see why a requested layout was not
+        executed, without one event per chunk."""
+        key = (method, path)
+        if key in self._routing_noted:
+            return
+        self._routing_noted.add(key)
+        logger.info(
+            "routing %s to the %s path (%s; --force-device overrides)",
+            method, path, reason,
+        )
+        self.journal.emit("routing", method=method, path=path, reason=reason)
 
     def _run_gap_average_host(
         self, clusters: list[Cluster], config: GapAverageConfig

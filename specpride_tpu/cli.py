@@ -73,11 +73,18 @@ def _add_backend(p: argparse.ArgumentParser) -> None:
                    help="multi-host: total process count")
     p.add_argument("--process-id", type=int,
                    help="multi-host: this process's rank")
+    p.add_argument(
+        "--force-device", action="store_true",
+        help="keep the device kernels selected by --mesh/--layout even "
+        "when jax exposes only CPU devices (default: gap-average routes "
+        "to the vectorized host consensus there — the CPU 'device' path "
+        "measured ~0.3x of it — and journals the routing decision)",
+    )
 
 
 def _add_execution(p: argparse.ArgumentParser) -> None:
     """Chunked-execution flags shared VERBATIM by consensus and select
-    (checkpointing, the pipelined executor, failure policy, streamed
+    (checkpointing, the multi-lane executor, failure policy, streamed
     ingest) — one definition so the two commands can never drift."""
     p.add_argument("--append", action="store_true",
                    help="append to the output instead of replacing it")
@@ -85,10 +92,26 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-every", type=int, default=512)
     p.add_argument(
         "--prefetch", type=int, default=2, metavar="N",
-        help="pipelined chunk executor: a background packer thread builds "
-        "up to N chunks' device inputs ahead of dispatch (bounded queue; "
-        "0 = serial; output is byte-identical either way — see "
+        help="pipelined chunk executor: the pack lane builds up to N "
+        "chunks' device inputs ahead of dispatch (bounded queue; 0 = "
+        "serial; output is byte-identical either way — see "
         "docs/performance.md)",
+    )
+    p.add_argument(
+        "--pack-workers", type=int, default=None, metavar="N",
+        help="pack lane worker pool: N threads run the host pack stage "
+        "on distinct chunks concurrently, re-ordered into FIFO by a "
+        "bounded reorder buffer so dispatch/checkpoint order is "
+        "unchanged (default min(4, cores/4); 0 = the single dedicated "
+        "packer thread; active only with --prefetch > 0)",
+    )
+    p.add_argument(
+        "--async-write", choices=["auto", "on", "off"], default="auto",
+        help="ordered write lane: QC-row finalize, MGF appends and "
+        "checkpoint writes move to a dedicated committer thread with the "
+        "same strict append-then-record order per chunk, so a kill at "
+        "any point resumes identically (auto = on whenever the pipelined "
+        "executor runs)",
     )
     p.add_argument(
         "--on-error", choices=["abort", "skip"], default="abort",
@@ -182,7 +205,10 @@ def _get_backend(args):
             "device mesh: %d local devices, %d processes",
             mesh.size, jax.process_count(),
         )
-    return TpuBackend(mesh=mesh, layout=getattr(args, "layout", "auto"))
+    return TpuBackend(
+        mesh=mesh, layout=getattr(args, "layout", "auto"),
+        force_device=getattr(args, "force_device", False),
+    )
 
 
 def _shard_for_process(clusters: list, args) -> tuple[list, str]:
@@ -434,8 +460,54 @@ def _serial_chunks(clusters, worklist):
         yield item
 
 
+def _pack_chunk(
+    clusters, chunk_index: int, idxs: list, prepare, method: str, config,
+    cos_config, span_name: str, **span_labels,
+):
+    """THE per-chunk pack stage — the one copy the dedicated packer and
+    every pool worker run, so the ``--pack-workers 0`` and ``>= 1`` paths
+    can never drift behaviorally: materialize the chunk's clusters, run
+    the backend's host pack (``prepare_chunk``) into a PRIVATE RunStats,
+    and capture any exception on the item for the consumer's --on-error
+    policy.  Returns ``(item, busy_seconds)``."""
+    import time as _time
+
+    item = _ChunkItem(chunk_index, idxs)
+    pack_stats = RunStats()
+    t0 = _time.perf_counter()
+    try:
+        with tracing.span(
+            span_name, chunk_index=chunk_index, n_clusters=len(idxs),
+            **span_labels,
+        ):
+            with pack_stats.phase("pack"):
+                item.part = [clusters[i] for i in idxs]
+            if prepare is not None:
+                item.prepared = prepare(
+                    method, item.part, config,
+                    cos_config=cos_config, stats=pack_stats,
+                )
+    except Exception as e:  # noqa: BLE001 - handed to consumer
+        item.error = e
+    item.pack_stats = pack_stats
+    return item, _time.perf_counter() - t0
+
+
+def _default_pack_workers() -> int:
+    """Default ``--pack-workers``: min(4, cores/4), floored at 1.  A
+    quarter of the host saturates the dispatch lane on every profile
+    measured so far (pack is at most a few times compute+write per
+    chunk) without starving the dispatch/QC/write lanes of cores."""
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    return max(1, min(4, cores // 4))
+
+
 def _pipelined_chunks(
-    clusters, worklist, backend, method, args, prefetch: int, want_qc: bool
+    clusters, worklist, backend, method, args, prefetch: int, want_qc: bool,
+    lanes: dict,
 ):
     """Producer–consumer pipeline over the chunk worklist.
 
@@ -458,7 +530,9 @@ def _pipelined_chunks(
 
     Telemetry: each pack runs under a ``pipeline:pack`` span (packer
     lane); consumer starvation >= 1 ms is recorded as a
-    ``pipeline:idle`` span and summed into the run's ``device_idle_s``."""
+    ``pipeline:idle`` span and summed into the run's ``device_idle_s``;
+    the packer's busy seconds accumulate into ``lanes["pack_busy_s"]``
+    for the run_end per-lane summary."""
     import queue
     import threading
     import time as _time
@@ -470,6 +544,8 @@ def _pipelined_chunks(
         _cosine_config(args) if want_qc and method == "bin-mean" else None
     )
     prepare = getattr(backend, "prepare_chunk", None)
+    busy = [0.0]
+    lanes["pack_busy_s"] = busy
 
     def _put(obj) -> bool:
         while not stop.is_set():
@@ -485,23 +561,11 @@ def _pipelined_chunks(
             for chunk_index, idxs in worklist:
                 if stop.is_set():
                     return
-                item = _ChunkItem(chunk_index, idxs)
-                pack_stats = RunStats()
-                try:
-                    with tracing.span(
-                        "pipeline:pack", chunk_index=chunk_index,
-                        n_clusters=len(idxs),
-                    ):
-                        with pack_stats.phase("pack"):
-                            item.part = [clusters[i] for i in idxs]
-                        if prepare is not None:
-                            item.prepared = prepare(
-                                method, item.part, config,
-                                cos_config=cos_config, stats=pack_stats,
-                            )
-                except Exception as e:  # noqa: BLE001 - handed to consumer
-                    item.error = e
-                item.pack_stats = pack_stats
+                item, elapsed = _pack_chunk(
+                    clusters, chunk_index, idxs, prepare, method, config,
+                    cos_config, "pipeline:pack",
+                )
+                busy[0] += elapsed
                 if not _put(item):
                     return
         finally:
@@ -534,6 +598,296 @@ def _pipelined_chunks(
         except queue.Empty:
             pass
         t.join()
+
+
+def _pooled_chunks(
+    clusters, worklist, backend, method, args, prefetch: int, want_qc: bool,
+    n_workers: int, lanes: dict,
+):
+    """Pack worker pool (``--pack-workers N``): N threads run the host
+    pack stage (chunk materialization + ``prepare_chunk``) on DISTINCT
+    chunks concurrently, and a bounded reorder buffer releases finished
+    chunks to the dispatch lane strictly in worklist order — so dispatch
+    order, and therefore checkpoint/resume and ``--on-error skip``
+    semantics, are identical to the single-packer and serial paths.
+
+    Threading contract: identical to ``_pipelined_chunks`` per worker —
+    pure host numpy plus a PRIVATE per-chunk RunStats; chunks never
+    share mutable state (the backend's prepare path touches no backend
+    state, the plan cache and native-library loaders are
+    lock-protected, and a streamed input's window cache is widened to
+    ``n_workers + 1`` slots below so workers on distinct windows don't
+    evict each other).  At most ``max(prefetch, n_workers)`` chunks are
+    outstanding (packing or buffered) at once, so memory stays bounded.
+
+    Telemetry: worker *i* packs under ``pipeline:pack[i]`` spans (one
+    Chrome track per worker via the span ``tid`` lane) and accumulates
+    its busy seconds into ``lanes["pack_busy_s"][i]``; head-of-line
+    blocking — the consumer starved for chunk *s* while LATER chunks sat
+    finished in the reorder buffer — accumulates into
+    ``lanes["reorder_stall_s"]``."""
+    import threading
+    import time as _time
+
+    config = _method_config(method, args)
+    cos_config = (
+        _cosine_config(args) if want_qc and method == "bin-mean" else None
+    )
+    prepare = getattr(backend, "prepare_chunk", None)
+    n_workers = max(1, min(n_workers, len(worklist)))
+    depth = max(prefetch, n_workers)
+    admit = threading.Semaphore(depth)
+    stop = threading.Event()
+    cond = threading.Condition()
+    buf: dict[int, _ChunkItem] = {}
+    state = {"next_task": 0, "exited": 0}
+    busy = [0.0] * n_workers
+    lanes["pack_busy_s"] = busy
+    if hasattr(clusters, "cache_slots"):
+        # streamed input: one window slot per worker plus the consumer's
+        # serial-retry re-walk, so concurrent lookahead can't thrash
+        clusters.cache_slots = max(
+            int(getattr(clusters, "cache_slots", 2)), n_workers + 1
+        )
+
+    def _worker(wid: int) -> None:
+        claimed: int | None = None  # claimed but not yet delivered
+        try:
+            while True:
+                admit.acquire()
+                if stop.is_set():
+                    return
+                with cond:
+                    seq = state["next_task"]
+                    if seq >= len(worklist):
+                        return
+                    state["next_task"] = seq + 1
+                claimed = seq
+                chunk_index, idxs = worklist[seq]
+                item, elapsed = _pack_chunk(
+                    clusters, chunk_index, idxs, prepare, method, config,
+                    cos_config, f"pipeline:pack[{wid}]", worker=wid,
+                )
+                busy[wid] += elapsed
+                with cond:
+                    buf[seq] = item
+                    claimed = None
+                    cond.notify_all()
+        finally:
+            with cond:
+                if claimed is not None:
+                    # the worker is dying BETWEEN claim and delivery
+                    # (BaseException outside _pack_chunk's guard, e.g.
+                    # MemoryError): deliver the claim as an errored item
+                    # so the consumer applies its --on-error policy
+                    # instead of waiting on a chunk nobody owns
+                    chunk_index, idxs = worklist[claimed]
+                    it = _ChunkItem(chunk_index, idxs)
+                    it.error = RuntimeError(
+                        f"pack worker {wid} died packing chunk {chunk_index}"
+                    )
+                    buf.setdefault(claimed, it)
+                state["exited"] += 1
+                cond.notify_all()
+
+    threads = [
+        threading.Thread(
+            target=_worker, args=(w,), name=f"specpride-packer-{w}",
+            daemon=True,
+        )
+        for w in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    stall = 0.0
+    try:
+        for seq in range(len(worklist)):
+            t_wait = _time.perf_counter()
+            with cond:
+                while seq not in buf:
+                    if state["exited"] == n_workers:
+                        # a worker died between claiming and delivering a
+                        # chunk (BaseException escaped the handler)
+                        raise RuntimeError(
+                            "pack worker pool exited without delivering "
+                            f"chunk {seq}"
+                        )
+                    blocked = bool(buf)
+                    seg0 = _time.perf_counter()
+                    cond.wait(0.1)
+                    if blocked:
+                        stall += _time.perf_counter() - seg0
+                item = buf.pop(seq)
+            waited = _time.perf_counter() - t_wait
+            item.wait_s = waited
+            if waited >= 1e-3:
+                tracing.current().complete(
+                    "pipeline:idle", t_wait, waited, chunk_index=item.index
+                )
+            admit.release()
+            yield item
+    finally:
+        stop.set()
+        for _ in threads:
+            admit.release()  # unblock workers parked on the admit gate
+        with cond:
+            cond.notify_all()
+        for t in threads:
+            t.join()
+        lanes["reorder_stall_s"] = lanes.get("reorder_stall_s", 0.0) + stall
+
+
+class _CommitItem:
+    """One finished chunk handed from the dispatch lane to the ordered
+    write lane: everything the commit protocol needs, snapshotted on the
+    dispatch lane so commits are byte-identical to serial runs."""
+
+    __slots__ = ("index", "reps", "part_ids", "qc_rows", "failed", "chunk_t0")
+
+    def __init__(self, index, reps, part_ids, qc_rows, failed, chunk_t0):
+        self.index = index
+        self.reps = reps
+        self.part_ids = part_ids
+        self.qc_rows = qc_rows  # finalized QC rows for this chunk (or None)
+        self.failed = failed  # sorted failure snapshot at submit time
+        self.chunk_t0 = chunk_t0
+
+
+def _commit_chunk(item: _CommitItem, args, journal, stats: RunStats,
+                  qc: list, done: set, first_write: bool) -> None:
+    """THE chunk commit protocol — the one copy both the inline (sync)
+    tail of ``_checkpointed_run`` and the ``_Committer`` lane execute, so
+    ``--async-write on`` and ``off`` can never drift: QC-row finalize,
+    MGF append, counters, the ``chunk_done`` heartbeat, then (with a
+    checkpoint) the atomic ``{done, output_bytes, failed}`` manifest
+    replace — strictly AFTER the append, so a kill between the two
+    leaves output past the manifest, the state resume truncates."""
+    import time as _time
+
+    if item.qc_rows:
+        qc.extend(item.qc_rows)
+    with stats.phase("write"):
+        write_mgf(item.reps, args.output, append=not first_write)
+    stats.count("clusters", len(item.part_ids))
+    stats.count("representatives", len(item.reps))
+    done.update(item.part_ids)
+    dt = _time.perf_counter() - item.chunk_t0
+    journal.emit(
+        "chunk_done", chunk_index=item.index,
+        n_clusters=len(item.part_ids),
+        n_representatives=len(item.reps), elapsed_s=round(dt, 4),
+        clusters_per_sec=round(len(item.part_ids) / dt, 2)
+        if dt > 0 else 0.0,
+    )
+    if args.checkpoint:
+        output_bytes = os.path.getsize(args.output)
+        with tracing.span("checkpoint_write", n_done=len(done)):
+            tmp = args.checkpoint + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(
+                    {
+                        "done": sorted(done),
+                        "output_bytes": output_bytes,
+                        **({"failed": item.failed} if item.failed else {}),
+                    },
+                    fh,
+                )
+            os.replace(tmp, args.checkpoint)
+        journal.emit(
+            "checkpoint_write", n_done=len(done),
+            output_bytes=output_bytes,
+        )
+
+
+class _Committer:
+    """Ordered async write/checkpoint lane (``--async-write``).
+
+    A dedicated committer thread consumes finished chunks FIFO from a
+    bounded queue and runs, per chunk, exactly the serial tail of
+    ``_checkpointed_run``: QC-row finalize, MGF append, then the atomic
+    ``{done, output_bytes, failed}`` manifest replace.  The checkpoint
+    for chunk *i* is written only after chunk *i*'s MGF bytes are
+    flushed (the writer closes the file before ``getsize``), so a kill
+    at ANY point leaves the same on-disk states a serial run can leave
+    and resume behaves identically.
+
+    The lane owns ``done``/``first_write``/the shared QC list from
+    construction on — the dispatch lane must not touch them again.
+    Phase time and counters accumulate in a private ``RunStats`` merged
+    into the run's stats at ``finish``/``shutdown`` (``RunStats.merge``
+    is not thread-safe, so the fold happens after the join).  A commit
+    error is re-raised on the dispatch lane at the next ``submit`` or
+    at ``finish``; after an error the lane keeps draining its queue so
+    the dispatch lane can never deadlock on a full queue."""
+
+    def __init__(self, args, journal, qc, done: set, first_write: bool,
+                 depth: int):
+        import queue
+        import threading
+
+        self._args = args
+        self._journal = journal
+        self._qc = qc
+        self._done = done
+        self._first_write = first_write
+        self.stats = RunStats()
+        self.busy_s = 0.0
+        self.error: BaseException | None = None
+        self._merged = False
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._thread = threading.Thread(
+            target=self._run, name="specpride-committer", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, item: _CommitItem) -> None:
+        if self.error is not None:
+            self.finish(None)  # raises the commit error on this lane
+        self._q.put(item)
+
+    def _run(self) -> None:
+        import time as _time
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self.error is not None:
+                continue  # drain without acting; submit() will re-raise
+            t0 = _time.perf_counter()
+            try:
+                with tracing.span(
+                    "pipeline:write", chunk_index=item.index,
+                    n_clusters=len(item.part_ids),
+                ):
+                    self._commit(item)
+            except BaseException as e:  # noqa: BLE001 - re-raised on submit
+                self.error = e
+            self.busy_s += _time.perf_counter() - t0
+
+    def _commit(self, item: _CommitItem) -> None:
+        _commit_chunk(
+            item, self._args, self._journal, self.stats, self._qc,
+            self._done, self._first_write,
+        )
+        self._first_write = False
+
+    def finish(self, stats: RunStats | None) -> None:
+        """Flush every queued commit, stop the lane, fold its counters
+        and phase time into ``stats``, and re-raise any commit error."""
+        self.shutdown(stats)
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+
+    def shutdown(self, stats: RunStats | None) -> None:
+        """Idempotent stop: drain + join, merge once, never raise."""
+        if self._thread.is_alive():
+            self._q.put(None)
+        self._thread.join()
+        if stats is not None and not self._merged:
+            self._merged = True
+            stats.merge(self.stats)
 
 
 def _checkpointed_run(
@@ -675,177 +1029,221 @@ def _checkpointed_run(
     # the pipeline needs >= 2 chunks to overlap anything; a single-chunk
     # run takes the serial path so it never pays for a packer thread
     pipelined = prefetch > 0 and len(worklist) > 1
-    if pipelined:
+    pw = getattr(args, "pack_workers", None)
+    n_workers = _default_pack_workers() if pw is None else max(int(pw), 0)
+    lanes: dict = {"pack_busy_s": [], "reorder_stall_s": 0.0}
+    if pipelined and n_workers >= 1:
+        items = _pooled_chunks(
+            clusters, worklist, backend, method, args, prefetch,
+            qc is not None, n_workers, lanes,
+        )
+    elif pipelined:
         items = _pipelined_chunks(
             clusters, worklist, backend, method, args, prefetch,
-            qc is not None,
+            qc is not None, lanes,
         )
     else:
         items = _serial_chunks(clusters, worklist)
+    aw = getattr(args, "async_write", "auto")
+    committer = (
+        _Committer(
+            args, journal, qc if qc is not None else [], done, first_write,
+            depth=max(prefetch, 1),
+        )
+        if worklist and (aw == "on" or (aw == "auto" and pipelined))
+        else None
+    )
     idle_s = 0.0
     loop_t0 = _time.perf_counter()
 
-    for item in items:
-        chunk_index, part = item.index, item.part
-        idle_s += item.wait_s
-        if item.pack_stats is not None:
-            # packer-thread time lands in the run's `pack` phase (NOT in
-            # the consumer's compute wall time), so the phase report and
-            # the compute+write throughput stay truthful under prefetch
-            stats.merge(item.pack_stats)
-        journal.emit(
-            "chunk_start", chunk_index=chunk_index, n_clusters=len(item.idxs)
-        )
-        # the per-chunk span is the trace's unit of progress: everything a
-        # chunk does (compute, QC, write, checkpoint) nests under it, so a
-        # straggler chunk is visible as one long slice on the timeline
-        # (closed in the finally — an abort mid-chunk must not leak an
-        # open span onto the tracer's per-thread stack)
-        chunk_span = tracing.span(
-            "chunk", chunk_index=chunk_index, n_clusters=len(item.idxs)
-        )
-        chunk_span.__enter__()
-        try:
-            chunk_t0 = _time.perf_counter()
-            n_qc_before = len(qc) if qc is not None else 0
+    try:
+        for item in items:
+            chunk_index, part = item.index, item.part
+            idle_s += item.wait_s
+            if item.pack_stats is not None:
+                # packer-thread time lands in the run's `pack` phase (NOT in
+                # the consumer's compute wall time), so the phase report and
+                # the compute+write throughput stay truthful under prefetch
+                stats.merge(item.pack_stats)
+            journal.emit(
+                "chunk_start", chunk_index=chunk_index, n_clusters=len(item.idxs)
+            )
+            # the per-chunk span is the trace's unit of progress: everything a
+            # chunk does (compute, QC, write, checkpoint) nests under it, so a
+            # straggler chunk is visible as one long slice on the timeline
+            # (closed in the finally — an abort mid-chunk must not leak an
+            # open span onto the tracer's per-thread stack)
+            chunk_span = tracing.span(
+                "chunk", chunk_index=chunk_index, n_clusters=len(item.idxs)
+            )
+            chunk_span.__enter__()
             try:
-                if item.error is not None:
-                    # a pack-stage failure surfaces here so --on-error
-                    # keeps one policy for the whole chunk lifecycle
-                    raise item.error
-                if item.prepared is not None:
-                    with stats.phase("compute"):
-                        reps, chunk_cosines = backend.run_prepared(
-                            item.prepared
-                        )
-                    if qc is not None and chunk_cosines is not None:
-                        _append_qc_rows(qc, part, chunk_cosines)
-                else:
-                    with stats.phase("compute"):
-                        reps = _run_method(
-                            backend, method, part, args, scores=scores, qc=qc
-                        )
-            except (ValueError, RuntimeError) as e:
-                # per-chunk failure isolation (survey §5 failure
-                # detection): with --on-error skip, a chunk whose input is
-                # bad (e.g. mixed charge states) is retried
-                # cluster-by-cluster so only the offending clusters are
-                # dropped — logged and recorded in the manifest, never
-                # silently
-                if on_error != "skip":
-                    raise
-                if part is None:
-                    # the packer died while materializing this chunk; the
-                    # serial retry below needs the clusters themselves
-                    part = [clusters[i] for i in item.idxs]
-                logger.warning(
-                    "chunk of %d clusters failed (%s); retrying one by one",
-                    len(part), e,
-                )
-                reps, bad_part = [], []
-                with stats.phase("compute"):
-                    for c in part:
-                        try:
-                            reps.extend(
-                                _run_method(
-                                    backend, method, [c], args,
-                                    scores=scores, qc=qc,
-                                )
-                            )
-                        except (ValueError, RuntimeError) as ce:
-                            logger.warning(
-                                "skipping cluster %s: %s", c.cluster_id, ce
-                            )
-                            bad_part.append(c.cluster_id)
-                failed.update(dict.fromkeys(bad_part))
-                stats.count("clusters_failed", len(bad_part))
-            if qc is not None and len(qc) == n_qc_before and reps:
-                # ONE QC site for every non-fused method (the fused
-                # bin-mean path appends inside _run_method, detected by
-                # len(qc)): align reps to clusters by id — best-spectrum
-                # may drop scoreless clusters — and never let a QC failure
-                # veto the representatives the method already produced
+                chunk_t0 = _time.perf_counter()
+                # per-chunk QC rows buffer: rows land in the shared report
+                # list only at commit time (inline below, or on the write
+                # lane), so the committer can own "QC finalize" without the
+                # dispatch lane ever racing it on the list
+                chunk_qc: list | None = [] if qc is not None else None
                 try:
-                    by_id = {r.cluster_id: r for r in reps}
-                    kept = [c for c in part if c.cluster_id in by_id]
-                    with stats.phase("compute"), tracing.span(
-                        "qc", n_clusters=len(kept)
-                    ):
-                        _append_qc_rows(
-                            qc, kept,
-                            _cosines_of(
-                                backend,
-                                [by_id[c.cluster_id] for c in kept], kept,
-                                _cosine_config(args),
-                            ),
-                        )
+                    if item.error is not None:
+                        # a pack-stage failure surfaces here so --on-error
+                        # keeps one policy for the whole chunk lifecycle
+                        raise item.error
+                    if item.prepared is not None:
+                        with stats.phase("compute"):
+                            reps, chunk_cosines = backend.run_prepared(
+                                item.prepared
+                            )
+                        if chunk_qc is not None and chunk_cosines is not None:
+                            _append_qc_rows(chunk_qc, part, chunk_cosines)
+                    else:
+                        with stats.phase("compute"):
+                            reps = _run_method(
+                                backend, method, part, args, scores=scores,
+                                qc=chunk_qc,
+                            )
                 except (ValueError, RuntimeError) as e:
+                    # per-chunk failure isolation (survey §5 failure
+                    # detection): with --on-error skip, a chunk whose input is
+                    # bad (e.g. mixed charge states) is retried
+                    # cluster-by-cluster so only the offending clusters are
+                    # dropped — logged and recorded in the manifest, never
+                    # silently
+                    if on_error != "skip":
+                        raise
+                    if part is None:
+                        # the packer died while materializing this chunk; the
+                        # serial retry below needs the clusters themselves
+                        part = [clusters[i] for i in item.idxs]
                     logger.warning(
-                        "QC cosines failed for a %d-cluster chunk (%s); "
-                        "their rows are omitted from the report",
+                        "chunk of %d clusters failed (%s); retrying one by one",
                         len(part), e,
                     )
-                    # machine-readable trace for the report summary:
-                    # consumers must be able to tell "row dropped by the
-                    # method" from "QC itself failed" (advisor r4)
-                    qc_failed.update(
-                        dict.fromkeys(c.cluster_id for c in part)
-                    )
-                    journal.emit(
-                        "qc_failure",
-                        cluster_ids=[c.cluster_id for c in part],
-                        error=str(e),
-                    )
-            with stats.phase("write"):
-                write_mgf(reps, args.output, append=not first_write)
-            first_write = False
-            stats.count("clusters", len(part))
-            stats.count("representatives", len(reps))
-            done.update(c.cluster_id for c in part)
-            chunk_dt = _time.perf_counter() - chunk_t0
-            journal.emit(
-                "chunk_done", chunk_index=chunk_index, n_clusters=len(part),
-                n_representatives=len(reps), elapsed_s=round(chunk_dt, 4),
-                clusters_per_sec=round(len(part) / chunk_dt, 2)
-                if chunk_dt > 0 else 0.0,
-            )
-            if args.checkpoint:
-                output_bytes = os.path.getsize(args.output)
-                with tracing.span("checkpoint_write", n_done=len(done)):
-                    tmp = args.checkpoint + ".tmp"
-                    with open(tmp, "w") as fh:
-                        json.dump(
-                            {
-                                "done": sorted(done),
-                                "output_bytes": output_bytes,
-                                **(
-                                    {"failed": sorted(failed)}
-                                    if failed else {}
+                    reps, bad_part = [], []
+                    with stats.phase("compute"):
+                        for c in part:
+                            try:
+                                reps.extend(
+                                    _run_method(
+                                        backend, method, [c], args,
+                                        scores=scores, qc=chunk_qc,
+                                    )
+                                )
+                            except (ValueError, RuntimeError) as ce:
+                                logger.warning(
+                                    "skipping cluster %s: %s", c.cluster_id, ce
+                                )
+                                bad_part.append(c.cluster_id)
+                    failed.update(dict.fromkeys(bad_part))
+                    stats.count("clusters_failed", len(bad_part))
+                if chunk_qc is not None and not chunk_qc and reps:
+                    # ONE QC site for every non-fused method (the fused
+                    # bin-mean path appends inside _run_method, detected by
+                    # the buffer staying empty): align reps to clusters by id
+                    # — best-spectrum may drop scoreless clusters — and never
+                    # let a QC failure veto the representatives the method
+                    # already produced.  The cosine COMPUTE stays on this
+                    # lane (it may dispatch to the device); only the finished
+                    # rows ride to the committer.
+                    try:
+                        by_id = {r.cluster_id: r for r in reps}
+                        kept = [c for c in part if c.cluster_id in by_id]
+                        with stats.phase("compute"), tracing.span(
+                            "qc", n_clusters=len(kept)
+                        ):
+                            _append_qc_rows(
+                                chunk_qc, kept,
+                                _cosines_of(
+                                    backend,
+                                    [by_id[c.cluster_id] for c in kept], kept,
+                                    _cosine_config(args),
                                 ),
-                            },
-                            fh,
+                            )
+                    except (ValueError, RuntimeError) as e:
+                        logger.warning(
+                            "QC cosines failed for a %d-cluster chunk (%s); "
+                            "their rows are omitted from the report",
+                            len(part), e,
                         )
-                    os.replace(tmp, args.checkpoint)
-                journal.emit(
-                    "checkpoint_write", n_done=len(done),
-                    output_bytes=output_bytes,
+                        # machine-readable trace for the report summary:
+                        # consumers must be able to tell "row dropped by the
+                        # method" from "QC itself failed" (advisor r4)
+                        qc_failed.update(
+                            dict.fromkeys(c.cluster_id for c in part)
+                        )
+                        journal.emit(
+                            "qc_failure",
+                            cluster_ids=[c.cluster_id for c in part],
+                            error=str(e),
+                        )
+                commit_item = _CommitItem(
+                    chunk_index, reps, [c.cluster_id for c in part],
+                    chunk_qc, sorted(failed) if failed else None, chunk_t0,
                 )
-        finally:
-            chunk_span.__exit__(None, None, None)
-    if pipelined:
+                if committer is not None:
+                    # ordered write lane: the whole commit tail (QC finalize,
+                    # MGF append, manifest replace, chunk_done heartbeat)
+                    # runs on the committer thread, FIFO.  Everything the
+                    # protocol needs is snapshotted here so the manifest
+                    # bytes match a serial run's exactly.
+                    committer.submit(commit_item)
+                else:
+                    _commit_chunk(
+                        commit_item, args, journal, stats,
+                        qc if qc is not None else [], done, first_write,
+                    )
+                    first_write = False
+            finally:
+                chunk_span.__exit__(None, None, None)
+        if committer is not None:
+            # flush queued commits before the pipeline wall/lane summary so
+            # write-lane time is inside the measured wall (and the output +
+            # manifest are complete before the QC report re-reads them);
+            # re-raises a commit error on this lane
+            committer.finish(stats)
+    finally:
+        close = getattr(items, "close", None)
+        if close is not None:
+            # stop the pack lanes NOW on a dispatch-lane abort — an
+            # un-closed generator would only run its cleanup (stop +
+            # join) whenever the traceback gets collected, leaving
+            # workers parked on the admit gate holding packed chunks
+            close()
+        if committer is not None:
+            # a dispatch-lane abort must not leak the committer
+            # thread; shutdown flushes chunks already queued (a
+            # serial run would have written them before the
+            # failing chunk too) and folds their counters in
+            committer.shutdown(stats)
+    if pipelined or committer is not None:
         # device_idle_s: time the dispatch lane sat starved waiting on the
-        # packer — the overlap shortfall.  Journaled in run_end (and
+        # pack lane — the overlap shortfall.  Journaled in run_end (and
         # surfaced by `specpride stats`) so the pipeline's win/loss is
         # measurable per run: overlap_efficiency = 1 - idle / wall.
+        # Per-lane busy seconds and the reorder-buffer stall time make
+        # the three lanes' load visible without opening a trace.
         wall = _time.perf_counter() - loop_t0
         stats.pipeline = {
             "prefetch": prefetch,
+            # the EFFECTIVE pool size: _pooled_chunks clamps to the chunk
+            # count, and the per-worker busy list must match it (0 = the
+            # dedicated single packer / no pipeline)
+            "pack_workers": (
+                len(lanes["pack_busy_s"])
+                if pipelined and n_workers >= 1 else 0
+            ),
+            "async_write": committer is not None,
             "n_chunks": len(worklist),
             "device_idle_s": round(idle_s, 4),
             "wall_s": round(wall, 4),
             "overlap_efficiency": (
                 round(1.0 - idle_s / wall, 4) if wall > 0 else None
             ),
+            "pack_busy_s": [round(b, 4) for b in lanes["pack_busy_s"]],
+            "write_busy_s": (
+                round(committer.busy_s, 4) if committer is not None else 0.0
+            ),
+            "reorder_stall_s": round(lanes["reorder_stall_s"], 4),
         }
     if failed:
         logger.warning(

@@ -238,8 +238,11 @@ class StreamedClusters:
         # ahead while the consumer may re-walk window W cluster by
         # cluster for its serial retry (--on-error skip) — a single slot
         # would ping-pong and re-parse a full window per index access.
-        # Peak RSS stays O(index + 2 windows); the lock serializes the
-        # cache against the same two threads.
+        # ``cache_slots`` is the capacity: the pack worker pool raises it
+        # to workers+1 so concurrent lookahead on distinct windows can't
+        # evict each other.  Peak RSS stays O(index + cache_slots
+        # windows); the lock serializes the cache against every lane.
+        self.cache_slots = 2
         self._windows: dict[int, list[Cluster]] = {}
         import threading
 
@@ -308,7 +311,8 @@ class StreamedClusters:
         parsed = self._materialize(self._groups[lo : lo + self.window])
         with self._cache_lock:
             cached = self._windows.pop(lo, parsed)
-            while len(self._windows) >= 2:  # evict least-recently USED
+            slots = max(int(self.cache_slots), 1)
+            while len(self._windows) >= slots:  # evict least-recently USED
                 self._windows.pop(next(iter(self._windows)))
             self._windows[lo] = cached
             return cached[i - lo]
@@ -383,6 +387,16 @@ def format_spectrum(spectrum: Spectrum, skip_nan: bool = True) -> str:
     return "\n".join(lines) + "\n\n"
 
 
+def _write_records(fh: IO[str], spectra) -> int:
+    """Stream records into an open text sink; returns the record count.
+    The ONE formatting loop all three ``write_mgf`` targets share."""
+    n = 0
+    for s in spectra:
+        fh.write(format_spectrum(s))
+        n += 1
+    return n
+
+
 def write_mgf(
     spectra: Sequence[Spectrum] | Iterator[Spectrum],
     path_or_file: str | os.PathLike | IO[str] | None,
@@ -393,20 +407,30 @@ def write_mgf(
     Streams one record at a time — never materialises the whole file in
     memory.  ``append`` reproduces the reference's ``--append`` output mode
     (ref src/average_spectrum_clustering.py:183-184,198).
+
+    All three targets run under the same traced writer: every branch
+    opens a ``write:mgf`` span with an ``n_spectra`` note, so a trace of
+    a run that writes through a file object (multi-part shards, tests)
+    or builds a string accounts for its write time like the path branch
+    always did.
     """
     if path_or_file is None:
-        return "".join(format_spectrum(s) for s in spectra)
+        with tracing.span("write:mgf", path=None, append=False) as sp:
+            buf = io.StringIO()
+            sp.note(n_spectra=_write_records(buf, spectra))
+            return buf.getvalue()
     if hasattr(path_or_file, "write"):
-        for s in spectra:
-            path_or_file.write(format_spectrum(s))  # type: ignore[union-attr]
+        # the caller opened the file: its mode (append vs truncate) is
+        # unknowable here, so the label must not claim either
+        with tracing.span(
+            "write:mgf", path=str(getattr(path_or_file, "name", "<stream>")),
+            append=None,
+        ) as sp:
+            sp.note(n_spectra=_write_records(path_or_file, spectra))
         return None
     mode = "a" if append else "w"
     with tracing.span("write:mgf", path=os.fspath(path_or_file),
                       append=append) as sp:
-        n = 0
         with open(os.fspath(path_or_file), mode, encoding="utf-8") as fh:
-            for s in spectra:
-                fh.write(format_spectrum(s))
-                n += 1
-        sp.note(n_spectra=n)
+            sp.note(n_spectra=_write_records(fh, spectra))
     return None

@@ -47,6 +47,10 @@ EVENT_FIELDS: dict[str, frozenset] = {
     "resume": frozenset({"n_done"}),
     "qc_failure": frozenset({"cluster_ids"}),
     "skipped_clusters": frozenset({"cluster_ids"}),
+    # device-availability routing: a backend substituted an equivalent
+    # execution path for the requested layout (e.g. gap-average on a
+    # CPU-only host) — emitted once per backend per decision
+    "routing": frozenset({"method", "path", "reason"}),
     "bench_run": frozenset({"method", "phases_s"}),
     "run_end": frozenset({"counters", "phases_s", "elapsed_s", "device"}),
     # v2: one finished tracing span (observability.tracing).  The span's

@@ -90,11 +90,18 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
         )
         pipeline = end.get("pipeline")
         if pipeline:
-            # pipelined chunk executor (--prefetch): how starved the
-            # dispatch lane was while the packer thread ran ahead
+            # multi-lane chunk executor (--prefetch / --pack-workers /
+            # --async-write): dispatch-lane starvation, per-lane busy
+            # seconds, and reorder-buffer head-of-line stall time
             run["prefetch"] = pipeline.get("prefetch")
             run["device_idle_s"] = pipeline.get("device_idle_s")
             run["overlap_efficiency"] = pipeline.get("overlap_efficiency")
+            for key in (
+                "pack_workers", "async_write", "wall_s", "pack_busy_s",
+                "write_busy_s", "reorder_stall_s",
+            ):
+                if pipeline.get(key) is not None:
+                    run[key] = pipeline[key]
     else:
         # dead run: the heartbeats are all we have — surface the last one
         run["compile_count"] = compiles
@@ -139,11 +146,32 @@ def _render_run(run: dict, out) -> None:
             file=out,
         )
     if run.get("device_idle_s") is not None:
+        # lane fields only exist in multi-lane-era journals; PR3-era
+        # pipeline summaries must render without literal None noise
+        lane_bits = "".join(
+            f" {key}={run[key]}"
+            for key in ("pack_workers", "async_write")
+            if run.get(key) is not None
+        )
         print(
-            f"  pipeline: prefetch={run.get('prefetch')} "
+            f"  pipeline: prefetch={run.get('prefetch')}{lane_bits} "
             f"device_idle_s={run['device_idle_s']:.3f} "
             f"overlap_efficiency={run.get('overlap_efficiency')}", file=out,
         )
+        if run.get("pack_busy_s") is not None:
+            wall = run.get("wall_s") or 0.0
+            busy = run["pack_busy_s"]
+            pack = ",".join(f"{b:.3f}" for b in busy) if busy else "-"
+            frac = (
+                f" ({sum(busy) / (wall * max(len(busy), 1)):.0%} busy)"
+                if wall > 0 and busy else ""
+            )
+            print(
+                f"  lanes: pack_busy_s=[{pack}]{frac} "
+                f"write_busy_s={run.get('write_busy_s', 0.0):.3f} "
+                f"reorder_stall_s={run.get('reorder_stall_s', 0.0):.3f}",
+                file=out,
+            )
     print(
         f"  device: compile_count={run['compile_count']} "
         f"dispatches={run['dispatch_count']} "

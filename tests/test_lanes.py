@@ -1,0 +1,393 @@
+"""Multi-lane executor (--pack-workers / --async-write): byte parity
+against the serial path across the lane matrix, kill/resume with the
+committer lane active, forced out-of-order pack completion through the
+reorder buffer, per-lane run_end telemetry, the CPU-only gap-average
+device routing, and the unified traced MGF writer."""
+
+import json
+import os
+
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.io.mgf import read_mgf, write_mgf
+
+from conftest import make_cluster
+
+
+def _workload(rng, n=9, **kw):
+    return [
+        make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=25, **kw)
+        for i in range(n)
+    ]
+
+
+def _write(tmp_path, clusters):
+    path = tmp_path / "clustered.mgf"
+    write_mgf([s for c in clusters for s in c.members], path)
+    return path
+
+
+class TestLaneParity:
+    @pytest.mark.parametrize("method,command", [
+        ("bin-mean", "consensus"),
+        ("gap-average", "consensus"),
+        ("medoid", "select"),
+    ])
+    def test_byte_identical_across_lane_matrix(
+        self, tmp_path, rng, method, command
+    ):
+        """Every (pack-workers, async-write) combination must produce the
+        serial run's exact MGF bytes AND checkpoint manifest: the lanes
+        change scheduling, never results or resume state."""
+        clustered = _write(tmp_path, _workload(rng))
+        golden = golden_manifest = None
+        combos = [("serial", ["--prefetch", "0"])] + [
+            (
+                f"pw{pw}_{aw}",
+                ["--prefetch", "4", "--pack-workers", str(pw),
+                 "--async-write", aw],
+            )
+            for pw in (0, 1, 4)
+            for aw in ("on", "off")
+        ]
+        for tag, extra in combos:
+            out = tmp_path / f"out_{tag}.mgf"
+            ckpt = tmp_path / f"ck_{tag}.json"
+            assert cli_main([
+                command, str(clustered), str(out), "--method", method,
+                "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+            ] + extra) == 0
+            data = out.read_bytes()
+            manifest = json.loads(ckpt.read_text())
+            if golden is None:
+                golden, golden_manifest = data, manifest
+            else:
+                assert data == golden, (method, tag)
+                assert manifest == golden_manifest, (method, tag)
+
+    def test_qc_report_identical_with_committer(self, tmp_path, rng):
+        """QC rows finalize on the committer lane under --async-write;
+        the report must still match the serial run byte for byte."""
+        clustered = _write(tmp_path, _workload(rng))
+        reports = {}
+        for tag, extra in (
+            ("serial", ["--prefetch", "0"]),
+            ("lanes", ["--prefetch", "4", "--pack-workers", "4",
+                       "--async-write", "on"]),
+        ):
+            out = tmp_path / f"o_{tag}.mgf"
+            qc = tmp_path / f"qc_{tag}.json"
+            assert cli_main([
+                "consensus", str(clustered), str(out),
+                "--checkpoint", str(tmp_path / f"c_{tag}.json"),
+                "--checkpoint-every", "3", "--qc-report", str(qc),
+            ] + extra) == 0
+            reports[tag] = qc.read_bytes()
+        assert reports["serial"] == reports["lanes"]
+
+    def test_kill_resume_with_committer_lane(self, tmp_path, rng):
+        """A mid-run kill (committed partial manifest + an orphaned torn
+        append) resumed with the full lane stack active must converge to
+        the serial golden bytes — the committer writes checkpoint i only
+        after chunk i's MGF bytes are flushed, so every crash state it
+        can leave is one the serial path could also leave."""
+        clusters = _workload(rng, n=8)
+        clustered = _write(tmp_path, clusters)
+
+        golden = tmp_path / "golden.mgf"
+        assert cli_main([
+            "consensus", str(clustered), str(golden), "--prefetch", "0",
+            "--checkpoint", str(tmp_path / "g.json"),
+            "--checkpoint-every", "2",
+        ]) == 0
+        golden_bytes = golden.read_bytes()
+
+        head_src = tmp_path / "head.mgf"
+        write_mgf([s for c in clusters[:2] for s in c.members], head_src)
+        out = tmp_path / "out.mgf"
+        assert cli_main([
+            "consensus", str(head_src), str(out), "--prefetch", "0",
+        ]) == 0
+        committed = out.stat().st_size
+        assert golden_bytes.startswith(out.read_bytes())
+        with open(out, "ab") as fh:
+            fh.write(b"BEGIN IONS\nTITLE=torn-orphan\n")
+        ckpt = tmp_path / "ckpt.json"
+        ckpt.write_text(json.dumps({
+            "done": ["cluster-0", "cluster-1"], "output_bytes": committed,
+        }))
+        assert cli_main([
+            "consensus", str(clustered), str(out), "--prefetch", "4",
+            "--pack-workers", "4", "--async-write", "on",
+            "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+        ]) == 0
+        assert out.read_bytes() == golden_bytes
+
+    def test_on_error_skip_with_lanes(self, tmp_path, rng):
+        """--on-error skip with a poisoned cluster under the full lane
+        stack: the pack-pool failure must still route through the
+        consumer's per-cluster serial retry and record exactly the bad
+        cluster — same output and manifest as serial."""
+        good = _workload(rng, n=5)
+        bad = make_cluster(rng, "cluster-bad", n_members=2, n_peaks=15)
+        bad.members[1].precursor_charge = bad.members[0].precursor_charge + 1
+        clusters = good[:2] + [bad] + good[2:]
+        clustered = tmp_path / "clustered.mgf"
+        write_mgf([s for c in clusters for s in c.members], clustered)
+        outs = {}
+        for tag, extra in (
+            ("serial", ["--prefetch", "0"]),
+            ("lanes", ["--prefetch", "2", "--pack-workers", "3",
+                       "--async-write", "on"]),
+        ):
+            out = tmp_path / f"out_{tag}.mgf"
+            ckpt = tmp_path / f"ck_{tag}.json"
+            assert cli_main([
+                "consensus", str(clustered), str(out), "--on-error", "skip",
+                "--checkpoint", str(ckpt), "--checkpoint-every", "2",
+            ] + extra) == 0
+            outs[tag] = out.read_bytes()
+            assert json.loads(ckpt.read_text())["failed"] == ["cluster-bad"]
+        assert outs["serial"] == outs["lanes"]
+        assert sorted(s.title for s in read_mgf(tmp_path / "out_lanes.mgf")) \
+            == sorted(c.cluster_id for c in good)
+
+    def test_abort_shuts_all_lanes_down(self, tmp_path, rng):
+        """Default --on-error abort with the bad cluster in an EARLY
+        chunk of a longer worklist: the pack-pool error propagates and
+        neither pool workers nor the committer thread survive — the
+        executor must close its lanes on the abort path, not rely on the
+        worklist being exhausted before the failure."""
+        bad = make_cluster(rng, "cluster-bad", n_members=2, n_peaks=15)
+        bad.members[1].precursor_charge = bad.members[0].precursor_charge + 1
+        clusters = [bad] + _workload(rng, n=12)
+        clustered = _write(tmp_path, clusters)
+        with pytest.raises(ValueError):
+            cli_main([
+                "consensus", str(clustered), str(tmp_path / "x.mgf"),
+                "--prefetch", "2", "--pack-workers", "4",
+                "--async-write", "on",
+                "--checkpoint", str(tmp_path / "c.json"),
+                "--checkpoint-every", "1",
+            ])
+        import threading
+
+        assert not [
+            t for t in threading.enumerate()
+            if t.name.startswith(("specpride-packer", "specpride-committer"))
+            and t.is_alive()
+        ]
+
+
+class TestReorderBuffer:
+    def test_out_of_order_pack_completion_releases_fifo(self, tmp_path, rng):
+        """Force chunk 0's pack to finish LAST: later chunks must wait in
+        the reorder buffer (reorder_stall_s > 0), and the output must
+        still be the serial bytes — FIFO release is the ordering
+        contract, not pack completion order."""
+        import time
+
+        from specpride_tpu import cli as cli_mod
+        from specpride_tpu.backends import numpy_backend as nb
+        from specpride_tpu.observability import RunStats
+
+        clusters = _workload(rng, n=8)
+
+        class SlowHead(list):
+            """Delays every materialization of clusters 0/1 (chunk 0)
+            so pool workers complete chunks 1..3 first."""
+
+            def __getitem__(self, i):
+                if i in (0, 1):
+                    time.sleep(0.15)
+                return super().__getitem__(i)
+
+        def run(source, extra):
+            n = len(list(tmp_path.iterdir()))
+            out = tmp_path / f"out_{n}.mgf"
+            args = cli_mod.build_parser().parse_args([
+                "consensus", "in.mgf", str(out), "--backend", "numpy",
+                "--checkpoint", str(tmp_path / f"ck_{n}.json"),
+                "--checkpoint-every", "2",
+            ] + extra)
+            stats = RunStats()
+            cli_mod._checkpointed_run(args=args, backend=nb,
+                                      method="bin-mean", clusters=source,
+                                      stats=stats)
+            return out.read_bytes(), stats.pipeline
+
+        golden, _ = run(list(clusters), ["--prefetch", "0"])
+        data, pipe = run(SlowHead(clusters), [
+            "--prefetch", "4", "--pack-workers", "4", "--async-write", "on",
+        ])
+        assert data == golden
+        assert pipe["pack_workers"] == 4 and pipe["async_write"] is True
+        assert pipe["reorder_stall_s"] > 0.0
+        assert len(pipe["pack_busy_s"]) == 4
+
+    def test_run_end_pipeline_lane_fields(self, tmp_path, rng):
+        """run_end.pipeline carries the per-lane summary and `specpride
+        stats` renders it."""
+        clustered = _write(tmp_path, _workload(rng))
+        journal = tmp_path / "run.jsonl"
+        agg = tmp_path / "agg.json"
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "o.mgf"),
+            "--prefetch", "2", "--pack-workers", "2", "--async-write", "on",
+            "--checkpoint", str(tmp_path / "c.json"),
+            "--checkpoint-every", "2", "--journal", str(journal),
+        ]) == 0
+        events = [json.loads(l) for l in journal.read_text().splitlines()]
+        end = [e for e in events if e["event"] == "run_end"][-1]
+        pipe = end["pipeline"]
+        assert pipe["pack_workers"] == 2 and pipe["async_write"] is True
+        assert len(pipe["pack_busy_s"]) == 2
+        assert pipe["write_busy_s"] >= 0.0
+        assert pipe["reorder_stall_s"] >= 0.0
+        # worker spans carry their lane index; the committer has its own
+        span_names = {e["name"] for e in events if e["event"] == "span"}
+        assert any(n.startswith("pipeline:pack[") for n in span_names)
+        assert "pipeline:write" in span_names
+        # commit protocol order is auditable from the journal: every
+        # checkpoint_write follows its chunk's chunk_done, n_done grows
+        order = [
+            e for e in events
+            if e["event"] in ("chunk_done", "checkpoint_write")
+        ]
+        n_done = 0
+        for prev, cur in zip(order, order[1:]):
+            if cur["event"] == "checkpoint_write":
+                assert prev["event"] == "chunk_done"
+                assert cur["n_done"] > n_done
+                n_done = cur["n_done"]
+        import subprocess
+        import sys
+
+        res = subprocess.run(
+            [sys.executable, "-m", "specpride_tpu", "stats", str(journal),
+             "--json", str(agg)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert res.returncode == 0, res.stderr
+        assert "reorder_stall_s" in res.stdout
+        run = json.loads(agg.read_text())["runs"][0]
+        assert run["pack_workers"] == 2
+        assert "write_busy_s" in run and "pack_busy_s" in run
+
+
+class TestGapAverageRouting:
+    def _gap_clusters(self, rng):
+        from test_tpu_parity import make_gap_safe_cluster
+
+        return [
+            make_gap_safe_cluster(rng, f"cluster-{i}", n_members=3)
+            for i in range(5)
+        ]
+
+    def test_cpu_only_bucketized_routes_to_host(self, rng):
+        """On a CPU-only host, --layout bucketized gap-average runs the
+        vectorized host consensus (same results, ~3x faster here) and
+        journals the decision exactly once."""
+        from specpride_tpu.backends import numpy_backend as nb
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+
+        events = []
+
+        class Capture:
+            enabled = True
+
+            def emit(self, event, **fields):
+                events.append({"event": event, **fields})
+                return {}
+
+        backend = TpuBackend(layout="bucketized")
+        backend.journal = Capture()
+        clusters = self._gap_clusters(rng)
+        out = backend.run_gap_average(clusters)
+        backend.run_gap_average(clusters)  # second call: no duplicate event
+        oracle = nb.run_gap_average(clusters)
+        for o, d in zip(oracle, out):
+            assert o.n_peaks == d.n_peaks
+        routing = [e for e in events if e["event"] == "routing"]
+        assert routing == [{
+            "event": "routing", "method": "gap-average",
+            "path": "host-vectorized", "reason": "cpu-only-devices",
+        }]
+        # the host path dispatched no gap kernel
+        assert not [e for e in events if e["event"] == "dispatch"]
+
+    def test_force_device_keeps_kernel(self, rng):
+        """--force-device pins the requested device path: the bucketized
+        kernel dispatches and no routing event is emitted."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+
+        events = []
+
+        class Capture:
+            enabled = True
+
+            def emit(self, event, **fields):
+                events.append({"event": event, **fields})
+                return {}
+
+        backend = TpuBackend(layout="bucketized", force_device=True)
+        backend.journal = Capture()
+        backend.run_gap_average(self._gap_clusters(rng))
+        assert not [e for e in events if e["event"] == "routing"]
+        assert [
+            e for e in events
+            if e["event"] == "dispatch"
+            and e["kernel"] == "gap_average_compact"
+        ]
+
+    def test_cli_force_device_flag(self, tmp_path, rng):
+        """The CLI flag reaches the backend, and the default CLI path
+        journals the routing decision on CPU-only hosts."""
+        clustered = _write(tmp_path, _workload(rng, n=4))
+        journal = tmp_path / "run.jsonl"
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "o.mgf"),
+            "--method", "gap-average", "--layout", "bucketized",
+            "--journal", str(journal),
+        ]) == 0
+        events = [json.loads(l) for l in journal.read_text().splitlines()]
+        assert [e for e in events if e["event"] == "routing"]
+        journal2 = tmp_path / "run2.jsonl"
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "o2.mgf"),
+            "--method", "gap-average", "--layout", "bucketized",
+            "--force-device", "--journal", str(journal2),
+        ]) == 0
+        events2 = [json.loads(l) for l in journal2.read_text().splitlines()]
+        assert not [e for e in events2 if e["event"] == "routing"]
+
+
+class TestUnifiedMgfWriter:
+    def test_all_three_branches_traced(self, tmp_path, rng):
+        """File-path, file-object and string targets all open the same
+        write:mgf span with an n_spectra note (previously only the path
+        branch was traced)."""
+        import io
+
+        from specpride_tpu.observability import Tracer
+        from specpride_tpu.observability import tracing
+
+        spectra = [s for c in _workload(rng, n=2) for s in c.members]
+        prev = tracing.set_current(Tracer(keep=True))
+        try:
+            write_mgf(spectra, tmp_path / "a.mgf")
+            sink = io.StringIO()
+            write_mgf(spectra, sink)
+            text = write_mgf(spectra, None)
+            tracer = tracing.current()
+        finally:
+            tracing.set_current(prev)
+        spans = [s for s in tracer.spans if s["name"] == "write:mgf"]
+        assert len(spans) == 3
+        assert all(
+            s["labels"]["n_spectra"] == len(spectra) for s in spans
+        )
+        # identical bytes out of every branch
+        assert (tmp_path / "a.mgf").read_text() == sink.getvalue() == text

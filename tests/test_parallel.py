@@ -31,7 +31,10 @@ def test_sharded_bin_mean_matches_oracle(rng):
 
 
 def test_sharded_gap_average_matches_oracle(rng):
-    backend = TpuBackend(mesh=cluster_mesh())
+    # force_device: on this CPU-only test mesh the backend would
+    # otherwise route gap-average to the host path (the kernel under
+    # test would silently stop running)
+    backend = TpuBackend(mesh=cluster_mesh(), force_device=True)
     from test_tpu_parity import make_gap_safe_cluster
 
     clusters = [

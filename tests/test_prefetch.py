@@ -214,7 +214,8 @@ class TestPrefetchParity:
 
         assert not [
             t for t in threading.enumerate()
-            if t.name == "specpride-packer" and t.is_alive()
+            if t.name.startswith(("specpride-packer", "specpride-committer"))
+            and t.is_alive()
         ]
 
 
