@@ -299,6 +299,75 @@ _SWEEP_METHODS = (
 )
 
 
+def bench_fault_overhead(clusters, workdir: str, repeats: int = 5) -> dict:
+    """Zero-fault cost of the ARMED robustness harness (PR5 acceptance:
+    < 1%).
+
+    Same pinned protocol as the executor sweeps (``_sweep_run``), run
+    ``repeats``x in alternation: disarmed (no fault plan) vs armed with
+    a zero-rate fault spec at EVERY site — the plan is installed, every
+    ``faults.check`` takes the full slow path (lock + visit counter +
+    deterministic draw), retries wrap every lane, but nothing ever
+    fires.  Reported as the median executor-seconds delta, so the
+    number is the true per-run cost of *having* the harness, which is
+    what a production deployment pays on every healthy run."""
+    import statistics
+
+    from specpride_tpu.robustness.faults import FAULT_SITES
+
+    src = _sweep_source(clusters, workdir)
+    armed_spec = ",".join(f"{site}:io:0" for site in FAULT_SITES)
+    # one unmeasured warmup: the first CLI run of a process pays jit
+    # compiles + page-cache fill that would otherwise land entirely on
+    # whichever arm ran first
+    _sweep_run(
+        "consensus", "bin-mean", src, workdir, "fo_warmup",
+        ["--prefetch", "4"],
+    )
+    walls: dict[str, list[float]] = {"disarmed": [], "armed": []}
+    execs: dict[str, list[float]] = {"disarmed": [], "armed": []}
+    for i in range(repeats):
+        for tag, flags in (
+            ("disarmed", []),
+            ("armed", ["--inject-faults", armed_spec, "--fault-seed", "0"]),
+        ):
+            wall, executor_s, _, data = _sweep_run(
+                "consensus", "bin-mean", src, workdir,
+                f"fo_{tag}_{i}", ["--prefetch", "4"] + flags,
+            )
+            walls[tag].append(wall)
+            execs[tag].append(executor_s)
+    # min is the standard low-noise estimator here: scheduler/IO jitter
+    # only ever ADDS time, and the harness cost we are measuring is a
+    # constant per run, so the fastest observation of each arm is the
+    # cleanest view of it (medians of few repeats still carry one noisy
+    # run each)
+    disarmed = min(execs["disarmed"])
+    armed = min(execs["armed"])
+    out = {
+        "repeats": repeats,
+        "armed_spec": armed_spec,
+        "disarmed_executor_s": round(disarmed, 4),
+        "armed_executor_s": round(armed, 4),
+        "overhead_frac": round(armed / disarmed - 1.0, 4)
+        if disarmed > 0 else None,
+        "disarmed_executor_median_s": round(
+            statistics.median(execs["disarmed"]), 4
+        ),
+        "armed_executor_median_s": round(
+            statistics.median(execs["armed"]), 4
+        ),
+        "disarmed_wall_s": [round(w, 3) for w in walls["disarmed"]],
+        "armed_wall_s": [round(w, 3) for w in walls["armed"]],
+    }
+    eprint(
+        f"[fault_overhead] disarmed {disarmed:.3f}s armed {armed:.3f}s "
+        f"-> overhead {out['overhead_frac']:+.2%}"
+        if out["overhead_frac"] is not None else "[fault_overhead] n/a"
+    )
+    return out
+
+
 def bench_prefetch_sweep(
     clusters, workdir: str, prefetches=(0, 1, 2, 4)
 ) -> list[dict]:
@@ -611,7 +680,7 @@ def main() -> None:
         "--sections", default=None, metavar="LIST",
         help="with --report: comma list of report sections to run "
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
-        "prefetch_sweep,worker_sweep,pallas",
+        "prefetch_sweep,worker_sweep,fault_overhead,pallas",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -635,7 +704,7 @@ def main() -> None:
     # never produce a silently empty report)
     all_sections = (
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
-        "worker_sweep,pallas"
+        "worker_sweep,fault_overhead,pallas"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -766,6 +835,10 @@ def main() -> None:
                     )
                 if "worker_sweep" in secs:
                     report["worker_sweep"] = bench_worker_sweep(
+                        clusters, workdir
+                    )
+                if "fault_overhead" in secs:
+                    report["fault_overhead"] = bench_fault_overhead(
                         clusters, workdir
                     )
             if "pallas" in secs:

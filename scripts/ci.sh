@@ -133,6 +133,90 @@ env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     stats "$ln_tmp/run_pw4_on.jsonl" | grep -q reorder_stall_s
 rm -rf "$ln_tmp"
 
+echo "== robustness: chaos pass (one injected fault per site, seeded) =="
+# the pack-workers x async-write matrix re-runs with one deterministic
+# fault per lane site; every run must (a) exit 0, (b) reproduce the
+# fault-free serial bytes AND manifest, (c) pair every journaled fault
+# with a recovery event (retry/degrade/resume_repair/quarantine)
+rb_tmp=$(mktemp -d)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus tests/data/golden_clustered.mgf "$rb_tmp/serial.mgf" \
+    --method bin-mean --backend tpu --prefetch 0 \
+    --checkpoint "$rb_tmp/serial.ck.json" --checkpoint-every 1
+# golden_clustered.mgf holds 3 clusters -> 3 chunks at --checkpoint-every
+# 1, so the AFTER offsets stagger the six faults across chunks 1..3
+CHAOS="parse:io:1,pack:io:1:1,prepare:io:1:1,dispatch:oom:1:1,write:io:1:1,checkpoint_write:io:1:2"
+for PW in 0 4; do
+    for AW in on off; do
+        env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+            consensus tests/data/golden_clustered.mgf \
+            "$rb_tmp/chaos_pw${PW}_$AW.mgf" \
+            --method bin-mean --backend tpu --prefetch 4 \
+            --pack-workers "$PW" --async-write "$AW" \
+            --retries 3 --retry-backoff 0.01 --fault-seed 0 \
+            --inject-faults "$CHAOS" \
+            --checkpoint "$rb_tmp/chaos_pw${PW}_$AW.ck.json" \
+            --checkpoint-every 1 \
+            --journal "$rb_tmp/chaos_pw${PW}_$AW.jsonl"
+        cmp "$rb_tmp/serial.mgf" "$rb_tmp/chaos_pw${PW}_$AW.mgf"
+        cmp "$rb_tmp/serial.ck.json" "$rb_tmp/chaos_pw${PW}_$AW.ck.json"
+    done
+done
+# d2h fires only on a DEVICE layout (the auto bin-mean path is host-side),
+# and qc only on a non-fused QC pass (select medoid + --qc-report); one
+# run each so all 8 sites are exercised, parity-checked vs its own
+# fault-free twin
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus tests/data/golden_clustered.mgf "$rb_tmp/flat_clean.mgf" \
+    --method bin-mean --backend tpu --layout flat --force-device \
+    --prefetch 0 --checkpoint "$rb_tmp/flat_clean.ck.json" \
+    --checkpoint-every 1
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus tests/data/golden_clustered.mgf "$rb_tmp/flat_chaos.mgf" \
+    --method bin-mean --backend tpu --layout flat --force-device \
+    --prefetch 2 --retries 3 --retry-backoff 0.01 \
+    --inject-faults "d2h:io:1:1" \
+    --checkpoint "$rb_tmp/flat_chaos.ck.json" --checkpoint-every 1 \
+    --journal "$rb_tmp/chaos_d2h.jsonl"
+cmp "$rb_tmp/flat_clean.mgf" "$rb_tmp/flat_chaos.mgf"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    select tests/data/golden_clustered.mgf "$rb_tmp/qc_clean.mgf" \
+    --method medoid --backend tpu --prefetch 2 \
+    --qc-report "$rb_tmp/qc_clean.json" \
+    --checkpoint "$rb_tmp/qc_clean.ck.json" --checkpoint-every 1
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    select tests/data/golden_clustered.mgf "$rb_tmp/qc_chaos.mgf" \
+    --method medoid --backend tpu --prefetch 2 \
+    --retries 3 --retry-backoff 0.01 --inject-faults "qc:io:1:1" \
+    --qc-report "$rb_tmp/qc_chaos.json" \
+    --checkpoint "$rb_tmp/qc_chaos.ck.json" --checkpoint-every 1 \
+    --journal "$rb_tmp/chaos_qc.jsonl"
+cmp "$rb_tmp/qc_clean.mgf" "$rb_tmp/qc_chaos.mgf"
+cmp "$rb_tmp/qc_clean.json" "$rb_tmp/qc_chaos.json"
+python - "$rb_tmp"/chaos_*.jsonl <<'EOF'
+import json, sys
+from specpride_tpu.robustness.faults import FAULT_SITES, audit_fault_recovery
+fired = set()
+for path in sys.argv[1:]:
+    events = [json.loads(l) for l in open(path)]
+    faults = [e for e in events if e["event"] == "fault"]
+    assert faults, f"{path}: no fault fired (is the plan armed?)"
+    unmatched = audit_fault_recovery(events)
+    assert not unmatched, f"{path}: unrecovered faults {unmatched}"
+    end = [e for e in events if e["event"] == "run_end"][-1]
+    rb = end.get("robustness") or {}
+    assert rb.get("faults", {}).get("fired_total", 0) == len(faults), rb
+    fired |= {e["site"] for e in faults}
+missing = set(FAULT_SITES) - fired
+assert not missing, f"sites never exercised: {sorted(missing)}"
+print(f"chaos OK: all {len(FAULT_SITES)} sites fired and recovered, "
+      "outputs byte-identical to fault-free runs")
+EOF
+# `specpride stats` must render the injection/recovery summary and exit 0
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$rb_tmp/chaos_pw4_on.jsonl" | grep -q "robustness:"
+rm -rf "$rb_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

@@ -20,6 +20,10 @@ from specpride_tpu.config import (
 from specpride_tpu.data.peaks import Cluster, Spectrum
 from specpride_tpu.ops import quantize
 from specpride_tpu.ops.fragments import PROTON_MASS
+# fault-injection site (no-op unless a FaultPlan is armed): the oracle
+# shares the tpu backend's "dispatch" site so a chaos run exercises the
+# same recovery paths whichever --backend is selected
+from specpride_tpu.robustness import faults
 
 
 def check_uniform_charge(members: list[Spectrum]) -> None:
@@ -416,6 +420,7 @@ metrics = _MetricsRegistry()
 
 
 def _count_run(method: str, n: int) -> None:
+    faults.check("dispatch")
     metrics.counter(
         "specpride_oracle_clusters_total",
         "clusters processed by the numpy oracle", labels=("method",),

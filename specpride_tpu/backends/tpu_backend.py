@@ -43,6 +43,10 @@ from specpride_tpu.observability import (
     logger,
 )
 from specpride_tpu.observability import tracing
+# fault-injection sites (specpride_tpu.robustness): zero-cost no-ops
+# unless a FaultPlan is armed — the chaos harness fires realistic device
+# errors exactly where production ones surface
+from specpride_tpu.robustness import faults
 
 
 _cache_configured = False
@@ -189,6 +193,7 @@ class _AsyncFetch:
         self._fut = _get_fetch_pool().submit(np.asarray, device_array)
 
     def get(self) -> np.ndarray:
+        faults.check("d2h")
         return self._fut.result()
 
 
@@ -490,6 +495,7 @@ class TpuBackend:
                     if hasattr(a, "block_until_ready"):
                         a.block_until_ready()
         with self.stats.phase("d2h"):
+            faults.check("d2h")
             for a in arrays:
                 if hasattr(a, "copy_to_host_async"):
                     a.copy_to_host_async()
@@ -527,6 +533,7 @@ class TpuBackend:
         materializing the chunk's clusters ahead of time)."""
         if not self.supports_prepare(method) or not clusters:
             return None
+        faults.check("prepare")
         st = stats if stats is not None else self.stats
         if method == "bin-mean":
             return self._prepare_bin_mean(clusters, config, cos_config, st)
@@ -567,6 +574,7 @@ class TpuBackend:
         cleanly whether or not a run was pipelined); under prefetch the
         span covers the compute stage only — pack time lives in the
         packer lane's ``pipeline:pack`` spans."""
+        faults.check("dispatch")
         if prepared.method == "bin-mean":
             name = (
                 "method:bin_mean_with_cosines"
@@ -614,6 +622,7 @@ class TpuBackend:
         from specpride_tpu.data.packed import pack_bucketize_bin_mean
         from specpride_tpu.ops.binning import bin_mean_deduped_compact
 
+        faults.check("dispatch")
         if self.mesh is None and self.layout != "bucketized":
             # host ("auto") / flat-device paths; validation happens in the
             # shared pack stage (_prepare_bin_mean)
@@ -989,6 +998,7 @@ class TpuBackend:
         no accelerator to win on and the kernel measured ~0.3x of the
         host consensus (BENCH_r07) — so the run is routed to the host
         path and the decision journaled, unless ``force_device``."""
+        faults.check("dispatch")
         if self.mesh is None and self.layout != "bucketized":
             return self._run_gap_average_host(clusters, config)
         if not self.force_device and _cpu_only_devices():
@@ -1435,6 +1445,7 @@ class TpuBackend:
     def run_medoid(
         self, clusters: list[Cluster], config: MedoidConfig = MedoidConfig()
     ) -> list[Spectrum]:
+        faults.check("dispatch")
         indices = self.medoid_indices(clusters, config)
         return [c.members[i] for c, i in zip(clusters, indices)]
 
@@ -1595,6 +1606,7 @@ class TpuBackend:
         kernel and its D2H stream — on tunneled hosts the device->host
         link runs at ~25 MB/s, so the consensus transfer is the pipeline's
         critical path and the host would otherwise sit idle under it."""
+        faults.check("dispatch")
         if self.mesh is not None or self.layout == "bucketized":
             reps = self.run_bin_mean(clusters, bin_config)
             return reps, self.average_cosines(reps, clusters, cos_config)

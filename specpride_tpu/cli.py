@@ -20,6 +20,7 @@ stopped (survey §5 "Checkpoint / resume").
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -46,6 +47,14 @@ from specpride_tpu.observability import (
     open_journal,
 )
 from specpride_tpu.observability import tracing
+from specpride_tpu.robustness import (
+    Harness,
+    OutputIntegrity,
+    Quarantine,
+    errors as rb_errors,
+    faults as rb_faults,
+)
+from specpride_tpu.robustness.integrity import manifest_payload
 
 
 def _add_backend(p: argparse.ArgumentParser) -> None:
@@ -123,6 +132,46 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
         help="bounded-memory ingest: parse member spectra in windows of N "
         "clusters off a byte index instead of loading the whole MGF "
         "(default auto: streams inputs over 256 MB)",
+    )
+    p.add_argument(
+        "--retries", type=int, default=2, metavar="N",
+        help="retry transient failures (I/O errors, device resource "
+        "pressure, lane hangs) up to N times per stage with exponential "
+        "backoff + deterministic jitter; permanent errors (malformed "
+        "input) never retry (default 2; 0 disables)",
+    )
+    p.add_argument(
+        "--retry-backoff", type=float, default=0.05, metavar="BASE",
+        help="base backoff seconds: retry i sleeps BASE * 2^i * "
+        "(1 + jitter) (default 0.05)",
+    )
+    p.add_argument(
+        "--no-degrade", action="store_true",
+        help="disable graceful degradation: without it a device OOM "
+        "splits the chunk in half and re-dispatches (floor 1 cluster), "
+        "and repeated device failure reroutes the chunk to the numpy "
+        "backend — both journaled as `degrade` events",
+    )
+    p.add_argument(
+        "--watchdog-timeout", type=float, default=0.0, metavar="S",
+        help="per-lane stall watchdog: a lane section (pack / dispatch / "
+        "write) busy longer than S seconds journals a watchdog_stall "
+        "event and breaks injected hangs so the retry policy recovers "
+        "them (default 0 = off)",
+    )
+    p.add_argument(
+        "--inject-faults", metavar="SPEC",
+        help="deterministic fault injection for chaos testing: "
+        "comma list of SITE:KIND:RATE[:AFTER[:MAX]] — sites "
+        f"{{{','.join(rb_faults.FAULT_SITES)}}}, kinds "
+        f"{{{','.join(rb_faults.FAULT_KINDS)}}}; every fired fault is "
+        "journaled as a `fault` event (subprocess tests can use the "
+        "SPECPRIDE_FAULTS env var instead; see docs/robustness.md)",
+    )
+    p.add_argument(
+        "--fault-seed", type=int, default=0, metavar="N",
+        help="seed for --inject-faults firing decisions and retry "
+        "jitter: same plan + seed fires at the same visits every run",
     )
 
 
@@ -244,6 +293,12 @@ def _shard_for_process(clusters: list, args) -> tuple[list, str]:
         args.metrics_out = f"{args.metrics_out}.part{pid:05d}"
     if getattr(args, "chrome_trace", None):
         args.chrome_trace = f"{args.chrome_trace}.part{pid:05d}"
+    quarantine = getattr(args, "_quarantine", None)
+    if quarantine is not None:
+        # every rank parses the FULL input (load precedes sharding), so
+        # without a per-rank file N ranks would append the same blocks
+        # to one shared quarantine concurrently
+        quarantine.rename(f"{quarantine.path}.part{pid:05d}")
     logger.info(
         "process %d/%d: %d of %d clusters -> %s",
         pid, nproc, len(mine), len(clusters), part,
@@ -462,31 +517,56 @@ def _serial_chunks(clusters, worklist):
 
 def _pack_chunk(
     clusters, chunk_index: int, idxs: list, prepare, method: str, config,
-    cos_config, span_name: str, **span_labels,
+    cos_config, span_name: str, harness: Harness | None = None,
+    **span_labels,
 ):
     """THE per-chunk pack stage — the one copy the dedicated packer and
     every pool worker run, so the ``--pack-workers 0`` and ``>= 1`` paths
     can never drift behaviorally: materialize the chunk's clusters, run
     the backend's host pack (``prepare_chunk``) into a PRIVATE RunStats,
     and capture any exception on the item for the consumer's --on-error
-    policy.  Returns ``(item, busy_seconds)``."""
+    policy.  Returns ``(item, busy_seconds)``.
+
+    Robustness: the whole stage runs inside the harness's pack-lane
+    retry wrapper — the ``parse`` fault site fires in chunk
+    materialization (the MGF window parse on streamed inputs), ``pack``
+    before the backend pack, ``prepare`` inside ``prepare_chunk`` — so
+    a transient failure anywhere in the stage re-runs it (both halves
+    are pure functions of the chunk) instead of poisoning the item.
+    Only errors that survive the retry budget reach the consumer."""
     import time as _time
 
     item = _ChunkItem(chunk_index, idxs)
     pack_stats = RunStats()
     t0 = _time.perf_counter()
-    try:
-        with tracing.span(
+
+    def _stage():
+        # the watchdog section covers ONE attempt's real work — it must
+        # sit inside the retried fn, not around retry_call, or the
+        # backoff sleeps between attempts would read as a lane stall
+        section = (
+            harness.section("pack") if harness is not None
+            else contextlib.nullcontext()
+        )
+        with section, tracing.span(
             span_name, chunk_index=chunk_index, n_clusters=len(idxs),
             **span_labels,
         ):
             with pack_stats.phase("pack"):
+                rb_faults.check("parse")
                 item.part = [clusters[i] for i in idxs]
+            rb_faults.check("pack")
             if prepare is not None:
                 item.prepared = prepare(
                     method, item.part, config,
                     cos_config=cos_config, stats=pack_stats,
                 )
+
+    try:
+        if harness is not None:
+            harness.retry_call("pack", _stage)
+        else:
+            _stage()
     except Exception as e:  # noqa: BLE001 - handed to consumer
         item.error = e
     item.pack_stats = pack_stats
@@ -507,7 +587,7 @@ def _default_pack_workers() -> int:
 
 def _pipelined_chunks(
     clusters, worklist, backend, method, args, prefetch: int, want_qc: bool,
-    lanes: dict,
+    lanes: dict, harness: Harness | None = None,
 ):
     """Producer–consumer pipeline over the chunk worklist.
 
@@ -548,13 +628,21 @@ def _pipelined_chunks(
     lanes["pack_busy_s"] = busy
 
     def _put(obj) -> bool:
-        while not stop.is_set():
+        # bounded wait on the shared abort event, not a bare
+        # except/continue loop: when the dispatch lane aborts with the
+        # queue full, the packer parks on `stop` (0 CPU) and exits
+        # within one wait quantum instead of hammering put() — the
+        # consumer's finally drains the queue, so a live consumer always
+        # opens a slot within the put timeout
+        while True:
+            if stop.is_set():
+                return False
             try:
                 q.put(obj, timeout=0.1)
                 return True
             except queue.Full:
-                continue
-        return False
+                if stop.wait(timeout=0.05):
+                    return False
 
     def _packer() -> None:
         try:
@@ -563,7 +651,7 @@ def _pipelined_chunks(
                     return
                 item, elapsed = _pack_chunk(
                     clusters, chunk_index, idxs, prepare, method, config,
-                    cos_config, "pipeline:pack",
+                    cos_config, "pipeline:pack", harness=harness,
                 )
                 busy[0] += elapsed
                 if not _put(item):
@@ -602,7 +690,7 @@ def _pipelined_chunks(
 
 def _pooled_chunks(
     clusters, worklist, backend, method, args, prefetch: int, want_qc: bool,
-    n_workers: int, lanes: dict,
+    n_workers: int, lanes: dict, harness: Harness | None = None,
 ):
     """Pack worker pool (``--pack-workers N``): N threads run the host
     pack stage (chunk materialization + ``prepare_chunk``) on DISTINCT
@@ -666,7 +754,8 @@ def _pooled_chunks(
                 chunk_index, idxs = worklist[seq]
                 item, elapsed = _pack_chunk(
                     clusters, chunk_index, idxs, prepare, method, config,
-                    cos_config, f"pipeline:pack[{wid}]", worker=wid,
+                    cos_config, f"pipeline:pack[{wid}]", harness=harness,
+                    worker=wid,
                 )
                 busy[wid] += elapsed
                 with cond:
@@ -754,20 +843,68 @@ class _CommitItem:
 
 
 def _commit_chunk(item: _CommitItem, args, journal, stats: RunStats,
-                  qc: list, done: set, first_write: bool) -> None:
+                  qc: list, done: set, first_write: bool,
+                  integrity: OutputIntegrity | None = None,
+                  harness: Harness | None = None) -> None:
     """THE chunk commit protocol — the one copy both the inline (sync)
     tail of ``_checkpointed_run`` and the ``_Committer`` lane execute, so
     ``--async-write on`` and ``off`` can never drift: QC-row finalize,
     MGF append, counters, the ``chunk_done`` heartbeat, then (with a
-    checkpoint) the atomic ``{done, output_bytes, failed}`` manifest
-    replace — strictly AFTER the append, so a kill between the two
-    leaves output past the manifest, the state resume truncates."""
+    checkpoint) the atomic schema-v2 ``{done, output_bytes, sha256,
+    failed}`` manifest replace — strictly AFTER the append, so a kill
+    between the two leaves output past the manifest, the state resume
+    truncates.
+
+    Robustness: both steps run under the harness's retry policy.  The
+    append's retry hook first truncates any partial append back to the
+    pre-commit offset, so a transient write failure can never duplicate
+    records; the manifest replace is atomic already, so its retry needs
+    no undo.  ``integrity`` maintains the running sha256 of the
+    committed prefix that lands in the manifest."""
     import time as _time
 
     if item.qc_rows:
         qc.extend(item.qc_rows)
-    with stats.phase("write"):
-        write_mgf(item.reps, args.output, append=not first_write)
+    pre_bytes = (
+        os.path.getsize(args.output)
+        if not first_write and os.path.exists(args.output) else 0
+    )
+
+    def _section():
+        # per-attempt watchdog coverage: inside the retried fn so the
+        # backoff sleeps between attempts never read as a lane stall
+        return (
+            harness.section("write") if harness is not None
+            else contextlib.nullcontext()
+        )
+
+    def _append() -> None:
+        with _section():
+            rb_faults.check("write")
+            with stats.phase("write"):
+                write_mgf(item.reps, args.output, append=not first_write)
+
+    def _undo_partial_append() -> None:
+        # a failed append may still have landed bytes; drop back to the
+        # pre-commit offset so the retry appends exactly once (first
+        # writes re-open with mode "w" — truncation built in)
+        if not first_write and os.path.exists(args.output) and (
+            os.path.getsize(args.output) > pre_bytes
+        ):
+            with open(args.output, "r+b") as fh:
+                fh.truncate(pre_bytes)
+
+    if harness is not None:
+        harness.retry_call(
+            "write", _append, before_retry=_undo_partial_append
+        )
+    else:
+        _append()
+    output_bytes = os.path.getsize(args.output)
+    if integrity is not None:
+        if first_write:
+            integrity.reset()
+        integrity.absorb(args.output, output_bytes)
     stats.count("clusters", len(item.part_ids))
     stats.count("representatives", len(item.reps))
     done.update(item.part_ids)
@@ -780,19 +917,33 @@ def _commit_chunk(item: _CommitItem, args, journal, stats: RunStats,
         if dt > 0 else 0.0,
     )
     if args.checkpoint:
-        output_bytes = os.path.getsize(args.output)
-        with tracing.span("checkpoint_write", n_done=len(done)):
-            tmp = args.checkpoint + ".tmp"
-            with open(tmp, "w") as fh:
-                json.dump(
-                    {
+        def _replace_manifest() -> None:
+            with _section():
+                rb_faults.check("checkpoint_write")
+                _replace_manifest_inner()
+
+        def _replace_manifest_inner() -> None:
+            with tracing.span("checkpoint_write", n_done=len(done)):
+                tmp = args.checkpoint + ".tmp"
+                payload = (
+                    manifest_payload(
+                        done, output_bytes, integrity, failed=item.failed
+                    )
+                    if integrity is not None
+                    else {
                         "done": sorted(done),
                         "output_bytes": output_bytes,
                         **({"failed": item.failed} if item.failed else {}),
-                    },
-                    fh,
+                    }
                 )
-            os.replace(tmp, args.checkpoint)
+                with open(tmp, "w") as fh:
+                    json.dump(payload, fh)
+                os.replace(tmp, args.checkpoint)
+
+        if harness is not None:
+            harness.retry_call("checkpoint_write", _replace_manifest)
+        else:
+            _replace_manifest()
         journal.emit(
             "checkpoint_write", n_done=len(done),
             output_bytes=output_bytes,
@@ -821,7 +972,8 @@ class _Committer:
     the dispatch lane can never deadlock on a full queue."""
 
     def __init__(self, args, journal, qc, done: set, first_write: bool,
-                 depth: int):
+                 depth: int, integrity: OutputIntegrity | None = None,
+                 harness: Harness | None = None):
         import queue
         import threading
 
@@ -830,6 +982,8 @@ class _Committer:
         self._qc = qc
         self._done = done
         self._first_write = first_write
+        self._integrity = integrity
+        self._harness = harness
         self.stats = RunStats()
         self.busy_s = 0.0
         self.error: BaseException | None = None
@@ -866,9 +1020,12 @@ class _Committer:
             self.busy_s += _time.perf_counter() - t0
 
     def _commit(self, item: _CommitItem) -> None:
+        # watchdog sections open inside _commit_chunk's retried steps,
+        # so retry backoff on this lane never reads as a stall
         _commit_chunk(
             item, self._args, self._journal, self.stats, self._qc,
-            self._done, self._first_write,
+            self._done, self._first_write, integrity=self._integrity,
+            harness=self._harness,
         )
         self._first_write = False
 
@@ -890,9 +1047,105 @@ class _Committer:
             stats.merge(self.stats)
 
 
+def _dispatch_chunk(
+    backend, method, item: _ChunkItem, part, args, stats: RunStats,
+    scores, chunk_qc, harness: Harness,
+):
+    """Device dispatch of one chunk under the robustness policy.
+
+    Recovery ladder, applied per (sub-)chunk:
+
+    1. **Split on OOM** — a ``RESOURCE_EXHAUSTED`` device error on a
+       multi-cluster chunk halves it and re-dispatches each half through
+       the one-shot path (methods are per-cluster, so outputs stay
+       byte-identical), recursing down to single clusters.  Journaled as
+       ``degrade`` ``action=split``.
+    2. **Retry with backoff** — any transient error (I/O, device
+       pressure, a watchdog-broken hang, an unsplittable OOM) re-runs
+       the same dispatch up to ``--retries`` times.
+    3. **Reroute to the host oracle** — when retries are exhausted on a
+       still-transient DEVICE error, the chunk falls back to the numpy
+       backend (the degradation the existing routing machinery applies
+       statically for CPU-only gap-average, here applied dynamically).
+       Journaled as ``degrade`` ``action=reroute``.
+    4. **Surface** — permanent errors (malformed input) skip the ladder
+       entirely and propagate to ``--on-error``.
+
+    ``--no-degrade`` disables steps 1 and 3."""
+    import time as _time
+
+    from specpride_tpu.backends import numpy_backend as _nb
+
+    policy = harness.policy
+
+    def _run_parts(sub_part, prepared, attempt=0):
+        while True:
+            try:
+                with harness.section("dispatch"):
+                    if prepared is not None:
+                        reps, cosines = backend.run_prepared(prepared)
+                        if chunk_qc is not None and cosines is not None:
+                            _append_qc_rows(chunk_qc, sub_part, cosines)
+                        return reps
+                    return _run_method(
+                        backend, method, sub_part, args, scores=scores,
+                        qc=chunk_qc,
+                    )
+            except Exception as e:  # noqa: BLE001 - classified ladder below
+                if (
+                    harness.degrade and rb_errors.is_oom(e)
+                    and len(sub_part) > 1
+                ):
+                    harness.note_degrade(
+                        "split", f"{type(e).__name__}: {e}",
+                        item.index, len(sub_part),
+                    )
+                    logger.warning(
+                        "device OOM on a %d-cluster chunk (%s); splitting "
+                        "in half", len(sub_part), e,
+                    )
+                    mid = (len(sub_part) + 1) // 2
+                    return (
+                        _run_parts(sub_part[:mid], None)
+                        + _run_parts(sub_part[mid:], None)
+                    )
+                if attempt < policy.retries and rb_errors.is_transient(e):
+                    wait = policy.backoff_s("dispatch", attempt)
+                    policy.note_retry("dispatch", attempt, e, wait)
+                    if wait > 0:
+                        _time.sleep(wait)
+                    attempt += 1
+                    continue
+                if (
+                    harness.degrade and backend is not _nb
+                    and rb_errors.is_transient(e)
+                ):
+                    harness.note_degrade(
+                        "reroute", f"{type(e).__name__}: {e}",
+                        item.index, len(sub_part),
+                    )
+                    logger.warning(
+                        "device path failed %d time(s) on a %d-cluster "
+                        "chunk (%s); rerouting to the numpy backend",
+                        attempt + 1, len(sub_part), e,
+                    )
+                    # the host fallback is the LAST resort: injection is
+                    # suppressed on it (a different physical path than
+                    # the device lane the fault plan models)
+                    with rb_faults.suppressed():
+                        return _run_method(
+                            _nb, method, sub_part, args, scores=scores,
+                            qc=chunk_qc,
+                        )
+                raise
+
+    with stats.phase("compute"):
+        return _run_parts(part, item.prepared)
+
+
 def _checkpointed_run(
     backend, method, clusters, args, stats: RunStats, scores=None,
-    qc: list | None = None, journal=None,
+    qc: list | None = None, journal=None, quarantine: Quarantine | None = None,
 ):
     """Chunked execution with a resume manifest (survey §5).
 
@@ -911,52 +1164,150 @@ def _checkpointed_run(
     Output is chunk-invariant (every method is per-cluster), so pipelined
     and serial runs produce byte-identical files."""
     journal = journal if journal is not None else NullJournal()
+    harness = Harness.from_args(args, journal)
+    try:
+        return _checkpointed_run_impl(
+            backend, method, clusters, args, stats, scores, qc, journal,
+            quarantine, harness,
+        )
+    finally:
+        # robustness accounting rides the stats object into run_end even
+        # when the run aborts mid-loop; close() disarms the global fault
+        # plan and stops the watchdog so nothing leaks into the next
+        # in-process invocation (tests, bench) whatever exit path ran
+        rb = harness.summary(
+            quarantined=quarantine.count if quarantine is not None else 0
+        )
+        if rb:
+            stats.robustness = rb
+        harness.close()
+
+
+def _checkpointed_run_impl(
+    backend, method, clusters, args, stats: RunStats, scores, qc,
+    journal, quarantine, harness: Harness,
+):
+    integ = OutputIntegrity()
     done: set[str] = set()
     output_bytes: int | None = None  # None: manifest predates offset tracking
     restarted = False  # a resume state was found unusable and discarded
     prior_failed: list[str] = []  # failures recorded by an earlier attempt
     if args.checkpoint and os.path.exists(args.checkpoint):
-        with open(args.checkpoint) as fh:
-            manifest = json.load(fh)
-        done = set(manifest.get("done", []))
-        prior_failed = list(manifest.get("failed", []))
-        raw = manifest.get("output_bytes")
-        output_bytes = None if raw is None else int(raw)
-        out_size = (
-            os.path.getsize(args.output)
-            if os.path.exists(args.output)
-            else None
-        )
-        if done and out_size is None:
+        manifest: dict | None = None
+        try:
+            with open(args.checkpoint, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+            if not isinstance(manifest, dict):
+                raise ValueError("manifest is not a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            # a torn or bit-flipped manifest (json.JSONDecodeError is a
+            # ValueError): nothing in it can be trusted, so restart —
+            # loudly, never by silently treating it as "no checkpoint"
             logger.warning(
-                "checkpoint lists %d done clusters but output %s is gone; "
-                "restarting from scratch", len(done), args.output,
+                "checkpoint %s is unreadable (%s); restarting from "
+                "scratch", args.checkpoint, e,
             )
-            # no output on disk -> nothing a redo could duplicate, so this
-            # restart is safe even under --append
-            done, output_bytes = set(), 0
-            prior_failed = []  # the redo retries them; stale records lie
-        elif output_bytes is not None and out_size is not None and (
-            out_size < output_bytes
-        ):
-            # un-fsynced append lost in a power cut after the manifest
-            # landed: done-listed clusters are missing from the output, so
-            # trusting the manifest would silently drop them
-            logger.warning(
-                "output %s is %d bytes but the manifest recorded %d; "
-                "restarting from scratch", args.output, out_size, output_bytes,
+            journal.emit(
+                "resume_repair", action="restart",
+                reason="manifest_unreadable", error=str(e),
             )
+            harness.note_repair()
             done, output_bytes, restarted = set(), 0, True
-            prior_failed = []  # the redo retries them; stale records lie
-        elif output_bytes is not None and out_size is not None and (
-            out_size > output_bytes
-        ):
-            logger.info(
-                "dropping %d output bytes past the manifest (interrupted "
-                "chunk)", out_size - output_bytes,
+        if manifest is not None:
+            done = set(manifest.get("done", []))
+            prior_failed = list(manifest.get("failed", []))
+            raw = manifest.get("output_bytes")
+            output_bytes = None if raw is None else int(raw)
+            out_size = (
+                os.path.getsize(args.output)
+                if os.path.exists(args.output)
+                else None
             )
-            with open(args.output, "r+b") as fh:
-                fh.truncate(output_bytes)
+            if done and out_size is None:
+                logger.warning(
+                    "checkpoint lists %d done clusters but output %s is "
+                    "gone; restarting from scratch", len(done), args.output,
+                )
+                journal.emit(
+                    "resume_repair", action="restart",
+                    reason="output_missing",
+                )
+                harness.note_repair()
+                # no output on disk -> nothing a redo could duplicate, so
+                # this restart is safe even under --append
+                done, output_bytes = set(), 0
+                prior_failed = []  # the redo retries them; stale records lie
+            elif output_bytes is not None and out_size is not None and (
+                out_size < output_bytes
+            ):
+                # un-fsynced append lost in a power cut after the manifest
+                # landed: done-listed clusters are missing from the output,
+                # so trusting the manifest would silently drop them
+                logger.warning(
+                    "output %s is %d bytes but the manifest recorded %d; "
+                    "restarting from scratch", args.output, out_size,
+                    output_bytes,
+                )
+                journal.emit(
+                    "resume_repair", action="restart",
+                    reason="output_shorter_than_manifest",
+                )
+                harness.note_repair()
+                done, output_bytes, restarted = set(), 0, True
+                prior_failed = []  # the redo retries them; stale records lie
+            elif output_bytes is not None and out_size is not None and (
+                out_size > output_bytes
+            ):
+                logger.info(
+                    "dropping %d output bytes past the manifest "
+                    "(interrupted chunk)", out_size - output_bytes,
+                )
+                from specpride_tpu.io.mgf import truncate_tail
+
+                clean = truncate_tail(args.output, output_bytes)
+                journal.emit(
+                    "resume_repair", action="truncate_tail",
+                    reason="torn_tail",
+                    n_bytes=out_size - output_bytes,
+                    clean_boundary=clean,
+                )
+                harness.note_repair()
+                if not clean and not manifest.get("sha256"):
+                    # the recorded offset lands mid-record and there is no
+                    # hash to prove the prefix: the manifest itself is
+                    # suspect (legacy schema), so don't trust it
+                    logger.warning(
+                        "truncated output does not end on a record "
+                        "boundary and the manifest has no sha256; "
+                        "restarting from scratch",
+                    )
+                    journal.emit(
+                        "resume_repair", action="restart",
+                        reason="ragged_boundary",
+                    )
+                    done, output_bytes, restarted = set(), 0, True
+                    prior_failed = []
+            # committed-prefix verification (schema-v2 manifests): a bit
+            # flip INSIDE the committed region passes every byte-count
+            # check above — only the hash catches it.  The verify pass
+            # doubles as the seed of this run's running hash.
+            want = manifest.get("sha256")
+            if done and output_bytes and os.path.exists(args.output):
+                got = integ.seed_file(args.output, output_bytes)
+                if want and got != want:
+                    logger.warning(
+                        "output %s fails the manifest's sha256 check "
+                        "(committed prefix is corrupt); restarting from "
+                        "scratch", args.output,
+                    )
+                    journal.emit(
+                        "resume_repair", action="restart",
+                        reason="sha256_mismatch",
+                    )
+                    harness.note_repair()
+                    done, output_bytes, restarted = set(), 0, True
+                    prior_failed = []
+                    integ.reset()
         logger.info("resuming: %d clusters already done", len(done))
         journal.emit(
             "resume", n_done=len(done), restarted=restarted,
@@ -987,6 +1338,16 @@ def _checkpointed_run(
             )
         # ref average_spectrum_clustering.py:183-184,198: mode 'wa'[append]
         first_write = False
+    if not first_write and integ.offset == 0 and os.path.exists(args.output):
+        # --append pre-existing content, or a legacy (schema-less) resume
+        # the hash verify above didn't seed: fold the committed prefix
+        # into the running hash so this run's manifests cover the WHOLE
+        # output, not just its own appends
+        integ.seed_file(
+            args.output,
+            output_bytes if output_bytes is not None
+            else os.path.getsize(args.output),
+        )
     # chunk size: the checkpoint interval, else the stream window (so a
     # streamed run stays memory-bounded even without --checkpoint), else —
     # when the pipelined executor can actually pack this method ahead —
@@ -1035,12 +1396,12 @@ def _checkpointed_run(
     if pipelined and n_workers >= 1:
         items = _pooled_chunks(
             clusters, worklist, backend, method, args, prefetch,
-            qc is not None, n_workers, lanes,
+            qc is not None, n_workers, lanes, harness=harness,
         )
     elif pipelined:
         items = _pipelined_chunks(
             clusters, worklist, backend, method, args, prefetch,
-            qc is not None, lanes,
+            qc is not None, lanes, harness=harness,
         )
     else:
         items = _serial_chunks(clusters, worklist)
@@ -1048,7 +1409,7 @@ def _checkpointed_run(
     committer = (
         _Committer(
             args, journal, qc if qc is not None else [], done, first_write,
-            depth=max(prefetch, 1),
+            depth=max(prefetch, 1), integrity=integ, harness=harness,
         )
         if worklist and (aw == "on" or (aw == "auto" and pipelined))
         else None
@@ -1088,21 +1449,19 @@ def _checkpointed_run(
                     if item.error is not None:
                         # a pack-stage failure surfaces here so --on-error
                         # keeps one policy for the whole chunk lifecycle
+                        # (transient pack errors were already retried on
+                        # the pack lane; what arrives is permanent)
                         raise item.error
-                    if item.prepared is not None:
-                        with stats.phase("compute"):
-                            reps, chunk_cosines = backend.run_prepared(
-                                item.prepared
-                            )
-                        if chunk_qc is not None and chunk_cosines is not None:
-                            _append_qc_rows(chunk_qc, part, chunk_cosines)
-                    else:
-                        with stats.phase("compute"):
-                            reps = _run_method(
-                                backend, method, part, args, scores=scores,
-                                qc=chunk_qc,
-                            )
-                except (ValueError, RuntimeError) as e:
+                    reps = _dispatch_chunk(
+                        backend, method, item, part, args, stats, scores,
+                        chunk_qc, harness,
+                    )
+                except (ValueError, RuntimeError, OSError) as e:
+                    # OSError joins the policy catch so a persistent I/O
+                    # failure that exhausted its retries (incl.
+                    # LaneHangError, a TimeoutError->OSError subclass)
+                    # follows the same skip path as a compute failure
+                    # instead of aborting the run
                     # per-chunk failure isolation (survey §5 failure
                     # detection): with --on-error skip, a chunk whose input is
                     # bad (e.g. mixed charge states) is retried
@@ -1129,7 +1488,7 @@ def _checkpointed_run(
                                         scores=scores, qc=chunk_qc,
                                     )
                                 )
-                            except (ValueError, RuntimeError) as ce:
+                            except (ValueError, RuntimeError, OSError) as ce:
                                 logger.warning(
                                     "skipping cluster %s: %s", c.cluster_id, ce
                                 )
@@ -1148,18 +1507,28 @@ def _checkpointed_run(
                     try:
                         by_id = {r.cluster_id: r for r in reps}
                         kept = [c for c in part if c.cluster_id in by_id]
-                        with stats.phase("compute"), tracing.span(
-                            "qc", n_clusters=len(kept)
-                        ):
-                            _append_qc_rows(
-                                chunk_qc, kept,
-                                _cosines_of(
+
+                        def _qc_pass(kept=kept, by_id=by_id):
+                            with stats.phase("compute"), tracing.span(
+                                "qc", n_clusters=len(kept)
+                            ):
+                                rb_faults.check("qc")
+                                return _cosines_of(
                                     backend,
-                                    [by_id[c.cluster_id] for c in kept], kept,
-                                    _cosine_config(args),
-                                ),
-                            )
-                    except (ValueError, RuntimeError) as e:
+                                    [by_id[c.cluster_id] for c in kept],
+                                    kept, _cosine_config(args),
+                                )
+
+                        # transient QC failures retry like any lane; what
+                        # survives the budget is handled below (rows
+                        # omitted, representatives kept) — OSError joins
+                        # the catch so an exhausted retry degrades the
+                        # report instead of aborting the run
+                        _append_qc_rows(
+                            chunk_qc, kept,
+                            harness.retry_call("qc", _qc_pass),
+                        )
+                    except (ValueError, RuntimeError, OSError) as e:
                         logger.warning(
                             "QC cosines failed for a %d-cluster chunk (%s); "
                             "their rows are omitted from the report",
@@ -1191,6 +1560,7 @@ def _checkpointed_run(
                     _commit_chunk(
                         commit_item, args, journal, stats,
                         qc if qc is not None else [], done, first_write,
+                        integrity=integ, harness=harness,
                     )
                     first_write = False
             finally:
@@ -1262,12 +1632,20 @@ def _checkpointed_run(
 _STREAM_AUTO_BYTES = 256 * 1024 * 1024
 
 
-def _load_clusters(path: str, stats: RunStats, stream: str = "off"):
+def _load_clusters(path: str, stats: RunStats, stream: str = "off",
+                   quarantine: Quarantine | None = None):
     """Clusters from a clustered MGF: eager list, or a bounded-memory
     ``StreamedClusters`` view (``--stream-clusters``: "off", "auto" = only
     for inputs over 256 MB, or an explicit window size in clusters).
     Streaming needs a plain (non-gz) file; otherwise it falls back to
-    eager with a warning."""
+    eager with a warning.
+
+    With a ``quarantine`` (armed by ``--on-error skip``) malformed MGF
+    blocks — truncated records, unparseable peak lines — divert to
+    ``<output>.quarantine.mgf`` instead of aborting: eager reads parse
+    tolerantly (Python parser; the C++ fast path fails hard on damage),
+    streamed reads quarantine both the index scan's truncated spans and
+    any record a window parse rejects."""
     mode = (stream or "off").lower()
     window = 0
     if mode not in ("off", "auto"):
@@ -1290,7 +1668,10 @@ def _load_clusters(path: str, stats: RunStats, stream: str = "off"):
     native.ensure_built()
     if eager:
         with stats.phase("parse"):
-            spectra = read_mgf(path)
+            spectra = read_mgf(
+                path,
+                malformed=quarantine.add if quarantine is not None else None,
+            )
             clusters = group_into_clusters(spectra)
         stats.count("spectra_in", len(spectra))
         stats.count("peaks_in", sum(s.n_peaks for s in spectra))
@@ -1300,6 +1681,12 @@ def _load_clusters(path: str, stats: RunStats, stream: str = "off"):
 
     with stats.phase("parse"):
         clusters = StreamedClusters(path, window=window or 512)
+    if quarantine is not None:
+        # window parses (pack lane, possibly several workers) quarantine
+        # per-record damage; the index scan's truncated spans drain once
+        # here — without the quarantine both were silently dropped
+        clusters.on_malformed = quarantine.add
+        clusters.drain_malformed(quarantine.add)
     logger.info(
         "streaming %d clusters (%d spectra) in windows of %d",
         len(clusters), clusters.n_spectra, clusters.window,
@@ -1434,6 +1821,11 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         **({"pipeline": stats.pipeline} if getattr(
             stats, "pipeline", None
         ) else {}),
+        # robustness summary (absent when the layer stayed dormant):
+        # injected faults, retries, degrades, repairs, quarantined blocks
+        **({"robustness": stats.robustness} if getattr(
+            stats, "robustness", None
+        ) else {}),
     )
     tracer = tracing.current()
     _restore_tracer(args)  # only uninstalls what this run installed
@@ -1459,12 +1851,18 @@ def cmd_consensus(args) -> int:
         except ValueError as e:
             raise SystemExit(f"invalid bin-mean options: {e}")
     _install_tracer_early(args)
+    quarantine = (
+        Quarantine(args.output + ".quarantine.mgf")
+        if getattr(args, "on_error", "abort") == "skip" else None
+    )
+    args._quarantine = quarantine  # _shard_for_process renames per rank
     try:
         if _is_mzml(args.input):
             clusters = _clusters_from_mzml(args.input, args, stats)
         else:
             clusters = _load_clusters(
-                args.input, stats, getattr(args, "stream_clusters", "off")
+                args.input, stats, getattr(args, "stream_clusters", "off"),
+                quarantine=quarantine,
             )
         if args.single:
             # whole file = one cluster; the reference titles the result
@@ -1477,11 +1875,13 @@ def cmd_consensus(args) -> int:
         backend = _get_backend(args)
         clusters, args.output = _shard_for_process(clusters, args)
         journal = _open_run_journal(args, backend, "consensus", len(clusters))
+        if quarantine is not None:
+            quarantine.bind(journal)  # flush blocks found during parse
         qc = [] if getattr(args, "qc_report", None) else None
         with device_trace(getattr(args, "trace_dir", None)):
             resumed, failed, qc_failed = _checkpointed_run(
                 backend, args.method, clusters, args, stats, qc=qc,
-                journal=journal,
+                journal=journal, quarantine=quarantine,
             )
         if qc is not None:
             _write_qc_report(args, backend, clusters, qc, stats, resumed,
@@ -1491,6 +1891,8 @@ def cmd_consensus(args) -> int:
         )
         _finish_run(args, backend, stats, journal)
     finally:
+        if quarantine is not None:
+            quarantine.close()
         _restore_tracer(args)  # no-op after a clean _finish_run
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0
@@ -1499,28 +1901,38 @@ def cmd_consensus(args) -> int:
 def cmd_select(args) -> int:
     stats = RunStats()
     _install_tracer_early(args)
+    quarantine = (
+        Quarantine(args.output + ".quarantine.mgf")
+        if getattr(args, "on_error", "abort") == "skip" else None
+    )
+    args._quarantine = quarantine  # _shard_for_process renames per rank
     try:
         if _is_mzml(args.input):
             clusters = _clusters_from_mzml(args.input, args, stats)
         else:
             clusters = _load_clusters(
-                args.input, stats, getattr(args, "stream_clusters", "off")
+                args.input, stats, getattr(args, "stream_clusters", "off"),
+                quarantine=quarantine,
             )
         backend = _get_backend(args)
         scores = _load_scores(args) if args.method == "best" else None
         clusters, args.output = _shard_for_process(clusters, args)
         journal = _open_run_journal(args, backend, "select", len(clusters))
+        if quarantine is not None:
+            quarantine.bind(journal)  # flush blocks found during parse
         qc = [] if getattr(args, "qc_report", None) else None
         with device_trace(getattr(args, "trace_dir", None)):
             resumed, failed, qc_failed = _checkpointed_run(
                 backend, args.method, clusters, args, stats, scores, qc=qc,
-                journal=journal,
+                journal=journal, quarantine=quarantine,
             )
         if qc is not None:
             _write_qc_report(args, backend, clusters, qc, stats, resumed,
                              failed, qc_failed)
         _finish_run(args, backend, stats, journal)
     finally:
+        if quarantine is not None:
+            quarantine.close()
         _restore_tracer(args)  # no-op after a clean _finish_run
     print(json.dumps(stats.summary()), file=sys.stderr)
     return 0

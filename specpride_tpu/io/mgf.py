@@ -68,8 +68,91 @@ def _finish_spectrum(
     )
 
 
-def parse_mgf_stream(stream: IO[str]) -> Iterator[Spectrum]:
-    """Yield spectra from an MGF text stream."""
+def _ingest_line(
+    line: str, headers: dict[str, str],
+    mzs: list[float], intensities: list[float],
+) -> None:
+    """Fold one in-record MGF line into the accumulating record state —
+    the ONE copy of the peak/header grammar both the strict and the
+    quarantining parser run, so their accepted dialect can never drift."""
+    if line[0].isdigit() or line[0] in "+-.":
+        fields = line.split()
+        if len(fields) >= 2:
+            mzs.append(float(fields[0]))
+            intensities.append(float(fields[1]))
+        elif len(fields) == 1:
+            mzs.append(float(fields[0]))
+            intensities.append(0.0)
+    else:
+        key, sep, value = line.partition("=")
+        if sep:
+            headers[key.strip().upper()] = value.strip()
+
+
+def _parse_block(lines: list[str]) -> Spectrum:
+    """Parse one buffered BEGIN IONS..END IONS block (exclusive)."""
+    headers: dict[str, str] = {}
+    mzs: list[float] = []
+    intensities: list[float] = []
+    for line in lines:
+        _ingest_line(line, headers, mzs, intensities)
+    return _finish_spectrum(headers, mzs, intensities)
+
+
+def _parse_mgf_quarantining(stream: IO[str], malformed) -> Iterator[Spectrum]:
+    """Tolerant parse: records buffer per block; a block that fails to
+    parse — or is structurally truncated (BEGIN IONS reopening an open
+    record, EOF before END IONS) — goes to ``malformed(raw, reason)``
+    verbatim instead of aborting the stream.  The strict path cannot
+    even DETECT a truncated block: its BEGIN handler silently resets
+    state, dropping the partial record on the floor."""
+    block: list[str] = []
+    in_ions = False
+    for line in stream:
+        line = line.strip()
+        if not line:
+            continue
+        if line == "BEGIN IONS":
+            if in_ions:
+                malformed(
+                    "\n".join(block),
+                    "truncated record (BEGIN IONS inside an open record)",
+                )
+            block = [line]
+            in_ions = True
+        elif line == "END IONS":
+            if in_ions:
+                try:
+                    spectrum = _parse_block(block[1:])
+                except (ValueError, OverflowError) as e:
+                    malformed(
+                        "\n".join(block + [line]),
+                        f"unparseable record ({e})",
+                    )
+                else:
+                    yield spectrum
+            in_ions = False
+            block = []
+        elif in_ions:
+            block.append(line)
+    if in_ions and block:
+        malformed("\n".join(block), "truncated record (EOF before END IONS)")
+
+
+def parse_mgf_stream(
+    stream: IO[str], malformed=None
+) -> Iterator[Spectrum]:
+    """Yield spectra from an MGF text stream.
+
+    ``malformed`` (optional ``callable(raw_block: str, reason: str)``)
+    switches on quarantining: unparseable or truncated blocks are handed
+    over raw and the stream continues — the robustness layer's
+    ``Quarantine`` writes them to ``<output>.quarantine.mgf``.  Without
+    it, parse errors raise exactly as before (library callers keep
+    fail-fast semantics)."""
+    if malformed is not None:
+        yield from _parse_mgf_quarantining(stream, malformed)
+        return
     headers: dict[str, str] = {}
     mzs: list[float] = []
     intensities: list[float] = []
@@ -87,22 +170,14 @@ def parse_mgf_stream(stream: IO[str]) -> Iterator[Spectrum]:
             in_ions = False
         elif not in_ions:
             continue
-        elif line[0].isdigit() or line[0] in "+-.":
-            fields = line.split()
-            if len(fields) >= 2:
-                mzs.append(float(fields[0]))
-                intensities.append(float(fields[1]))
-            elif len(fields) == 1:
-                mzs.append(float(fields[0]))
-                intensities.append(0.0)
         else:
-            key, sep, value = line.partition("=")
-            if sep:
-                headers[key.strip().upper()] = value.strip()
+            _ingest_line(line, headers, mzs, intensities)
     return
 
 
-def read_mgf(path: str | os.PathLike, use_native: bool | None = None) -> list[Spectrum]:
+def read_mgf(
+    path: str | os.PathLike, use_native: bool | None = None, malformed=None,
+) -> list[Spectrum]:
     """Read all spectra from an MGF file.
 
     ``use_native`` selects the C++ parser: True forces it (building it
@@ -111,8 +186,21 @@ def read_mgf(path: str | os.PathLike, use_native: bool | None = None) -> list[Sp
     not spawn a compiler as a side effect of reading a file.  Opt in to
     auto-build on the default path with ``SPECPRIDE_NATIVE_BUILD=1`` (the
     CLI and bench harness call ``native.ensure_built()`` explicitly).
+
+    ``malformed`` enables quarantining (see ``parse_mgf_stream``) and
+    forces the Python parser.  Deliberate, not an oversight: the C++
+    fast path either fails hard on damage or — worse for this mode —
+    silently skips a structurally truncated block, and quarantine
+    exists precisely to make such blocks auditable.  The cost is
+    bounded: eager reads cap at the 256 MB streaming threshold, and
+    streamed window parses take the Python parser regardless.
     """
     with tracing.span("parse:mgf", path=os.fspath(path)) as sp:
+        if malformed is not None:
+            with _open_text(path) as fh:
+                spectra = list(parse_mgf_stream(fh, malformed=malformed))
+            sp.note(n_spectra=len(spectra), parser="python-quarantine")
+            return spectra
         if use_native is not False:
             try:
                 from specpride_tpu.io import native
@@ -224,6 +312,14 @@ class StreamedClusters:
                  _groups=None):
         self.path = os.fspath(path)
         self.window = max(int(window), 1)
+        # robustness hooks: byte ranges of structurally truncated blocks
+        # found by the index scan (never indexed, so without quarantine
+        # they would vanish SILENTLY), and the per-record malformed
+        # callback used by window materialization (set by the CLI when
+        # --on-error skip arms the quarantine; must be thread-safe — the
+        # pack pool materializes windows concurrently)
+        self.malformed_spans: list[tuple[int, int]] = []
+        self.on_malformed = None
         if _groups is not None:
             self._groups = _groups
         else:
@@ -258,6 +354,12 @@ class StreamedClusters:
             for line in fh:
                 stripped = line.strip()
                 if stripped == b"BEGIN IONS":
+                    if begin >= 0:
+                        # an open record re-begun: the partial block
+                        # [begin, offset) has no END IONS — remember it
+                        # so quarantine can surface it instead of the
+                        # historical silent drop
+                        self.malformed_spans.append((begin, offset))
                     begin = offset
                     title = None
                 elif stripped.startswith(b"TITLE="):
@@ -270,7 +372,22 @@ class StreamedClusters:
                     ))
                     begin = -1
                 offset += len(line)
+            if begin >= 0:
+                self.malformed_spans.append((begin, offset))
         return records
+
+    def drain_malformed(self, malformed) -> int:
+        """Hand every scan-detected truncated block to ``malformed(raw,
+        reason)`` and forget them.  Returns the count drained."""
+        spans, self.malformed_spans = self.malformed_spans, []
+        with open(self.path, "rb") as fh:
+            for begin, end in spans:
+                fh.seek(begin)
+                raw = fh.read(end - begin).decode("utf-8", errors="replace")
+                malformed(
+                    raw.strip(), "truncated record (no END IONS)"
+                )
+        return len(spans)
 
     @property
     def cluster_ids(self) -> list[str]:
@@ -285,9 +402,14 @@ class StreamedClusters:
 
     def __getitem__(self, key):
         if isinstance(key, slice):
-            return StreamedClusters(
+            sub = StreamedClusters(
                 self.path, self.window, _groups=self._groups[key]
             )
+            # sub-views (multi-host shards) keep quarantining per-record
+            # damage; scan-level malformed spans stay with the parent
+            # (already drained once — a shard must not re-report them)
+            sub.on_malformed = self.on_malformed
+            return sub
         i = int(key)
         if i < 0:
             i += len(self._groups)
@@ -342,7 +464,9 @@ class StreamedClusters:
             for begin, end in spans:
                 fh.seek(begin)
                 chunk = fh.read(end - begin).decode("utf-8")
-                for s in parse_mgf_stream(io.StringIO(chunk)):
+                for s in parse_mgf_stream(
+                    io.StringIO(chunk), malformed=self.on_malformed
+                ):
                     if s.cluster_id in wanted:
                         members[s.cluster_id].append(s)
         return [Cluster(cid, members[cid]) for cid, _ in groups]
@@ -385,6 +509,28 @@ def format_spectrum(spectrum: Spectrum, skip_nan: bool = True) -> str:
         )
     lines.append("END IONS")
     return "\n".join(lines) + "\n\n"
+
+
+def truncate_tail(path: str | os.PathLike, offset: int) -> bool:
+    """Drop output bytes past ``offset`` — the resume repair for a torn
+    append (a crash between an MGF append and its checkpoint, or an
+    un-fsynced tail a power cut shredded).
+
+    Returns True when the surviving tail ends on a record boundary
+    (``END IONS``), which every manifest-recorded offset must: the
+    commit protocol only records offsets after whole-record appends, so
+    a ragged boundary here means the damage reaches INTO the committed
+    prefix and the caller should fall back to a hash check / restart
+    rather than trust the truncation alone."""
+    path = os.fspath(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(int(offset))
+    if offset <= 0:
+        return True
+    with open(path, "rb") as fh:
+        fh.seek(max(0, int(offset) - 4096))
+        tail = fh.read()
+    return tail.rstrip().endswith(b"END IONS")
 
 
 def _write_records(fh: IO[str], spectra) -> int:

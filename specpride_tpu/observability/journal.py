@@ -51,6 +51,22 @@ EVENT_FIELDS: dict[str, frozenset] = {
     # execution path for the requested layout (e.g. gap-average on a
     # CPU-only host) — emitted once per backend per decision
     "routing": frozenset({"method", "path", "reason"}),
+    # robustness layer (specpride_tpu.robustness): an injected fault
+    # fired at a named site; each must pair with a later recovery event
+    # (retry / degrade / resume_repair / quarantine / skipped_clusters)
+    "fault": frozenset({"site", "kind", "visit"}),
+    # a transient failure was retried with backoff at a wrapper site
+    "retry": frozenset({"site", "attempt", "backoff_s"}),
+    # graceful degradation: a chunk was split after device OOM, or
+    # rerouted to the numpy backend after repeated device failure
+    "degrade": frozenset({"action", "reason"}),
+    # resume found the output/manifest damaged and repaired (truncated a
+    # torn tail) or restarted (hash mismatch, unreadable manifest)
+    "resume_repair": frozenset({"action", "reason"}),
+    # a malformed MGF block was diverted to <output>.quarantine.mgf
+    "quarantine": frozenset({"path", "reason"}),
+    # a lane section exceeded --watchdog-timeout
+    "watchdog_stall": frozenset({"lane", "elapsed_s"}),
     "bench_run": frozenset({"method", "phases_s"}),
     "run_end": frozenset({"counters", "phases_s", "elapsed_s", "device"}),
     # v2: one finished tracing span (observability.tracing).  The span's

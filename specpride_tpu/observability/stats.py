@@ -63,6 +63,10 @@ class RunStats:
         # carried on the stats object so _finish_run can journal it in
         # run_end without widening every return path
         self.pipeline: dict | None = None
+        # set by the robustness harness (specpride_tpu.robustness):
+        # retry/degrade/fault accounting journaled in run_end the same
+        # way — None whenever the layer stayed dormant
+        self.robustness: dict | None = None
         self._start = time.perf_counter()
 
     def count(self, name: str, n: int = 1) -> None:

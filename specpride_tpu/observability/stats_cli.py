@@ -59,6 +59,26 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
         "chunks": len(chunks),
         "skipped_clusters": skipped,
     }
+    # robustness layer: injected-fault / recovery accounting (absent on
+    # runs where the layer stayed dormant, so old journals render as
+    # before).  The pairing audit runs here — an unrecovered fault in a
+    # "green" journal is exactly the silent rot `specpride stats` exists
+    # to surface.
+    rb_counts = {
+        kind: sum(1 for e in events if e["event"] == kind)
+        for kind in (
+            "fault", "retry", "degrade", "quarantine", "resume_repair",
+            "watchdog_stall",
+        )
+    }
+    if any(rb_counts.values()) or (end or {}).get("robustness"):
+        from specpride_tpu.robustness.faults import audit_fault_recovery
+
+        rb: dict = {k: v for k, v in rb_counts.items() if v}
+        rb["unrecovered_faults"] = len(audit_fault_recovery(events))
+        if end and end.get("robustness"):
+            rb["run_end"] = end["robustness"]
+        run["robustness"] = rb
     if start:
         run.update(
             command=start.get("command"),
@@ -170,6 +190,32 @@ def _render_run(run: dict, out) -> None:
                 f"  lanes: pack_busy_s=[{pack}]{frac} "
                 f"write_busy_s={run.get('write_busy_s', 0.0):.3f} "
                 f"reorder_stall_s={run.get('reorder_stall_s', 0.0):.3f}",
+                file=out,
+            )
+    rb = run.get("robustness")
+    if rb:
+        bits = " ".join(
+            f"{k}={rb[k]}"
+            for k in (
+                "fault", "retry", "degrade", "quarantine",
+                "resume_repair", "watchdog_stall",
+            )
+            if rb.get(k)
+        )
+        state = (
+            "UNRECOVERED" if rb.get("unrecovered_faults") else "recovered"
+        )
+        print(
+            f"  robustness: {bits or 'armed, no events'} "
+            f"({rb.get('unrecovered_faults', 0)} {state})", file=out,
+        )
+        rend = rb.get("run_end") or {}
+        if rend.get("retries") is not None:
+            print(
+                f"  robustness run_end: retries={rend.get('retries')} "
+                f"retry_wait_s={rend.get('retry_wait_s')} "
+                f"degrade_splits={rend.get('degrade_splits', 0)} "
+                f"degrade_reroutes={rend.get('degrade_reroutes', 0)}",
                 file=out,
             )
     print(

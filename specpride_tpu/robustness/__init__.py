@@ -1,0 +1,69 @@
+"""Fault-injection, retry, degradation and integrity layer.
+
+Production-scale MS pipelines stream millions of spectra through hosts
+and accelerators where transient failures are routine (SpecHD,
+arXiv:2311.12874 targets exactly such hardware; clustering at the scale
+of arXiv:1301.0834 makes "restart the run" an unaffordable recovery
+strategy).  This package makes every lane boundary of the multi-lane
+chunk executor (``cli._checkpointed_run``) a *recoverable* failure
+point, and makes the recovery paths themselves testable:
+
+``faults``
+    A seeded, deterministic :class:`FaultPlan` (``--inject-faults``
+    ``SITE:KIND:RATE[:AFTER[:MAX]]``, or the ``SPECPRIDE_FAULTS`` env
+    var for subprocess tests) fires realistic errors at named sites
+    already delimited by tracing spans — ``parse``, ``pack``,
+    ``prepare``, ``dispatch``, ``d2h``, ``qc``, ``write``,
+    ``checkpoint_write``.  Every injected fault is journaled.
+
+``errors``
+    One error taxonomy both backends and the executor share:
+    transient (worth retrying), out-of-memory (worth degrading), or
+    permanent (surface to ``--on-error``).
+
+``retry``
+    Bounded exponential backoff with deterministic jitter
+    (``--retries`` / ``--retry-backoff``) around chunk dispatch and the
+    committer's write+checkpoint tail; every retry is journaled and
+    counted into ``run_end.robustness``.
+
+``watchdog``
+    A per-lane stall monitor (``--watchdog-timeout``): lanes run their
+    work inside watched sections; a section that exceeds the timeout is
+    journaled as ``watchdog_stall`` and cancels any injected ``hang``
+    so the lane's retry policy can recover it.
+
+``integrity``
+    Checkpoint manifests gain a schema version and a sha256 of the
+    committed MGF bytes; resume verifies the hash, truncates torn
+    tails at record boundaries, and journals every ``resume_repair``.
+
+``quarantine``
+    Malformed MGF records divert to ``<output>.quarantine.mgf``
+    instead of aborting the run (under ``--on-error skip``).
+"""
+
+from specpride_tpu.robustness.errors import (  # noqa: F401
+    InjectedFault,
+    LaneHangError,
+    classify,
+    is_oom,
+    is_transient,
+)
+from specpride_tpu.robustness.faults import (  # noqa: F401
+    FAULT_SITES,
+    FaultPlan,
+    active_plan,
+    check,
+    install,
+    recovery_sites_for,
+    uninstall,
+)
+from specpride_tpu.robustness.harness import Harness  # noqa: F401
+from specpride_tpu.robustness.integrity import (  # noqa: F401
+    MANIFEST_SCHEMA,
+    OutputIntegrity,
+)
+from specpride_tpu.robustness.quarantine import Quarantine  # noqa: F401
+from specpride_tpu.robustness.retry import RetryPolicy  # noqa: F401
+from specpride_tpu.robustness.watchdog import Watchdog  # noqa: F401

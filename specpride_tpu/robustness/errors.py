@@ -1,0 +1,78 @@
+"""One error taxonomy for the whole execution stack.
+
+The executor's recovery decisions hang off three classes:
+
+* **transient** — worth retrying in place: I/O hiccups (``OSError``),
+  device resource pressure, lane hangs broken by the watchdog.  These
+  are the failures a production host sees under load and that a bounded
+  backoff genuinely fixes.
+* **oom** — a ``RESOURCE_EXHAUSTED`` device allocation failure.  A
+  retry of the *same* batch usually fails again, but a *smaller* batch
+  fits: the executor splits the chunk in half instead of retrying.
+* **permanent** — malformed input, logic errors (``ValueError``):
+  retrying cannot help, so they surface straight to ``--on-error``.
+
+Real device OOMs arrive as ``jaxlib``'s ``XlaRuntimeError`` whose
+message starts with the gRPC status name — matched here by substring so
+this module never imports jax (the numpy oracle path must load without
+it).  Injected faults raise the same shapes (``faults.py``), so the
+classification path exercised in tests is the one production hits.
+"""
+
+from __future__ import annotations
+
+# substrings of RuntimeError messages that mark a device allocation
+# failure (jaxlib XlaRuntimeError carries the gRPC status name verbatim)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+# RuntimeError messages that mark *transient* device/runtime trouble
+# worth a retry (collective timeouts, preempted devices, poisoned
+# streams after a neighboring failure)
+_TRANSIENT_MARKERS = (
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "CANCELLED",
+    "INTERNAL: Failed to",
+)
+
+
+class InjectedFault(Exception):
+    """Mixin marking an exception as injected by a FaultPlan — never
+    raised directly; concrete faults subclass (kind, real type)."""
+
+
+class LaneHangError(TimeoutError):
+    """A lane section stalled past the watchdog timeout (or an injected
+    ``hang`` ran out its bound).  Transient: the work itself is intact,
+    so the enclosing retry re-runs it."""
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Device allocation failure — the degradation (chunk-split) class."""
+    return isinstance(exc, RuntimeError) and any(
+        m in str(exc) for m in _OOM_MARKERS
+    )
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Worth retrying in place.  OOM is also transient in the taxonomy —
+    when the caller cannot split (single-cluster chunk, ``--no-degrade``)
+    a backoff retry is the only remaining in-place recovery."""
+    if isinstance(exc, (OSError, TimeoutError)):
+        return True
+    if is_oom(exc):
+        return True
+    return isinstance(exc, RuntimeError) and any(
+        m in str(exc) for m in _TRANSIENT_MARKERS
+    )
+
+
+def classify(exc: BaseException) -> str:
+    """``"oom"`` | ``"transient"`` | ``"permanent"`` — the order matters:
+    OOM is transient too, but callers that can degrade check it first."""
+    if is_oom(exc):
+        return "oom"
+    if is_transient(exc):
+        return "transient"
+    return "permanent"

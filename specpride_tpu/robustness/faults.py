@@ -1,0 +1,344 @@
+"""Seeded, deterministic fault injection at named pipeline sites.
+
+A :class:`FaultPlan` is parsed from ``--inject-faults``
+``SITE:KIND:RATE[:AFTER[:MAX]],...`` (or the ``SPECPRIDE_FAULTS`` env
+var, so subprocess kill/resume tests can arm a child run without
+threading CLI flags through):
+
+* ``SITE`` — one of :data:`FAULT_SITES`, the lane boundaries already
+  delimited by tracing spans: ``parse`` (chunk materialization / MGF
+  window parse), ``pack`` (host pack stage), ``prepare`` (backend
+  ``prepare_chunk``), ``dispatch`` (device dispatch of a chunk),
+  ``d2h`` (device→host result fetch), ``qc`` (cosine QC pass),
+  ``write`` (MGF append), ``checkpoint_write`` (manifest replace).
+* ``KIND`` — the realistic error raised there: ``io`` (``OSError``),
+  ``oom`` (a ``RESOURCE_EXHAUSTED``-shaped ``RuntimeError``, the shape
+  jaxlib's ``XlaRuntimeError`` carries), ``malformed``
+  (``ValueError``), or ``hang`` (the site blocks until the per-lane
+  watchdog cancels it — or a hard bound expires — then raises a
+  transient :class:`~specpride_tpu.robustness.errors.LaneHangError`).
+* ``RATE`` — firing probability per eligible visit, drawn
+  deterministically from ``sha256(seed, site, visit)`` so a given
+  ``(plan, seed)`` fires at exactly the same visits on every run,
+  regardless of thread scheduling.
+* ``AFTER`` — skip the first AFTER visits of the site (default 0), so
+  a fault can target "the third chunk" instead of the first.
+* ``MAX`` — cap on total fires for this entry (default 1).  The cap is
+  what makes ``RATE=1`` useful: "fire exactly once, as early as
+  possible", the chaos-CI idiom — and it guarantees a bounded retry
+  policy eventually sees a clean attempt.
+
+Every fired fault is journaled as a ``fault`` event before the error
+is raised, so a post-mortem can pair each injection with the recovery
+event (``retry`` / ``degrade`` / ``resume_repair`` / ``quarantine`` /
+``skipped_clusters``) that survived it — :func:`audit_fault_recovery`
+implements exactly that pairing for CI.
+
+The plan installs process-globally (:func:`install`) because the
+injection points live in both the CLI executor and the backends;
+:func:`check` is the single hot-path entry and costs one global read
+when no plan is armed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+import time
+
+from specpride_tpu.robustness.errors import InjectedFault, LaneHangError
+
+FAULT_SITES = (
+    "parse", "pack", "prepare", "dispatch", "d2h", "qc", "write",
+    "checkpoint_write",
+)
+
+FAULT_KINDS = ("io", "oom", "malformed", "hang")
+
+# a hang with no watchdog armed must still end: hard bound on the block
+MAX_HANG_S = 5.0
+
+# which retry-wrapper site recovers a fault fired at SITE: the pack-lane
+# wrapper covers everything the pack stage runs (materialization,
+# prepare); the dispatch wrapper covers the device round trip incl. the
+# result fetch.  audit_fault_recovery pairs events with this map.
+_RECOVERY_SITES = {
+    "parse": ("pack",),
+    "pack": ("pack",),
+    "prepare": ("pack",),
+    "dispatch": ("dispatch",),
+    "d2h": ("dispatch",),
+    "qc": ("qc",),
+    "write": ("write",),
+    "checkpoint_write": ("checkpoint_write",),
+}
+
+
+def recovery_sites_for(site: str) -> tuple[str, ...]:
+    return _RECOVERY_SITES.get(site, (site,))
+
+
+class InjectedOSError(OSError, InjectedFault):
+    pass
+
+
+class InjectedResourceExhausted(RuntimeError, InjectedFault):
+    """Shaped like jaxlib's XlaRuntimeError for RESOURCE_EXHAUSTED — the
+    message prefix is what ``errors.is_oom`` (and production code
+    matching real device OOMs) keys on."""
+
+
+class InjectedValueError(ValueError, InjectedFault):
+    pass
+
+
+class InjectedHang(LaneHangError, InjectedFault):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    kind: str
+    rate: float
+    after: int = 0
+    max_fires: int = 1
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        parts = text.strip().split(":")
+        if not 3 <= len(parts) <= 5:
+            raise ValueError(
+                f"fault spec {text!r}: want SITE:KIND:RATE[:AFTER[:MAX]]"
+            )
+        site, kind, rate = parts[0], parts[1], float(parts[2])
+        if site not in FAULT_SITES:
+            raise ValueError(
+                f"fault spec {text!r}: unknown site {site!r} "
+                f"(sites: {', '.join(FAULT_SITES)})"
+            )
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"fault spec {text!r}: unknown kind {kind!r} "
+                f"(kinds: {', '.join(FAULT_KINDS)})"
+            )
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"fault spec {text!r}: rate must be in [0, 1]")
+        after = int(parts[3]) if len(parts) >= 4 else 0
+        max_fires = int(parts[4]) if len(parts) == 5 else 1
+        if after < 0 or max_fires < 0:
+            raise ValueError(f"fault spec {text!r}: AFTER/MAX must be >= 0")
+        return cls(site, kind, rate, after, max_fires)
+
+
+class FaultPlan:
+    """The armed set of fault specs plus per-site visit/fire accounting.
+
+    Thread-safe: ``check`` is called concurrently from pack workers, the
+    dispatch lane, the committer, and backend fetch threads.  The visit
+    counter advances under the lock; the fire decision is a pure
+    function of ``(seed, site, visit)`` so concurrency changes *which
+    thread* trips a fault, never *which visit* does."""
+
+    def __init__(self, specs: list[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self.journal = None  # attached by install(); may stay None
+        self._by_site: dict[str, list[FaultSpec]] = {}
+        for s in self.specs:
+            self._by_site.setdefault(s.site, []).append(s)
+        self._lock = threading.Lock()
+        self._visits: dict[str, int] = {}
+        self._fires: dict[int, int] = {}  # spec index -> fire count
+        self._spec_index = {id(s): i for i, s in enumerate(self.specs)}
+        self.fired_by_site: dict[str, int] = {}
+        self._hang_cancel = threading.Event()
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        specs = [
+            FaultSpec.parse(part)
+            for part in text.split(",")
+            if part.strip()
+        ]
+        if not specs:
+            raise ValueError(f"empty fault plan {text!r}")
+        return cls(specs, seed=seed)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan | None":
+        """``SPECPRIDE_FAULTS`` / ``SPECPRIDE_FAULT_SEED``: the subprocess
+        escape hatch (kill/resume tests arm a child CLI run without
+        plumbing flags through its argv)."""
+        spec = os.environ.get("SPECPRIDE_FAULTS", "").strip()
+        if not spec:
+            return None
+        seed = int(os.environ.get("SPECPRIDE_FAULT_SEED", "0") or 0)
+        return cls.parse(spec, seed=seed)
+
+    @property
+    def fired_total(self) -> int:
+        return sum(self.fired_by_site.values())
+
+    def summary(self) -> dict:
+        return {
+            "plan": [dataclasses.asdict(s) for s in self.specs],
+            "seed": self.seed,
+            "fired_total": self.fired_total,
+            "fired_by_site": dict(sorted(self.fired_by_site.items())),
+        }
+
+    def _draw(self, site: str, visit: int) -> float:
+        digest = hashlib.sha256(
+            f"{self.seed}:{site}:{visit}".encode()
+        ).digest()
+        return int.from_bytes(digest[:8], "big") / float(1 << 64)
+
+    def cancel_hangs(self) -> None:
+        """Break every current AND future injected hang — the watchdog's
+        lever.  One-way by design: once a run's watchdog has proven the
+        lane can stall, further hangs at the same sites would only
+        re-measure the same timeout."""
+        self._hang_cancel.set()
+
+    def check(self, site: str) -> None:
+        """Fire at most one armed fault for this visit of ``site``.
+
+        Raises the fault's error type after journaling a ``fault``
+        event; a clean visit returns immediately (one lock + one dict
+        update when specs exist for the site, a dict miss otherwise)."""
+        specs = self._by_site.get(site)
+        fired: FaultSpec | None = None
+        with self._lock:
+            visit = self._visits.get(site, 0)
+            self._visits[site] = visit + 1
+            if specs:
+                for s in specs:
+                    idx = self._spec_index[id(s)]
+                    if visit < s.after:
+                        continue
+                    if self._fires.get(idx, 0) >= s.max_fires:
+                        continue
+                    if self._draw(site, visit) < s.rate:
+                        self._fires[idx] = self._fires.get(idx, 0) + 1
+                        self.fired_by_site[site] = (
+                            self.fired_by_site.get(site, 0) + 1
+                        )
+                        fired = s
+                        break
+        if fired is None:
+            return
+        if self.journal is not None:
+            self.journal.emit(
+                "fault", site=site, kind=fired.kind, visit=visit,
+            )
+        self._raise(site, fired, visit)
+
+    def _raise(self, site: str, spec: FaultSpec, visit: int) -> None:
+        msg = f"injected {spec.kind} fault at {site} (visit {visit})"
+        if spec.kind == "io":
+            raise InjectedOSError(msg)
+        if spec.kind == "oom":
+            raise InjectedResourceExhausted(f"RESOURCE_EXHAUSTED: {msg}")
+        if spec.kind == "malformed":
+            raise InjectedValueError(msg)
+        # hang: block until the watchdog cancels us (or the hard bound
+        # expires), then surface as a transient lane-hang the enclosing
+        # retry policy recovers — exactly what a real stalled device
+        # stream looks like from the lane's point of view
+        deadline = time.perf_counter() + MAX_HANG_S
+        while time.perf_counter() < deadline:
+            if self._hang_cancel.wait(timeout=0.02):
+                break
+        raise InjectedHang(f"{msg}: lane unblocked after stall")
+
+
+_active: FaultPlan | None = None
+_suppress = threading.local()
+
+
+class _Suppressed:
+    """Context manager disabling injection on THIS thread — used by the
+    degradation reroute: its numpy fallback is a different physical path
+    than the device lane the plan models, and injecting into the
+    last-resort recovery would only prove that no recovery remains."""
+
+    def __enter__(self):
+        self._prev = getattr(_suppress, "on", False)
+        _suppress.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _suppress.on = self._prev
+
+
+def suppressed() -> _Suppressed:
+    return _Suppressed()
+
+
+def install(plan: FaultPlan | None, journal=None) -> FaultPlan | None:
+    """Arm ``plan`` process-wide (None disarms).  Returns the previous
+    plan so callers can restore it — the CLI arms per run and disarms in
+    its ``finally``."""
+    global _active
+    prev = _active
+    if plan is not None and journal is not None:
+        plan.journal = journal
+    _active = plan
+    return prev
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def active_plan() -> FaultPlan | None:
+    return _active
+
+
+def check(site: str) -> None:
+    """THE injection hot path, called at every site on every chunk.
+    Disarmed cost is one global read and a None test — measured in the
+    bench's ``fault_overhead`` section."""
+    plan = _active
+    if plan is not None and not getattr(_suppress, "on", False):
+        plan.check(site)
+
+
+def audit_fault_recovery(events: list[dict]) -> list[dict]:
+    """Pair every journaled ``fault`` with a later recovery event.
+
+    Recovery evidence, in pairing order: a ``retry`` at the fault
+    site's wrapper (see :func:`recovery_sites_for`), a ``degrade``, a
+    ``quarantine``, a ``resume_repair``, or a ``skipped_clusters``
+    record (the ``--on-error skip`` outcome).  Each recovery event
+    backs at most one fault.  Returns the faults left unmatched — the
+    chaos CI pass asserts this list is empty."""
+    faults = [e for e in events if e.get("event") == "fault"]
+    recoveries = [
+        e for e in events
+        if e.get("event") in (
+            "retry", "degrade", "quarantine", "resume_repair",
+            "skipped_clusters",
+        )
+    ]
+    used: set[int] = set()
+    unmatched = []
+    for f in faults:
+        sites = recovery_sites_for(f.get("site", ""))
+        found = False
+        for i, r in enumerate(recoveries):
+            if i in used:
+                continue
+            if r.get("mono", 0) < f.get("mono", 0):
+                continue
+            if r["event"] == "retry" and r.get("site") not in sites:
+                continue
+            used.add(i)
+            found = True
+            break
+        if not found:
+            unmatched.append(f)
+    return unmatched

@@ -1,0 +1,83 @@
+"""Checkpoint/output integrity: schema-versioned manifests with a
+sha256 over the committed MGF bytes.
+
+The commit protocol (``cli._commit_chunk``) appends chunk *i*'s bytes,
+then atomically replaces the manifest recording ``{done, output_bytes,
+sha256, schema}``.  The hash covers exactly the first ``output_bytes``
+bytes of the output — the committed prefix — maintained incrementally
+by :class:`OutputIntegrity` (each commit absorbs only the bytes it just
+appended, so hashing cost is O(bytes written), never O(file size) per
+chunk).
+
+On resume the manifest's hash is verified against the file in one
+O(file) pass that doubles as the re-seed of the running hash.  This
+closes the two corruption windows the byte-count check alone misses:
+a bit flip *inside* the committed region (count unchanged, data wrong)
+and a torn tail that happens to land at the recorded size.  Every
+repair decision is journaled as a ``resume_repair`` event.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+# manifest schema: 1 = the implicit legacy {done, output_bytes, failed}
+# shape (no version field); 2 adds "schema" + "sha256".  Legacy
+# manifests still resume — without a hash there is nothing to verify,
+# so they get the historical byte-count checks only.
+MANIFEST_SCHEMA = 2
+
+_CHUNK = 1 << 20
+
+
+class OutputIntegrity:
+    """Running sha256 over the committed prefix of one output file."""
+
+    def __init__(self) -> None:
+        self._hasher = hashlib.sha256()
+        self.offset = 0
+
+    def reset(self) -> None:
+        self._hasher = hashlib.sha256()
+        self.offset = 0
+
+    def hexdigest(self) -> str:
+        return self._hasher.hexdigest()
+
+    def absorb(self, path: str, new_size: int) -> None:
+        """Advance the committed prefix to ``new_size`` by hashing the
+        bytes appended since the last commit."""
+        if new_size <= self.offset:
+            return
+        with open(path, "rb") as fh:
+            fh.seek(self.offset)
+            remaining = new_size - self.offset
+            while remaining > 0:
+                block = fh.read(min(_CHUNK, remaining))
+                if not block:
+                    break
+                self._hasher.update(block)
+                remaining -= len(block)
+        self.offset = new_size
+
+    def seed_file(self, path: str, upto: int) -> str:
+        """(Re)start the running hash from the first ``upto`` bytes of
+        ``path`` — the resume/append seeding pass.  Returns the digest
+        of that prefix so the caller can verify it against a manifest in
+        the same read."""
+        self.reset()
+        self.absorb(path, upto)
+        return self.hexdigest()
+
+
+def manifest_payload(done, output_bytes: int, integrity: "OutputIntegrity",
+                     failed=None) -> dict:
+    """The schema-v2 manifest body every checkpoint write emits."""
+    return {
+        "schema": MANIFEST_SCHEMA,
+        "done": sorted(done),
+        "output_bytes": output_bytes,
+        "sha256": integrity.hexdigest(),
+        **({"failed": failed} if failed else {}),
+    }

@@ -1,0 +1,93 @@
+"""Malformed-record quarantine: divert unparseable MGF blocks instead
+of aborting the run.
+
+A truncated ``BEGIN IONS`` block (a torn upload, a corrupted stripe) or
+a record whose peak lines don't parse used to kill a whole
+million-spectrum run at whatever point the parser reached it.  Under
+``--on-error skip`` the parsers now hand such blocks to a
+:class:`Quarantine`, which appends the raw text verbatim to
+``<output>.quarantine.mgf`` (lazily created — no faults, no file) and
+journals a ``quarantine`` event per block, so the dropped records are
+recoverable and auditable rather than silently skipped or fatally
+raised.
+
+Thread-safe: streamed-window parsing happens on pack-pool workers.
+Blocks found before the run journal opens (the eager parse runs first)
+are buffered and flushed when :meth:`bind` attaches the journal.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from specpride_tpu.observability import logger
+
+
+class Quarantine:
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.count = 0
+        self._lock = threading.Lock()
+        self._journal = None
+        self._pending: list[dict] = []
+        self._fh = None
+        # per-run semantics: a resume re-parses the whole input and
+        # re-quarantines every bad block, so a surviving file from an
+        # earlier attempt would only accumulate duplicates (and a stale
+        # file from an unrelated run at the same output path would lie)
+        with contextlib.suppress(OSError):
+            os.remove(self.path)
+
+    def rename(self, path: str) -> None:
+        """Move the quarantine to a new path (multi-host sharding gives
+        each rank its own ``.part<id>`` file, like every other per-run
+        artifact).  Safe before or after the first block landed."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            if os.path.exists(self.path):
+                os.replace(self.path, str(path))
+            self.path = str(path)
+
+    def bind(self, journal) -> None:
+        """Attach the run journal; events queued before it opened flush
+        now (journal consumers still see them after run_start)."""
+        with self._lock:
+            self._journal = journal
+            pending, self._pending = self._pending, []
+        for fields in pending:
+            journal.emit("quarantine", **fields)
+
+    def add(self, raw: str, reason: str) -> None:
+        """Append one malformed block to the quarantine file.  Matches
+        the ``malformed`` callback signature of ``io.mgf``'s parsers."""
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self.path, "a", encoding="utf-8")
+            text = raw if raw.endswith("\n") else raw + "\n"
+            self._fh.write(text)
+            if not text.endswith("\n\n"):
+                self._fh.write("\n")
+            self._fh.flush()
+            self.count += 1
+            journal = self._journal
+            fields = {
+                "path": self.path, "reason": reason,
+                "n_bytes": len(raw),
+            }
+            if journal is None:
+                self._pending.append(fields)
+        logger.warning(
+            "quarantined malformed MGF block (%s) -> %s", reason, self.path
+        )
+        if journal is not None:
+            journal.emit("quarantine", **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

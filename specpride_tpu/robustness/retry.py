@@ -1,0 +1,102 @@
+"""Bounded retry with exponential backoff and deterministic jitter.
+
+One :class:`RetryPolicy` per run wraps the failure-prone lane tails:
+the pack stage, chunk dispatch, the QC cosine pass, and the committer's
+MGF append + manifest replace.  Only errors the shared taxonomy calls
+transient (``errors.is_transient``) retry — malformed input fails fast
+to ``--on-error``, exactly as before this layer existed.
+
+Jitter is deterministic (``sha256(seed, site, attempt)``), so a seeded
+fault-injection run backs off identically every time: chaos CI wall
+times are reproducible and a flaking recovery path can be replayed.
+The policy is shared across lanes and therefore thread-safe; counters
+land in ``run_end.robustness`` via :meth:`summary`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+from specpride_tpu.observability import logger
+from specpride_tpu.robustness import errors
+
+
+class RetryPolicy:
+    """``--retries N --retry-backoff BASE``: up to N retries per call,
+    sleeping ``BASE * 2**attempt * (1 + jitter)`` between attempts with
+    ``jitter`` drawn deterministically in [0, 0.25)."""
+
+    def __init__(self, retries: int = 0, backoff: float = 0.05,
+                 seed: int = 0, journal=None):
+        self.retries = max(int(retries), 0)
+        self.backoff = max(float(backoff), 0.0)
+        self.seed = int(seed)
+        self.journal = journal
+        self._lock = threading.Lock()
+        self.retry_count = 0
+        self.retry_wait_s = 0.0
+        self.retries_by_site: dict[str, int] = {}
+
+    def _jitter(self, site: str, attempt: int) -> float:
+        digest = hashlib.sha256(
+            f"{self.seed}:{site}:{attempt}".encode()
+        ).digest()
+        return 0.25 * int.from_bytes(digest[:8], "big") / float(1 << 64)
+
+    def backoff_s(self, site: str, attempt: int) -> float:
+        """Sleep before retry number ``attempt`` (0-based)."""
+        return self.backoff * (2 ** attempt) * (
+            1.0 + self._jitter(site, attempt)
+        )
+
+    def note_retry(self, site: str, attempt: int, error: BaseException,
+                   wait_s: float) -> None:
+        with self._lock:
+            self.retry_count += 1
+            self.retry_wait_s += wait_s
+            self.retries_by_site[site] = (
+                self.retries_by_site.get(site, 0) + 1
+            )
+        if self.journal is not None:
+            self.journal.emit(
+                "retry", site=site, attempt=attempt,
+                backoff_s=round(wait_s, 4),
+                error=f"{type(error).__name__}: {error}",
+            )
+        logger.warning(
+            "%s failed (%s); retry %d/%d in %.3fs",
+            site, error, attempt + 1, self.retries, wait_s,
+        )
+
+    def call(self, site: str, fn, *, before_retry=None):
+        """Run ``fn()``; on a transient error, wait and re-run, up to
+        ``retries`` times.  ``before_retry`` (if given) runs before each
+        re-attempt — the committer uses it to truncate a partial append
+        so the retry can never duplicate bytes.  The final error (or
+        any permanent error) propagates to the caller's policy."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 - classified below
+                if attempt >= self.retries or not errors.is_transient(e):
+                    raise
+                wait = self.backoff_s(site, attempt)
+                self.note_retry(site, attempt, e, wait)
+                if before_retry is not None:
+                    before_retry()
+                if wait > 0:
+                    time.sleep(wait)
+                attempt += 1
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "retries": self.retry_count,
+                "retry_wait_s": round(self.retry_wait_s, 4),
+                "retries_by_site": dict(sorted(
+                    self.retries_by_site.items()
+                )),
+            }

@@ -1,0 +1,111 @@
+"""Per-lane stall watchdog (``--watchdog-timeout``).
+
+Each executor lane runs its work inside a watched *section*
+(``with watchdog.section("dispatch"): ...``); a monitor thread checks
+open sections and, when one exceeds the timeout, journals a
+``watchdog_stall`` event and cancels any injected hang so the lane
+raises a transient :class:`~specpride_tpu.robustness.errors.LaneHangError`
+the retry policy recovers.
+
+Sections — not heartbeats — are the right primitive here: a lane parked
+on an empty queue is *idle*, not stalled, and must never trip the
+watchdog; only time spent inside real work counts.  Against a genuine
+runaway (a wedged device stream, not an injected one) the watchdog
+cannot interrupt the thread — Python offers no safe cross-thread
+interrupt — but the journaled stall pins *which lane* and *how long*,
+which is the information a kill/resume operator needs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from specpride_tpu.observability import logger
+
+
+class Watchdog:
+    """Monitor thread over named lane sections.
+
+    ``timeout_s <= 0`` builds a disabled instance whose ``section`` is
+    free (no thread, no lock traffic) so call sites never branch."""
+
+    def __init__(self, timeout_s: float, journal=None, on_stall=None):
+        self.timeout_s = float(timeout_s)
+        self.enabled = self.timeout_s > 0
+        self.journal = journal
+        self.on_stall = on_stall  # e.g. FaultPlan.cancel_hangs
+        self.stall_count = 0
+        self._sections: dict[int, tuple[str, float]] = {}
+        self._flagged: set[int] = set()
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if self.enabled:
+            self._thread = threading.Thread(
+                target=self._monitor, name="specpride-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    class _Section:
+        __slots__ = ("_wd", "_key")
+
+        def __init__(self, wd: "Watchdog | None", key: int | None):
+            self._wd, self._key = wd, key
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            if self._wd is not None:
+                with self._wd._lock:
+                    self._wd._sections.pop(self._key, None)
+                    self._wd._flagged.discard(self._key)
+
+    def section(self, lane: str) -> "_Section":
+        """Mark this thread as doing ``lane`` work until exit."""
+        if not self.enabled:
+            return self._Section(None, None)
+        key = next(self._ids)
+        with self._lock:
+            self._sections[key] = (lane, time.perf_counter())
+        return self._Section(self, key)
+
+    def _monitor(self) -> None:
+        # poll a few times per timeout so detection latency stays a
+        # fraction of the bound without a hot loop
+        step = min(max(self.timeout_s / 5.0, 0.02), 0.5)
+        while not self._stop.wait(step):
+            now = time.perf_counter()
+            stalled: list[tuple[str, float]] = []
+            with self._lock:
+                for key, (lane, t0) in self._sections.items():
+                    if key in self._flagged:
+                        continue
+                    if now - t0 >= self.timeout_s:
+                        # flag once per section: a stall is an event,
+                        # not a condition to re-report every poll
+                        self._flagged.add(key)
+                        stalled.append((lane, now - t0))
+            for lane, elapsed in stalled:
+                self.stall_count += 1
+                logger.warning(
+                    "lane %s stalled for %.2fs (watchdog timeout %.2fs)",
+                    lane, elapsed, self.timeout_s,
+                )
+                if self.journal is not None:
+                    self.journal.emit(
+                        "watchdog_stall", lane=lane,
+                        elapsed_s=round(elapsed, 4),
+                        timeout_s=self.timeout_s,
+                    )
+                if self.on_stall is not None:
+                    self.on_stall()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None

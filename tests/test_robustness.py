@@ -1,0 +1,570 @@
+"""Fault-injection / recovery layer (specpride_tpu.robustness): plan
+parsing and determinism, retry with backoff, graceful degradation
+(OOM split + device reroute), the per-lane watchdog breaking injected
+hangs, malformed-record quarantine, and resume-after-corruption repair
+for all three methods — every recovery must leave output byte-identical
+to a fault-free serial run (or be a loud, journaled restart)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.io.mgf import read_mgf, write_mgf
+from specpride_tpu.robustness import errors as rb_errors
+from specpride_tpu.robustness import faults as rb_faults
+from specpride_tpu.robustness.faults import FaultPlan, audit_fault_recovery
+from specpride_tpu.robustness.retry import RetryPolicy
+
+from conftest import make_cluster
+
+
+def _workload(rng, n=8, **kw):
+    return [
+        make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=25, **kw)
+        for i in range(n)
+    ]
+
+
+def _write(tmp_path, clusters, name="clustered.mgf"):
+    path = tmp_path / name
+    write_mgf([s for c in clusters for s in c.members], path)
+    return path
+
+
+def _events(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def _run(clustered, out, *extra, command="consensus", ck=None, journal=None):
+    argv = [command, str(clustered), str(out)] + list(extra)
+    if ck is not None:
+        argv += ["--checkpoint", str(ck), "--checkpoint-every", "2"]
+    if journal is not None:
+        argv += ["--journal", str(journal)]
+    return cli_main(argv)
+
+
+class TestFaultPlan:
+    def test_spec_parsing(self):
+        plan = FaultPlan.parse(
+            "dispatch:oom:0.5:2:3, write:io:1", seed=7
+        )
+        s0, s1 = plan.specs
+        assert (s0.site, s0.kind, s0.rate, s0.after, s0.max_fires) == (
+            "dispatch", "oom", 0.5, 2, 3
+        )
+        assert (s1.site, s1.kind, s1.rate, s1.after, s1.max_fires) == (
+            "write", "io", 1.0, 0, 1
+        )
+
+    @pytest.mark.parametrize("bad", [
+        "nope:io:1", "dispatch:nope:1", "dispatch:io:2", "dispatch:io",
+        "", "dispatch:io:1:-1",
+    ])
+    def test_bad_specs_rejected(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+    def test_firing_is_deterministic_per_seed(self):
+        def fired_visits(seed):
+            plan = FaultPlan.parse("dispatch:io:0.3:0:1000", seed=seed)
+            out = []
+            for visit in range(50):
+                try:
+                    plan.check("dispatch")
+                except OSError:
+                    out.append(visit)
+            return out
+
+        a, b = fired_visits(11), fired_visits(11)
+        assert a == b and a  # same seed -> same visits, and some fire
+        assert fired_visits(12) != a  # a different seed reshuffles
+
+    def test_after_and_max_fires(self):
+        plan = FaultPlan.parse("write:io:1:3:2")
+        outcomes = []
+        for _ in range(8):
+            try:
+                plan.check("write")
+                outcomes.append("ok")
+            except OSError:
+                outcomes.append("fault")
+        # skips the first 3 visits, then fires exactly twice
+        assert outcomes == ["ok"] * 3 + ["fault", "fault"] + ["ok"] * 3
+        assert plan.fired_by_site == {"write": 2}
+
+    def test_error_shapes_match_taxonomy(self):
+        for kind, pred in (
+            ("io", rb_errors.is_transient),
+            ("oom", rb_errors.is_oom),
+        ):
+            plan = FaultPlan.parse(f"dispatch:{kind}:1")
+            with pytest.raises(Exception) as exc_info:
+                plan.check("dispatch")
+            assert pred(exc_info.value)
+        plan = FaultPlan.parse("dispatch:malformed:1")
+        with pytest.raises(ValueError) as exc_info:
+            plan.check("dispatch")
+        assert rb_errors.classify(exc_info.value) == "permanent"
+
+    def test_env_arming(self, monkeypatch):
+        monkeypatch.setenv("SPECPRIDE_FAULTS", "qc:io:1:1")
+        monkeypatch.setenv("SPECPRIDE_FAULT_SEED", "5")
+        plan = FaultPlan.from_env()
+        assert plan.seed == 5
+        assert [(s.site, s.kind) for s in plan.specs] == [("qc", "io")]
+        monkeypatch.delenv("SPECPRIDE_FAULTS")
+        assert FaultPlan.from_env() is None
+
+
+class TestRetryPolicy:
+    def test_transient_retried_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "done"
+
+        policy = RetryPolicy(retries=3, backoff=0.0)
+        assert policy.call("write", flaky) == "done"
+        assert len(calls) == 3
+        assert policy.summary()["retries"] == 2
+        assert policy.summary()["retries_by_site"] == {"write": 2}
+
+    def test_permanent_never_retried(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("malformed")
+
+        policy = RetryPolicy(retries=5, backoff=0.0)
+        with pytest.raises(ValueError):
+            policy.call("dispatch", bad)
+        assert len(calls) == 1
+
+    def test_budget_exhaustion_reraises(self):
+        policy = RetryPolicy(retries=2, backoff=0.0)
+        with pytest.raises(OSError):
+            policy.call("write", lambda: (_ for _ in ()).throw(OSError("x")))
+        assert policy.summary()["retries"] == 2
+
+    def test_backoff_is_exponential_and_deterministic(self):
+        policy = RetryPolicy(retries=3, backoff=0.1, seed=4)
+        waits = [policy.backoff_s("dispatch", i) for i in range(3)]
+        assert waits == [
+            RetryPolicy(retries=3, backoff=0.1, seed=4).backoff_s(
+                "dispatch", i
+            )
+            for i in range(3)
+        ]
+        assert 0.1 <= waits[0] < 0.125
+        assert 0.2 <= waits[1] < 0.25
+        assert 0.4 <= waits[2] < 0.5
+
+    def test_before_retry_hook_runs(self):
+        undone = []
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("partial write")
+            return "ok"
+
+        policy = RetryPolicy(retries=1, backoff=0.0)
+        assert policy.call(
+            "write", flaky, before_retry=lambda: undone.append(1)
+        ) == "ok"
+        assert undone == [1]
+
+
+class TestInjectedRecovery:
+    """End-to-end through the CLI: injected faults at every lane, output
+    byte-identical to a fault-free serial run, fault/recovery pairs in
+    the journal."""
+
+    def test_retry_recovers_every_io_site(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng))
+        golden = tmp_path / "golden.mgf"
+        assert _run(clustered, golden, "--prefetch", "0",
+                    ck=tmp_path / "g.ck.json") == 0
+        out, jr = tmp_path / "chaos.mgf", tmp_path / "chaos.jsonl"
+        assert _run(
+            clustered, out, "--prefetch", "4", "--pack-workers", "2",
+            "--async-write", "on", "--retries", "3",
+            "--retry-backoff", "0.01", "--inject-faults",
+            "parse:io:1,pack:io:1:1,prepare:io:1:1,dispatch:io:1:1,"
+            "write:io:1:2,checkpoint_write:io:1:3",
+            ck=tmp_path / "c.ck.json", journal=jr,
+        ) == 0
+        assert out.read_bytes() == golden.read_bytes()
+        events = _events(jr)
+        fired = {e["site"] for e in events if e["event"] == "fault"}
+        assert fired == {
+            "parse", "pack", "prepare", "dispatch", "write",
+            "checkpoint_write",
+        }
+        assert audit_fault_recovery(events) == []
+        rb = [e for e in events if e["event"] == "run_end"][-1]["robustness"]
+        assert rb["retries"] >= len(fired)
+        assert rb["faults"]["fired_total"] == len(fired)
+
+    def test_oom_splits_chunk_and_preserves_bytes(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng))
+        golden = tmp_path / "golden.mgf"
+        assert _run(clustered, golden, "--prefetch", "0",
+                    ck=tmp_path / "g.ck.json") == 0
+        out, jr = tmp_path / "oom.mgf", tmp_path / "oom.jsonl"
+        assert _run(
+            clustered, out, "--prefetch", "2", "--retry-backoff", "0.01",
+            "--inject-faults", "dispatch:oom:1:1",
+            ck=tmp_path / "o.ck.json", journal=jr,
+        ) == 0
+        assert out.read_bytes() == golden.read_bytes()
+        events = _events(jr)
+        degrades = [e for e in events if e["event"] == "degrade"]
+        assert [d["action"] for d in degrades] == ["split"]
+        assert audit_fault_recovery(events) == []
+
+    def test_repeated_device_failure_reroutes_to_numpy(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=4))
+        out, jr = tmp_path / "reroute.mgf", tmp_path / "reroute.jsonl"
+        # 9 fires at full rate with only 1 retry: the dispatch budget
+        # exhausts while the error stays transient -> reroute to numpy
+        assert _run(
+            clustered, out, "--prefetch", "2", "--retries", "1",
+            "--retry-backoff", "0.0",
+            "--inject-faults", "dispatch:io:1:0:9",
+            ck=tmp_path / "r.ck.json", journal=jr,
+        ) == 0
+        events = _events(jr)
+        actions = [e["action"] for e in events if e["event"] == "degrade"]
+        assert "reroute" in actions
+        assert audit_fault_recovery(events) == []
+        # every cluster still produced a representative
+        assert sorted(s.cluster_id for s in read_mgf(out)) == [
+            f"cluster-{i}" for i in range(4)
+        ]
+
+    def test_no_degrade_disables_split_and_reroute(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=4))
+        out = tmp_path / "nd.mgf"
+        with pytest.raises(RuntimeError):
+            _run(
+                clustered, out, "--prefetch", "2", "--no-degrade",
+                "--retries", "1", "--retry-backoff", "0.0",
+                "--inject-faults", "dispatch:oom:1:0:9",
+                ck=tmp_path / "nd.ck.json",
+            )
+
+    def test_qc_fault_retries_and_report_matches(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=6))
+        reports = {}
+        for tag, extra in (
+            ("clean", []),
+            ("faulty", ["--retries", "2", "--retry-backoff", "0.01",
+                        "--inject-faults", "qc:io:1:1"]),
+        ):
+            out = tmp_path / f"qc_{tag}.mgf"
+            qc = tmp_path / f"qc_{tag}.json"
+            jr = tmp_path / f"qc_{tag}.jsonl"
+            assert _run(
+                clustered, out, "--method", "medoid", "--prefetch", "2",
+                "--qc-report", str(qc), *extra,
+                command="select", ck=tmp_path / f"qc_{tag}.ck.json",
+                journal=jr,
+            ) == 0
+            reports[tag] = qc.read_bytes()
+        assert reports["clean"] == reports["faulty"]
+        events = _events(tmp_path / "qc_faulty.jsonl")
+        assert [e["site"] for e in events if e["event"] == "fault"] == ["qc"]
+        assert audit_fault_recovery(events) == []
+
+    def test_hang_broken_by_watchdog_and_retried(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=6))
+        golden = tmp_path / "golden.mgf"
+        assert _run(clustered, golden, "--prefetch", "0",
+                    ck=tmp_path / "g.ck.json") == 0
+        out, jr = tmp_path / "hang.mgf", tmp_path / "hang.jsonl"
+        assert _run(
+            clustered, out, "--prefetch", "2", "--retries", "2",
+            "--retry-backoff", "0.01", "--watchdog-timeout", "0.2",
+            "--inject-faults", "dispatch:hang:1:1",
+            ck=tmp_path / "h.ck.json", journal=jr,
+        ) == 0
+        assert out.read_bytes() == golden.read_bytes()
+        events = _events(jr)
+        stalls = [e for e in events if e["event"] == "watchdog_stall"]
+        assert stalls and stalls[0]["lane"] == "dispatch"
+        assert stalls[0]["elapsed_s"] >= 0.2
+        assert audit_fault_recovery(events) == []
+        rb = [e for e in events if e["event"] == "run_end"][-1]["robustness"]
+        assert rb["watchdog_stalls"] >= 1
+
+    def test_env_var_arms_subprocess(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=4))
+        jr = tmp_path / "env.jsonl"
+        res = subprocess.run(
+            [sys.executable, "-m", "specpride_tpu", "consensus",
+             str(clustered), str(tmp_path / "env.mgf"),
+             "--prefetch", "2", "--retries", "2", "--retry-backoff",
+             "0.01", "--journal", str(jr)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "SPECPRIDE_FAULTS": "write:io:1",
+                 "SPECPRIDE_FAULT_SEED": "3"},
+        )
+        assert res.returncode == 0, res.stderr
+        events = _events(jr)
+        assert [e["site"] for e in events if e["event"] == "fault"] == [
+            "write"
+        ]
+        assert audit_fault_recovery(events) == []
+
+    def test_exhausted_io_fault_follows_on_error_skip(self, tmp_path, rng):
+        """A persistent I/O failure that survives its (zero) retry budget
+        must follow --on-error skip like any compute failure — the
+        consumer's per-cluster serial retry recovers the chunk instead
+        of the OSError aborting the run."""
+        clustered = _write(tmp_path, _workload(rng, n=6))
+        out, jr = tmp_path / "skip.mgf", tmp_path / "skip.jsonl"
+        assert _run(
+            clustered, out, "--on-error", "skip", "--prefetch", "2",
+            "--retries", "0", "--no-degrade",
+            "--inject-faults", "pack:io:1:0:99",
+            ck=tmp_path / "s.ck.json", journal=jr,
+        ) == 0
+        # the serial retry materialized every cluster despite the pack
+        # lane failing persistently
+        assert sorted(s.cluster_id for s in read_mgf(out)) == [
+            f"cluster-{i}" for i in range(6)
+        ]
+
+    def test_plan_never_leaks_across_runs(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=4))
+        assert _run(
+            clustered, tmp_path / "a.mgf", "--prefetch", "2",
+            "--retries", "2", "--retry-backoff", "0.01",
+            "--inject-faults", "write:io:1:1",
+        ) == 0
+        assert rb_faults.active_plan() is None
+        jr = tmp_path / "clean.jsonl"
+        assert _run(
+            clustered, tmp_path / "b.mgf", "--prefetch", "2", journal=jr
+        ) == 0
+        events = _events(jr)
+        assert not [e for e in events if e["event"] == "fault"]
+        assert "robustness" not in [
+            e for e in events if e["event"] == "run_end"
+        ][-1]
+
+
+class TestQuarantine:
+    def _dirty_file(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=6))
+        blocks = clustered.read_text().split("\n\n")
+        trunc = (
+            "BEGIN IONS\nTITLE=cluster-trunc;mzspec:PXD000001:run1:"
+            "scan:9999\nPEPMASS=500.0\n123.4 10.0"
+        )
+        blocks.insert(4, trunc)  # mid-file BEGIN with no END IONS
+        dirty = tmp_path / "dirty.mgf"
+        dirty.write_text("\n\n".join(blocks))
+        return dirty
+
+    @pytest.mark.parametrize("stream", ["off", "2"])
+    def test_truncated_block_quarantined(self, tmp_path, rng, stream):
+        dirty = self._dirty_file(tmp_path, rng)
+        out = tmp_path / f"q_{stream}.mgf"
+        jr = tmp_path / f"q_{stream}.jsonl"
+        assert _run(
+            dirty, out, "--on-error", "skip", "--stream-clusters", stream,
+            "--prefetch", "2", journal=jr,
+        ) == 0
+        qfile = tmp_path / f"q_{stream}.mgf.quarantine.mgf"
+        assert "cluster-trunc" in qfile.read_text()
+        events = _events(jr)
+        qev = [e for e in events if e["event"] == "quarantine"]
+        assert len(qev) == 1 and "truncated record" in qev[0]["reason"]
+        # the 6 intact clusters all produced representatives
+        assert sorted(s.cluster_id for s in read_mgf(out)) == [
+            f"cluster-{i}" for i in range(6)
+        ]
+        rb = [e for e in events if e["event"] == "run_end"][-1]["robustness"]
+        assert rb["quarantined"] == 1
+
+    def test_quarantine_file_is_fresh_per_run(self, tmp_path, rng):
+        """Re-running over the same output must not accumulate duplicate
+        blocks (a resume re-parses the full input) or keep stale blocks
+        from an unrelated earlier run."""
+        dirty = self._dirty_file(tmp_path, rng)
+        out = tmp_path / "q.mgf"
+        qfile = tmp_path / "q.mgf.quarantine.mgf"
+        qfile.write_text("BEGIN IONS\nTITLE=stale-from-last-run\nEND IONS\n")
+        for _ in range(2):
+            assert _run(dirty, out, "--on-error", "skip",
+                        "--prefetch", "2") == 0
+        text = qfile.read_text()
+        assert "stale-from-last-run" not in text
+        assert text.count("cluster-trunc") == 1
+
+    def test_abort_policy_keeps_fail_fast(self, tmp_path, rng):
+        """Under the default --on-error abort a damaged record must still
+        raise (no quarantine file, no silent drop of the bad block)."""
+        clustered = _write(tmp_path, _workload(rng, n=3))
+        blocks = clustered.read_text().split("\n\n")
+        blocks.insert(
+            2,
+            "BEGIN IONS\nTITLE=cluster-bad;mzspec:PXD000001:run1:scan:9\n"
+            "PEPMASS=500.0\n123.4 banana\nEND IONS",
+        )
+        dirty = tmp_path / "dirty.mgf"
+        dirty.write_text("\n\n".join(blocks))
+        out = tmp_path / "abort.mgf"
+        with pytest.raises(ValueError):
+            _run(dirty, out, "--prefetch", "0")
+        assert not (tmp_path / "abort.mgf.quarantine.mgf").exists()
+
+
+class TestResumeIntegrity:
+    """Truncate/bit-flip the manifest and the MGF tail between runs: all
+    three methods must repair (or restart loudly) and converge to the
+    fault-free bytes — never silently duplicate or drop spectra."""
+
+    METHODS = [
+        ("bin-mean", "consensus"),
+        ("gap-average", "consensus"),
+        ("medoid", "select"),
+    ]
+
+    def _golden_and_partial(self, tmp_path, rng, method, command):
+        clusters = _workload(rng, n=6)
+        clustered = _write(tmp_path, clusters)
+        golden = tmp_path / "golden.mgf"
+        assert _run(clustered, golden, "--method", method, "--prefetch",
+                    "0", command=command, ck=tmp_path / "g.ck.json") == 0
+        # a partial run over the head -> committed prefix + manifest
+        head = _write(tmp_path, clusters[:3], name="head.mgf")
+        out, ck = tmp_path / "out.mgf", tmp_path / "resume.ck.json"
+        assert _run(head, out, "--method", method, "--prefetch", "0",
+                    command=command, ck=ck) == 0
+        assert golden.read_bytes().startswith(out.read_bytes())
+        return clustered, golden, out, ck
+
+    @pytest.mark.parametrize("method,command", METHODS)
+    def test_torn_tail_truncated_and_resumed(
+        self, tmp_path, rng, method, command
+    ):
+        clustered, golden, out, ck = self._golden_and_partial(
+            tmp_path, rng, method, command
+        )
+        with open(out, "ab") as fh:
+            fh.write(b"BEGIN IONS\nTITLE=torn\n123.4 5")
+        jr = tmp_path / "r.jsonl"
+        assert _run(clustered, out, "--method", method, "--prefetch", "4",
+                    "--pack-workers", "2", "--async-write", "on",
+                    command=command, ck=ck, journal=jr) == 0
+        assert out.read_bytes() == golden.read_bytes()
+        repairs = [
+            (e["action"], e["reason"]) for e in _events(jr)
+            if e["event"] == "resume_repair"
+        ]
+        assert ("truncate_tail", "torn_tail") in repairs
+
+    @pytest.mark.parametrize("method,command", METHODS)
+    def test_bit_flip_in_committed_region_restarts(
+        self, tmp_path, rng, method, command
+    ):
+        clustered, golden, out, ck = self._golden_and_partial(
+            tmp_path, rng, method, command
+        )
+        data = bytearray(out.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        out.write_bytes(bytes(data))
+        jr = tmp_path / "r.jsonl"
+        assert _run(clustered, out, "--method", method, "--prefetch", "2",
+                    command=command, ck=ck, journal=jr) == 0
+        assert out.read_bytes() == golden.read_bytes()
+        repairs = [
+            (e["action"], e["reason"]) for e in _events(jr)
+            if e["event"] == "resume_repair"
+        ]
+        assert ("restart", "sha256_mismatch") in repairs
+
+    @pytest.mark.parametrize("method,command", METHODS)
+    def test_corrupt_manifest_restarts(self, tmp_path, rng, method, command):
+        clustered, golden, out, ck = self._golden_and_partial(
+            tmp_path, rng, method, command
+        )
+        ck.write_bytes(ck.read_bytes()[: ck.stat().st_size // 2])
+        jr = tmp_path / "r.jsonl"
+        assert _run(clustered, out, "--method", method, "--prefetch", "2",
+                    command=command, ck=ck, journal=jr) == 0
+        assert out.read_bytes() == golden.read_bytes()
+        repairs = [
+            (e["action"], e["reason"]) for e in _events(jr)
+            if e["event"] == "resume_repair"
+        ]
+        assert ("restart", "manifest_unreadable") in repairs
+
+    def test_manifest_carries_schema_and_hash(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=4))
+        ck = tmp_path / "ck.json"
+        out = tmp_path / "o.mgf"
+        assert _run(clustered, out, ck=ck) == 0
+        manifest = json.loads(ck.read_text())
+        assert manifest["schema"] == 2
+        import hashlib
+
+        assert manifest["sha256"] == hashlib.sha256(
+            out.read_bytes()[: manifest["output_bytes"]]
+        ).hexdigest()
+
+    def test_legacy_schemaless_manifest_still_resumes(self, tmp_path, rng):
+        clusters = _workload(rng, n=6)
+        clustered = _write(tmp_path, clusters)
+        golden = tmp_path / "golden.mgf"
+        assert _run(clustered, golden, "--prefetch", "0",
+                    ck=tmp_path / "g.ck.json") == 0
+        head = _write(tmp_path, clusters[:3], name="head.mgf")
+        out, ck = tmp_path / "out.mgf", tmp_path / "ck.json"
+        assert _run(head, out, "--prefetch", "0", ck=ck) == 0
+        manifest = json.loads(ck.read_text())
+        # strip the v2 fields: a PR4-era manifest
+        ck.write_text(json.dumps({
+            "done": manifest["done"],
+            "output_bytes": manifest["output_bytes"],
+        }))
+        assert _run(clustered, out, "--prefetch", "2", ck=ck) == 0
+        assert out.read_bytes() == golden.read_bytes()
+        # and the resumed run upgraded the manifest in place
+        assert json.loads(ck.read_text())["schema"] == 2
+
+
+class TestStatsRendering:
+    def test_stats_renders_robustness_summary(self, tmp_path, rng, capsys):
+        from specpride_tpu.observability.stats_cli import run_stats
+
+        clustered = _write(tmp_path, _workload(rng, n=4))
+        jr = tmp_path / "run.jsonl"
+        assert _run(
+            clustered, tmp_path / "o.mgf", "--prefetch", "2",
+            "--retries", "2", "--retry-backoff", "0.01",
+            "--inject-faults", "write:io:1:1",
+            ck=tmp_path / "ck.json", journal=jr,
+        ) == 0
+        agg = tmp_path / "agg.json"
+        assert run_stats([str(jr)], json_out=str(agg)) == 0
+        rendered = capsys.readouterr().out
+        assert "robustness:" in rendered and "recovered" in rendered
+        run = json.loads(agg.read_text())["runs"][0]
+        assert run["robustness"]["fault"] == 1
+        assert run["robustness"]["unrecovered_faults"] == 0
+        assert run["robustness"]["run_end"]["retries"] >= 1
