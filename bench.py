@@ -491,6 +491,100 @@ def bench_worker_sweep(
     return rows
 
 
+_WARM_START_METHODS = (
+    # device layouts pinned so each method compiles real XLA kernels on
+    # any host (the CPU default layouts route bin-mean/gap-average to
+    # host paths that compile nothing — there would be no cold start to
+    # measure)
+    ("bin-mean", "consensus", ("--layout", "flat", "--force-device")),
+    ("gap-average", "consensus",
+     ("--layout", "bucketized", "--force-device")),
+    ("medoid", "select", ("--layout", "bucketized",)),
+)
+
+
+def bench_warm_start(clusters, workdir: str) -> dict:
+    """Cold-start vs warm-start wall time and compile counts per method
+    (ROADMAP item 5a; the tentpole acceptance number for this round).
+
+    Each run is a FRESH subprocess (the in-process jit cache would
+    otherwise hide the cold start) against one shared ``--compile-cache``
+    dir created fresh for this bench: the cold run pays every XLA
+    compile and seeds the shape manifest; the warm rerun AOT-warms from
+    the manifest and must journal ZERO fresh compiles
+    (``run_end.compile_cache.misses == 0``).  Wall time includes process
+    + jax startup — exactly what a CLI user experiences."""
+    import os
+    import subprocess
+    import sys
+
+    src = _sweep_source(clusters, workdir)
+    cache = os.path.join(workdir, "warm_cache")
+    rows = []
+    for method, command, flags in _WARM_START_METHODS:
+        row: dict = {"method": method, "flags": list(flags)}
+        for phase in ("cold", "warm"):
+            tag = f"wsb_{method.replace('-', '_')}_{phase}"
+            journal = os.path.join(workdir, f"{tag}.jsonl")
+            out = os.path.join(workdir, f"{tag}.mgf")
+            argv = [
+                sys.executable, "-m", "specpride_tpu", command, src, out,
+                "--method", method, "--backend", "tpu",
+                "--compile-cache", cache, "--journal", journal,
+                *flags,
+            ]
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                argv, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+            )
+            wall = time.perf_counter() - t0
+            assert proc.returncode == 0, (
+                method, phase, proc.stderr.decode(errors="replace")[-2000:]
+            )
+            with open(journal) as fh:
+                events = [json.loads(line) for line in fh]
+            end = [e for e in events if e["event"] == "run_end"][-1]
+            cc = end.get("compile_cache") or {}
+            warmups = [e for e in events if e["event"] == "warmup"]
+            row[phase] = {
+                "wall_s": round(wall, 3),
+                "run_elapsed_s": end["elapsed_s"],
+                # fresh XLA compiles (persistent-cache misses) vs loads
+                "fresh_compiles": cc.get("misses"),
+                "cache_hits": cc.get("hits"),
+                # traced (kernel, shape-class) combos — the per-process
+                # upper bound the compile-vs-cached tracing layer sees
+                "compile_events": sum(
+                    1 for e in events if e["event"] == "compile"
+                ),
+                "kernels_warmed": len(warmups),
+                "warmup_s": round(
+                    sum(e.get("seconds", 0.0) for e in warmups), 3
+                ),
+            }
+        row["cold_minus_warm_wall_s"] = round(
+            row["cold"]["wall_s"] - row["warm"]["wall_s"], 3
+        )
+        row["warm_speedup_wall"] = round(
+            row["cold"]["wall_s"] / row["warm"]["wall_s"], 3
+        )
+        rows.append(row)
+        eprint(
+            f"[warm_start:{method}] cold {row['cold']['wall_s']}s "
+            f"({row['cold']['fresh_compiles']} fresh compiles) -> warm "
+            f"{row['warm']['wall_s']}s "
+            f"({row['warm']['fresh_compiles']} fresh, "
+            f"{row['warm']['kernels_warmed']} warmed) "
+            f"= {row['warm_speedup_wall']}x wall"
+        )
+        assert row["warm"]["fresh_compiles"] == 0, (
+            f"{method}: warm rerun still compiled "
+            f"{row['warm']['fresh_compiles']} kernels"
+        )
+    return {"cache_dir": "fresh per bench invocation", "methods": rows}
+
+
 def bench_medoid_d2h(clusters) -> dict:
     """Medoid device path D2H bytes: index-only selection
     (``medoid_device_select``, the default) vs the count-matrix fetch it
@@ -592,11 +686,16 @@ def bench_sweep(clusters, backend, nb) -> dict:
     return {"tolerance_grid": grid_rows, "normalization": norm_rows}
 
 
-def pallas_ab(clusters) -> dict | None:
-    """On-chip A/B of the K1 segmented-scan core: XLA shift/select
-    formulation (ops.segments.seg_scan) vs the Pallas single-pass kernel
-    (ops.pallas_kernels.seg_scan_pallas), on this workload's real flat
-    bin-mean arrays.  Returns None off-TPU."""
+def pallas_ab(clusters, report_path: str | None = None) -> dict | None:
+    """On-chip A/B of the segmented-reduction cores on this workload's
+    real flat bin-mean arrays: the XLA shift/select formulation
+    (ops.segments) vs the Pallas kernels — the original 3-channel scan
+    (seg_scan_pallas) AND the fused segment-mean single pass
+    (seg_mean_pallas) the routing table can promote.  When the fused
+    kernel beats the XLA chain by >= 10%, a routing-override file
+    (<report>.routing.json, loadable via --routing-table /
+    SPECPRIDE_ROUTING) is emitted so the promotion is a measured
+    artifact, not an edit.  Returns None off-TPU."""
     import functools
 
     import jax
@@ -607,7 +706,7 @@ def pallas_ab(clusters) -> dict | None:
     from specpride_tpu.ops import pallas_kernels as pk
     from specpride_tpu.ops import segments as sg
 
-    if not pk.available() or pk.pl is None:
+    if not pk.has_pallas() or pk.pl is None:
         return None
     cfg = BinMeanConfig()
     batch = pack_flat_bin_mean(clusters, cfg, max_elements=1 << 24)[0]
@@ -650,12 +749,58 @@ def pallas_ab(clusters) -> dict | None:
         f"[pallas A/B] {n} peaks: XLA seg_scan {t_xla*1e3:.2f}ms, "
         f"Pallas {t_pal*1e3:.2f}ms, max rel diff {rel:.1e}"
     )
-    return {
+
+    # the FUSED segment-mean pass (what the routing table promotes) vs
+    # the full XLA equivalent: run_sums + the separate division
+    import jax.numpy as jnp
+
+    rcap = _pow2(int(batch.run_starts.size + 2))
+
+    @functools.partial(jax.jit, static_argnames=("rcap", "lcap"))
+    def xla_mean(g, w, x, rcap, lcap):
+        starts = sg.run_starts(g)
+        (counts, xs), _ = sg.run_sums(starts, (w, x * w), rcap, lcap)
+        return xs / jnp.maximum(counts, 1.0)
+
+    pal_mean = jax.jit(lambda g, w, x: pk.seg_mean_pallas(g, w, x)[1])
+    t_xla_mean = best(xla_mean, gbin, w, inten, rcap=rcap, lcap=lcap)
+    t_pal_mean = best(pal_mean, gbin, w, inten)
+    seg_mean_speedup = round(t_xla_mean / t_pal_mean, 3)
+    eprint(
+        f"[pallas A/B] fused seg_mean: XLA {t_xla_mean*1e3:.2f}ms, "
+        f"Pallas {t_pal_mean*1e3:.2f}ms -> {seg_mean_speedup}x"
+    )
+
+    out = {
         "n_peaks": n,
         "xla_seg_scan_ms": round(t_xla * 1e3, 3),
         "pallas_seg_scan_ms": round(t_pal * 1e3, 3),
         "max_rel_diff": rel,
+        "xla_seg_mean_ms": round(t_xla_mean * 1e3, 3),
+        "pallas_seg_mean_ms": round(t_pal_mean * 1e3, 3),
+        "seg_mean_speedup": seg_mean_speedup,
     }
+    if report_path and seg_mean_speedup >= 1.1:
+        from specpride_tpu.warmstart.routing import write_overrides
+
+        plat = jax.default_backend()
+        override = report_path + ".routing.json"
+        # promote ONLY what this A/B measured: the flat bin-mean
+        # arrays.  gap-average's (row, seg) composite-key workload
+        # needs its own measurement before a routing promotion — an
+        # override's reason string must never claim a measurement that
+        # did not happen.
+        write_overrides(override, [
+            {
+                "method": "bin-mean", "platform": plat, "path": "pallas",
+                "reason": f"pallas_ab: fused seg_mean "
+                f"{seg_mean_speedup}x over XLA seg_scan on {plat} "
+                "(flat bin-mean arrays)",
+            }
+        ])
+        out["routing_override"] = override
+        eprint(f"[pallas A/B] routing override -> {override}")
+    return out
 
 
 def main() -> None:
@@ -680,7 +825,7 @@ def main() -> None:
         "--sections", default=None, metavar="LIST",
         help="with --report: comma list of report sections to run "
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
-        "prefetch_sweep,worker_sweep,fault_overhead,pallas",
+        "prefetch_sweep,worker_sweep,fault_overhead,warm_start,pallas",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -704,7 +849,7 @@ def main() -> None:
     # never produce a silently empty report)
     all_sections = (
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
-        "worker_sweep,fault_overhead,pallas"
+        "worker_sweep,fault_overhead,warm_start,pallas"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -841,8 +986,12 @@ def main() -> None:
                     report["fault_overhead"] = bench_fault_overhead(
                         clusters, workdir
                     )
+                if "warm_start" in secs:
+                    report["warm_start"] = bench_warm_start(
+                        clusters, workdir
+                    )
             if "pallas" in secs:
-                ab = pallas_ab(clusters)
+                ab = pallas_ab(clusters, report_path=args.report)
                 if ab is not None:
                     report["pallas_ab"] = ab
             with open(args.report, "w") as f:

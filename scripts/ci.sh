@@ -217,6 +217,79 @@ env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     stats "$rb_tmp/chaos_pw4_on.jsonl" | grep -q "robustness:"
 rm -rf "$rb_tmp"
 
+echo "== warm start: compile-cache + AOT warmup + zero fresh compiles =="
+# each method runs twice against ONE fresh --compile-cache dir: the cold
+# run pays (and journals) its XLA compiles and seeds the shape manifest;
+# the warm rerun AOT-warms from the manifest and must journal ZERO fresh
+# compiles (run_end.compile_cache.misses == 0) with byte-identical
+# output.  Device layouts pinned so every method compiles real kernels
+# on CPU-only hosts.
+ws_tmp=$(mktemp -d)
+WS_IN=tests/data/golden_clustered.mgf
+ws_run() { # $1 = method; $2 = phase; $3 = command; rest = extra flags
+    M="$1"; PHASE="$2"; CMD="$3"; shift 3
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        "$CMD" "$WS_IN" "$ws_tmp/${M}_${PHASE}.mgf" \
+        --method "$M" --backend tpu \
+        --compile-cache "$ws_tmp/cache" \
+        --journal "$ws_tmp/${M}_${PHASE}.jsonl" "$@"
+}
+for PHASE in cold warm; do
+    ws_run bin-mean "$PHASE" consensus --layout flat --force-device
+    ws_run gap-average "$PHASE" consensus --layout bucketized --force-device
+    ws_run medoid "$PHASE" select --layout bucketized
+done
+for M in bin-mean gap-average medoid; do
+    # warmed vs unwarmed byte parity per method
+    cmp "$ws_tmp/${M}_cold.mgf" "$ws_tmp/${M}_warm.mgf"
+done
+python - "$ws_tmp" <<'EOF'
+import json, sys, glob, os
+tmp = sys.argv[1]
+for path in sorted(glob.glob(os.path.join(tmp, "*_cold.jsonl"))):
+    events = [json.loads(l) for l in open(path)]
+    cc = [e for e in events if e["event"] == "compile_cache"]
+    assert cc and cc[0]["enabled"], f"{path}: cache not enabled"
+    end = [e for e in events if e["event"] == "run_end"][-1]
+    assert end["compile_cache"]["misses"] > 0, \
+        f"{path}: cold run compiled nothing — the warm check is vacuous"
+for path in sorted(glob.glob(os.path.join(tmp, "*_warm.jsonl"))):
+    events = [json.loads(l) for l in open(path)]
+    end = [e for e in events if e["event"] == "run_end"][-1]
+    assert end["compile_cache"]["misses"] == 0, \
+        f"{path}: warm rerun still compiled {end['compile_cache']}"
+    warm = [e for e in events if e["event"] == "warmup"]
+    assert warm and all(e["cache_hit"] for e in warm), \
+        f"{path}: warmup did not hit the cache: {warm}"
+print("warm start OK: 3 methods, warm reruns journal 0 fresh compiles")
+EOF
+# `specpride stats` renders the warmstart line
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$ws_tmp/bin-mean_warm.jsonl" | grep -q "warmstart:"
+# `specpride warmup` smoke: pre-populate a FRESH cache from the saved
+# manifest, then a first-ever run against it must also journal 0 misses
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    warmup "$ws_tmp/cache/shape_manifest.json" \
+    --compile-cache "$ws_tmp/cache2" --journal "$ws_tmp/wu.jsonl"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$WS_IN" "$ws_tmp/first.mgf" \
+    --method bin-mean --backend tpu --layout flat --force-device \
+    --compile-cache "$ws_tmp/cache2" --warmup off \
+    --journal "$ws_tmp/first.jsonl"
+cmp "$ws_tmp/bin-mean_cold.mgf" "$ws_tmp/first.mgf"
+python - "$ws_tmp" <<'EOF'
+import json, sys, os
+tmp = sys.argv[1]
+wu = [json.loads(l) for l in open(os.path.join(tmp, "wu.jsonl"))]
+assert [e for e in wu if e["event"] == "warmup"], "warmup journal empty"
+events = [json.loads(l) for l in open(os.path.join(tmp, "first.jsonl"))]
+end = [e for e in events if e["event"] == "run_end"][-1]
+assert end["compile_cache"]["misses"] == 0, end["compile_cache"]
+print("specpride warmup OK: first-ever run after standalone warmup "
+      "journals 0 fresh compiles")
+EOF
+rm -rf "$ws_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

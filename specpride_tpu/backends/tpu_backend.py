@@ -49,58 +49,19 @@ from specpride_tpu.observability import tracing
 from specpride_tpu.robustness import faults
 
 
-_cache_configured = False
-
-
 def _ensure_compile_cache() -> None:
     """Point JAX at a persistent compilation cache (once per process).
 
     Kernel shapes are bounded to a few size classes precisely so compiled
     programs can be REUSED — but without a persistent cache every new
     process pays the full XLA compile bill again (15-25 s per method on
-    the 2000-cluster bench).  Honors an explicit JAX_COMPILATION_CACHE_DIR
-    / already-configured cache; override the default location with
-    SPECPRIDE_JAX_CACHE (empty string disables)."""
-    global _cache_configured
-    if _cache_configured:
-        return
-    _cache_configured = True
-    import os
+    the 2000-cluster bench).  Resolution and hit/miss accounting live in
+    ``warmstart.cache`` (the CLI's ``--compile-cache DIR|off`` overrides
+    this default resolution, which honors JAX_COMPILATION_CACHE_DIR /
+    an already-configured jax / SPECPRIDE_JAX_CACHE)."""
+    from specpride_tpu.warmstart import cache
 
-    import jax
-
-    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        return
-    try:
-        if jax.config.jax_compilation_cache_dir:
-            return
-    except AttributeError:
-        pass  # older jax without the attribute: treat as not configured
-    path = os.environ.get("SPECPRIDE_JAX_CACHE")
-    if path == "":
-        return
-    if path is None:
-        # partition by platform: CPU AOT entries compiled inside a
-        # TPU-plugin process carry different machine-feature flags than a
-        # plain CPU process, and loading a mismatched entry risks SIGILL
-        try:
-            plat = jax.config.jax_platforms or os.environ.get(
-                "JAX_PLATFORMS", ""
-            )
-        except AttributeError:
-            plat = os.environ.get("JAX_PLATFORMS", "")
-        path = os.path.join(
-            os.path.expanduser("~"), ".cache", "specpride_tpu",
-            f"jax_cache_{plat or 'default'}",
-        )
-    try:
-        os.makedirs(path, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", path)
-        # cache even fast compiles: the tunnel round-trips during tracing
-        # make every avoided compile worth it
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-    except (OSError, AttributeError):
-        pass  # unwritable home / older jax: run uncached
+    cache.ensure_default_compile_cache()
 
 
 def _cpu_only_devices() -> bool:
@@ -309,6 +270,11 @@ class TpuBackend:
     _seen_shapes: set = dataclasses.field(
         default_factory=set, repr=False
     )
+    # per-(method, platform) execution-path table (host-vectorized /
+    # xla / pallas), seeded from measured static defaults + an optional
+    # bench-derived override file (warmstart.routing).  None = load the
+    # default table (SPECPRIDE_ROUTING env override honored).
+    routing: object = None
     # (method, path) routing decisions already journaled/logged — a
     # chunked run must not spam one event per chunk
     _routing_noted: set = dataclasses.field(
@@ -317,6 +283,10 @@ class TpuBackend:
 
     def __post_init__(self):
         _ensure_compile_cache()
+        if self.routing is None:
+            from specpride_tpu.warmstart.routing import RoutingTable
+
+            self.routing = RoutingTable.load()
 
     # -- telemetry hooks ------------------------------------------------
 
@@ -849,6 +819,7 @@ class TpuBackend:
         keep_runs = np.zeros(rcap, dtype=bool)
         keep_runs[: aux["keep"].size] = aux["keep"]
 
+        impl = self._impl_for("bin-mean")
         t0 = time.perf_counter()
         fused = bin_mean_flat_intensity(
             *self._put_batch([
@@ -859,9 +830,12 @@ class TpuBackend:
             total_cap=cap,
             rcap=rcap,
             lcap=lcap,
+            impl=impl,
         )
         self._note_dispatch(
-            "bin_mean_flat_intensity", (n_pad, cap, rcap, lcap),
+            "bin_mean_flat_intensity" if impl == "scan"
+            else "bin_mean_flat_intensity_pallas",
+            (n_pad, cap, rcap, lcap),
             rows=rows, padded_rows=rows,
             real_elems=n, padded_elems=n_pad,
             seconds=time.perf_counter() - t0, t_start=t0,
@@ -993,22 +967,80 @@ class TpuBackend:
         (``ops.gap_average``), where interconnect bandwidth changes the
         trade-off.
 
-        Device-availability routing: when --mesh/--layout ask for the
-        bucketized device path but jax exposes ONLY CPU devices, there is
-        no accelerator to win on and the kernel measured ~0.3x of the
-        host consensus (BENCH_r07) — so the run is routed to the host
-        path and the decision journaled, unless ``force_device``."""
+        Routing: when --mesh/--layout ask for the bucketized device path,
+        the per-(method, platform) routing table (``warmstart.routing``)
+        decides which core carries it — the vectorized host consensus
+        (the measured winner on CPU-only jax: the device kernel ran at
+        0.29x of it, BENCH_r08), the XLA seg-scan kernel, or the fused
+        Pallas segment-mean kernel — and the decision is journaled,
+        unless ``force_device`` pins the requested device kernels."""
         faults.check("dispatch")
         if self.mesh is None and self.layout != "bucketized":
             return self._run_gap_average_host(clusters, config)
-        if not self.force_device and _cpu_only_devices():
-            self._note_routing(
-                "gap-average", "host-vectorized", "cpu-only-devices"
-            )
-            return self._run_gap_average_host(clusters, config)
+        if not self.force_device:
+            d = self.routing.decide("gap-average", self._platform())
+            if d.path == "host-vectorized":
+                self._note_routing(
+                    "gap-average", d.path, d.reason, d.source
+                )
+                return self._run_gap_average_host(clusters, config)
         return self._run_gap_average_mesh(clusters, config)
 
-    def _note_routing(self, method: str, path: str, reason: str) -> None:
+    def _platform(self) -> str:
+        """Routing-table platform key: "cpu" when every visible device is
+        a CPU, else the default jax backend name (tpu/gpu/...)."""
+        if _cpu_only_devices():
+            return "cpu"
+        import jax
+
+        try:
+            return jax.default_backend()
+        except Exception:  # bring-up failure: route like a cpu host
+            return "cpu"
+
+    def _impl_for(self, method: str, pallas_capable: bool = True) -> str:
+        """Segmented-reduction core for ``method``'s device kernels:
+        "scan" (the XLA Hillis-Steele chain) or "pallas" (the fused
+        ``seg_mean_pallas`` single pass), per the routing table.  The
+        trivial "xla" default stays unjournaled; every decision the
+        backend CANNOT honor at this point — a Pallas promotion where
+        lowering (or a Pallas variant of the kernel) is unavailable, a
+        host-vectorized entry reaching a dispatch site whose
+        host-vs-device choice was already made by layout — is journaled
+        as the xla fallback, so an override never appears accepted
+        while silently changing nothing."""
+        d = self.routing.decide(method, self._platform())
+        if d.path == "host-vectorized":
+            # under --force-device the operator explicitly pinned the
+            # device kernels — the host route is knowingly overridden
+            # and stays event-silent (the documented pin contract).
+            # Otherwise this is an override reaching a dispatch site
+            # whose host-vs-device choice was already made by layout:
+            # journal the fallback so it never looks accepted.
+            if not self.force_device:
+                self._note_routing(
+                    method, "xla", "host-path-not-available-here",
+                    d.source,
+                )
+            return "scan"
+        if d.path != "pallas":
+            return "scan"
+        if not pallas_capable:
+            self._note_routing(
+                method, "xla", "no-pallas-variant-for-kernel", d.source
+            )
+            return "scan"
+        from specpride_tpu.ops import pallas_kernels as pk
+
+        if pk.has_pallas():
+            self._note_routing(method, "pallas", d.reason, d.source)
+            return "pallas"
+        self._note_routing(method, "xla", "pallas-unavailable", d.source)
+        return "scan"
+
+    def _note_routing(
+        self, method: str, path: str, reason: str, source: str = "static"
+    ) -> None:
         """Journal/log a device-routing decision ONCE per backend — the
         operator must be able to see why a requested layout was not
         executed, without one event per chunk."""
@@ -1017,10 +1049,13 @@ class TpuBackend:
             return
         self._routing_noted.add(key)
         logger.info(
-            "routing %s to the %s path (%s; --force-device overrides)",
-            method, path, reason,
+            "routing %s to the %s path (%s, %s; --force-device overrides)",
+            method, path, reason, source,
         )
-        self.journal.emit("routing", method=method, path=path, reason=reason)
+        self.journal.emit(
+            "routing", method=method, path=path, reason=reason,
+            source=source,
+        )
 
     def _run_gap_average_host(
         self, clusters: list[Cluster], config: GapAverageConfig
@@ -1192,6 +1227,11 @@ class TpuBackend:
 
         _check_no_empty(clusters)
         get_pepmass, get_rt = numpy_backend.resolve_gap_estimators(config)
+        impl = self._impl_for("gap-average")
+        kname = (
+            "gap_average_compact" if impl == "scan"
+            else "gap_average_compact_pallas"
+        )
 
         out: list[Spectrum | None] = [None] * len(clusters)
         pending = []
@@ -1220,10 +1260,11 @@ class TpuBackend:
                         ),
                         config=config,
                         total_cap=cap,
+                        impl=impl,
                     )
                     dt = time.perf_counter() - t0  # see bin_mean: span nesting
                 self._note_dispatch(
-                    "gap_average_compact", (size, k, cap),
+                    kname, (size, k, cap),
                     rows=hi - lo, padded_rows=size,
                     real_elems=lambda lo=lo, hi=hi: batch.n_valid[lo:hi].sum(),
                     padded_elems=size * k,
@@ -1276,6 +1317,10 @@ class TpuBackend:
                 # pipelined prepare path) — no second scan here
                 return self._medoid_indices_native(clusters, config)
         _check_no_empty(clusters)  # device path validates here
+        # consult (and audit) the routing table: medoid has no Pallas
+        # variant, so a pallas/host override journals its xla fallback
+        # instead of being silently swallowed
+        self._impl_for("medoid", pallas_capable=False)
         out: list[int] = [0] * len(clusters)
         pending = []
         st = self.stats
@@ -2085,7 +2130,15 @@ class TpuBackend:
                 )
                 dt = time.perf_counter() - t0  # see bin_mean: span nesting
             self._note_dispatch(
-                "cosine_flat", (n_pad, nr_pad, rows_cap, s_pad),
+                # shape class keyed by EVERY static jit arg (the scan
+                # windows and key shift define distinct compiles too), so
+                # the shape manifest can rebuild the exact compilation
+                "cosine_flat",
+                (
+                    n_pad, nr_pad, rows_cap, s_pad, shift,
+                    prep["l_rep"], prep["l_row"], prep["l_spec"],
+                    prep["l_mem"], prep["l_members"],
+                ),
                 rows=rows, padded_rows=rows_cap,
                 real_elems=n, padded_elems=n_pad,
                 seconds=dt, t_start=t0,

@@ -89,6 +89,22 @@ def _add_backend(p: argparse.ArgumentParser) -> None:
         "to the vectorized host consensus there — the CPU 'device' path "
         "measured ~0.3x of it — and journals the routing decision)",
     )
+    p.add_argument(
+        "--compile-cache", metavar="DIR|off", default=None,
+        help="persistent XLA compilation cache directory ('off' "
+        "disables; default: SPECPRIDE_JAX_CACHE / JAX_COMPILATION_"
+        "CACHE_DIR / a per-platform dir under ~/.cache).  An explicit "
+        "DIR also caches fast compiles so a warmed rerun performs ZERO "
+        "fresh XLA compiles; the resolution is journaled as a "
+        "compile_cache event",
+    )
+    p.add_argument(
+        "--routing-table", metavar="FILE",
+        help="bench-derived kernel-routing override file (per-(method, "
+        "platform) host-vectorized/xla/pallas decisions; default: "
+        "measured static defaults, or the SPECPRIDE_ROUTING env var — "
+        "see docs/performance.md)",
+    )
 
 
 def _add_execution(p: argparse.ArgumentParser) -> None:
@@ -173,6 +189,21 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
         help="seed for --inject-faults firing decisions and retry "
         "jitter: same plan + seed fires at the same visits every run",
     )
+    p.add_argument(
+        "--warmup", choices=["auto", "manifest", "off"], default="auto",
+        help="AOT bucket-shape warmup before the pack lane starts: "
+        "'auto' (default) warms from — and afterwards updates — the "
+        "shape manifest beside the compile cache when one exists; "
+        "'manifest' requires a manifest (--warmup-manifest or the "
+        "cache-dir default) and fails loudly without one; 'off' "
+        "disables.  Per-kernel compile-vs-cache-hit is journaled as "
+        "warmup events (see `specpride warmup`)",
+    )
+    p.add_argument(
+        "--warmup-manifest", metavar="FILE",
+        help="shape manifest path (default: <compile-cache dir>/"
+        "shape_manifest.json)",
+    )
 
 
 def _add_observability(p: argparse.ArgumentParser) -> None:
@@ -210,6 +241,13 @@ def _get_backend(args):
 
         return numpy_backend
     from specpride_tpu.backends.tpu_backend import TpuBackend
+    from specpride_tpu.warmstart import configure_compile_cache
+    from specpride_tpu.warmstart.routing import RoutingTable
+
+    # cache control resolves BEFORE the backend exists so the explicit
+    # --compile-cache flag beats the constructor's default resolution
+    configure_compile_cache(getattr(args, "compile_cache", None))
+    routing = RoutingTable.load(getattr(args, "routing_table", None))
 
     mesh = None
     if getattr(args, "coordinator", None) or getattr(args, "mesh", False):
@@ -257,6 +295,7 @@ def _get_backend(args):
     return TpuBackend(
         mesh=mesh, layout=getattr(args, "layout", "auto"),
         force_device=getattr(args, "force_device", False),
+        routing=routing,
     )
 
 
@@ -1740,6 +1779,122 @@ def _clusters_from_mzml(path: str, args, stats: RunStats) -> list[Cluster]:
     return group_into_clusters(out)
 
 
+def _warmup_manifest_path(args) -> str | None:
+    """The shape-manifest path this run reads/writes: the explicit
+    ``--warmup-manifest``, else the default beside the compile cache
+    (a manifest indexes what the cache next to it holds)."""
+    explicit = getattr(args, "warmup_manifest", None)
+    if explicit:
+        return explicit
+    from specpride_tpu.warmstart import cache as ws_cache
+    from specpride_tpu.warmstart.manifest import DEFAULT_BASENAME
+
+    state = ws_cache.cache_state()
+    if state.enabled and state.dir:
+        return os.path.join(state.dir, DEFAULT_BASENAME)
+    return None
+
+
+# kernel-name prefixes each method can dispatch: a per-run auto warmup
+# warms only what THIS run can use (the cosine kernels serve every
+# method's --qc-report); `specpride warmup` still warms a whole
+# manifest — that is the serve-everything daemon/boot path
+_METHOD_KERNEL_PREFIXES = {
+    "bin-mean": ("bin_mean", "cosine_"),
+    "gap-average": ("gap_average", "cosine_"),
+    "medoid": ("medoid_", "shared_bins", "cosine_"),
+    "best": ("cosine_",),
+}
+
+# per-run auto-warmup ceiling: shape classes are bounded by design
+# (pow2/half-octave size classes), but a long-lived shared manifest
+# unions every workload ever run — cap the per-run pass and log the
+# rest rather than let startup cost grow without bound
+_WARMUP_MAX_ENTRIES = 64
+
+
+def _run_warmup(args, backend, journal) -> None:
+    """``--warmup``: AOT-compile every manifest shape class concurrently
+    BEFORE the pack lane starts, so the chunk loop never stalls on an
+    XLA compile (each variant either compiles once into the persistent
+    cache or loads from it; per-kernel outcome journaled as warmup
+    events)."""
+    mode = getattr(args, "warmup", "auto")
+    if mode == "off" or not hasattr(backend, "_seen_shapes"):
+        return  # disabled, or the numpy oracle (nothing to compile)
+    path = _warmup_manifest_path(args)
+    exists = path is not None and os.path.exists(path)
+    if mode == "manifest" and not exists:
+        raise SystemExit(
+            "--warmup manifest: no shape manifest at "
+            f"{path or '<no --warmup-manifest and no compile cache>'} "
+            "(run the workload once with --warmup auto, or point "
+            "--warmup-manifest at a saved one)"
+        )
+    if not exists:
+        return  # auto: nothing recorded yet — this run will seed it
+    from specpride_tpu.warmstart.manifest import load_manifest
+    from specpride_tpu.warmstart.warmup import warm_entries
+
+    try:
+        entries = load_manifest(path)
+    except (OSError, ValueError) as e:
+        if mode == "manifest":
+            raise SystemExit(f"unreadable shape manifest {path}: {e}")
+        logger.warning("ignoring shape manifest %s (%s)", path, e)
+        return
+    prefixes = _METHOD_KERNEL_PREFIXES.get(args.method)
+    if prefixes is not None:
+        kept = [e for e in entries if e.kernel.startswith(prefixes)]
+        if len(kept) < len(entries):
+            logger.info(
+                "warmup: %d of %d manifest entries apply to --method %s",
+                len(kept), len(entries), args.method,
+            )
+        entries = kept
+    if len(entries) > _WARMUP_MAX_ENTRIES:
+        # manifests only grow (merge_manifest unions); a shared default
+        # cache accumulating many workloads' shape classes must not turn
+        # every run's startup into an unbounded compile pass.  Never a
+        # silent cap: the skip is logged, and `specpride warmup` (no
+        # cap) remains the warm-everything path.
+        logger.warning(
+            "warmup: manifest has %d entries for this method; warming "
+            "the first %d (run `specpride warmup %s` to warm them all)",
+            len(entries), _WARMUP_MAX_ENTRIES, path,
+        )
+        entries = entries[:_WARMUP_MAX_ENTRIES]
+    warm_entries(entries, journal=journal)
+
+
+def _save_shape_manifest(args, backend) -> None:
+    """Persist the (kernel, shape-class) set this run dispatched into
+    the shape manifest, so the NEXT process can warm up before its first
+    chunk.  No-op with ``--warmup off`` or without a manifest home."""
+    if getattr(args, "warmup", "auto") == "off":
+        return
+    seen = getattr(backend, "_seen_shapes", None)
+    if not seen:
+        return  # numpy backend, or a run that never dispatched
+    path = _warmup_manifest_path(args)
+    if path is None:
+        return
+    from specpride_tpu.warmstart.manifest import (
+        entries_from_seen,
+        merge_manifest,
+    )
+
+    entries = entries_from_seen(seen, _method_config(args.method, args))
+    if not entries:
+        return
+    try:
+        n = merge_manifest(path, entries)
+    except (OSError, ValueError) as e:
+        logger.warning("could not update shape manifest %s (%s)", path, e)
+        return
+    logger.info("shape manifest: %d shape class(es) -> %s", n, path)
+
+
 _TRACER_UNSET = object()
 
 
@@ -1783,6 +1938,19 @@ def _open_run_journal(args, backend, command: str, n_clusters: int):
         backend=getattr(args, "backend", "numpy"),
         n_clusters=int(n_clusters), output=args.output,
     )
+    if hasattr(backend, "journal"):
+        # device runs: record how the persistent compilation cache
+        # resolved (dir, or why it stayed off) and snapshot the
+        # hit/miss counters so run_end can report this run's delta —
+        # post-mortems must be able to tell cached from cold runs
+        from specpride_tpu.warmstart import cache as ws_cache
+
+        state = ws_cache.cache_state()
+        journal.emit(
+            "compile_cache", enabled=state.enabled, dir=state.dir,
+            reason=state.reason, source=state.source,
+        )
+        args._cc_snapshot = ws_cache.counters_snapshot()
     chrome = getattr(args, "chrome_trace", None)
     if journal.enabled or chrome:
         # spans ride the SAME journal stream as the v1 events; kept in
@@ -1808,6 +1976,13 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
     backends share), write the Chrome trace and the Prometheus textfile
     if requested, and uninstall the run's tracer."""
     device = device_summary(getattr(backend, "metrics", None))
+    cc_snapshot = args.__dict__.pop("_cc_snapshot", None)
+    if cc_snapshot is not None:
+        from specpride_tpu.warmstart import cache as ws_cache
+
+        compile_cache = ws_cache.counters_delta(cc_snapshot)
+    else:
+        compile_cache = None
     journal.emit(
         "run_end",
         counters=dict(stats.counters),
@@ -1826,6 +2001,11 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         **({"robustness": stats.robustness} if getattr(
             stats, "robustness", None
         ) else {}),
+        # persistent-compile-cache accounting for THIS run: fresh XLA
+        # compiles (misses) vs cache loads (hits) and seconds saved —
+        # a warmed rerun reports misses == 0 (absent on oracle runs)
+        **({"compile_cache": compile_cache} if compile_cache is not None
+           else {}),
     )
     tracer = tracing.current()
     _restore_tracer(args)  # only uninstalls what this run installed
@@ -1877,6 +2057,7 @@ def cmd_consensus(args) -> int:
         journal = _open_run_journal(args, backend, "consensus", len(clusters))
         if quarantine is not None:
             quarantine.bind(journal)  # flush blocks found during parse
+        _run_warmup(args, backend, journal)
         qc = [] if getattr(args, "qc_report", None) else None
         with device_trace(getattr(args, "trace_dir", None)):
             resumed, failed, qc_failed = _checkpointed_run(
@@ -1886,6 +2067,7 @@ def cmd_consensus(args) -> int:
         if qc is not None:
             _write_qc_report(args, backend, clusters, qc, stats, resumed,
                              failed, qc_failed)
+        _save_shape_manifest(args, backend)
         logger.info(
             "consensus done: %.1f clusters/sec", stats.throughput("clusters")
         )
@@ -1920,6 +2102,7 @@ def cmd_select(args) -> int:
         journal = _open_run_journal(args, backend, "select", len(clusters))
         if quarantine is not None:
             quarantine.bind(journal)  # flush blocks found during parse
+        _run_warmup(args, backend, journal)
         qc = [] if getattr(args, "qc_report", None) else None
         with device_trace(getattr(args, "trace_dir", None)):
             resumed, failed, qc_failed = _checkpointed_run(
@@ -1929,12 +2112,77 @@ def cmd_select(args) -> int:
         if qc is not None:
             _write_qc_report(args, backend, clusters, qc, stats, resumed,
                              failed, qc_failed)
+        _save_shape_manifest(args, backend)
         _finish_run(args, backend, stats, journal)
     finally:
         if quarantine is not None:
             quarantine.close()
         _restore_tracer(args)  # no-op after a clean _finish_run
     print(json.dumps(stats.summary()), file=sys.stderr)
+    return 0
+
+
+def cmd_warmup(args) -> int:
+    """``specpride warmup MANIFEST``: AOT-compile every kernel variant a
+    shape manifest records, concurrently, populating the persistent
+    compilation cache — so the NEXT run (or the first request a serving
+    daemon takes) performs zero fresh XLA compiles.  Per-kernel
+    compile-vs-cache-hit and seconds are journaled as warmup events."""
+    import time as _time
+
+    from specpride_tpu.observability import device_summary
+    from specpride_tpu.warmstart import cache as ws_cache
+    from specpride_tpu.warmstart.manifest import load_manifest
+    from specpride_tpu.warmstart.warmup import warm_entries
+
+    ws_cache.configure_compile_cache(getattr(args, "compile_cache", None))
+    try:
+        entries = load_manifest(args.manifest)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"unreadable shape manifest {args.manifest}: {e}")
+    journal = open_journal(getattr(args, "journal", None))
+    state = ws_cache.cache_state()
+    journal.emit(
+        "run_start", command="warmup", method="warmup", backend="tpu",
+        n_clusters=0, manifest=args.manifest,
+    )
+    journal.emit(
+        "compile_cache", enabled=state.enabled, dir=state.dir,
+        reason=state.reason, source=state.source,
+    )
+    snapshot = ws_cache.counters_snapshot()
+    t0 = _time.perf_counter()
+    results = warm_entries(entries, journal=journal, jobs=args.jobs)
+    elapsed = _time.perf_counter() - t0
+    n_hits = sum(r.cache_hit for r in results)
+    n_compiled = sum(r.status == "compiled" for r in results)
+    for r in results:
+        if r.status == "error":
+            logger.warning(
+                "warmup %s %s failed: %s", r.entry.kernel,
+                list(r.entry.shape_key), r.detail,
+            )
+    journal.emit(
+        "run_end",
+        counters={
+            "kernels_warmed": len(results),
+            "warmup_cache_hits": n_hits,
+            "warmup_compiled": n_compiled,
+        },
+        phases_s={"warmup": round(elapsed, 4)},
+        elapsed_s=round(elapsed, 4),
+        device=device_summary(None),
+        compile_cache=ws_cache.counters_delta(snapshot),
+    )
+    journal.close()
+    print(json.dumps({
+        "kernels": len(results),
+        "compiled": n_compiled,
+        "cache_hits": n_hits,
+        "skipped_or_failed": len(results) - n_hits - n_compiled,
+        "seconds": round(elapsed, 3),
+        "cache_dir": state.dir,
+    }))
     return 0
 
 
@@ -2240,6 +2488,33 @@ def build_parser() -> argparse.ArgumentParser:
     pm.add_argument("--remove-parts", action="store_true",
                     help="delete the part files after a successful merge")
     pm.set_defaults(fn=cmd_merge_parts)
+
+    pwu = sub.add_parser(
+        "warmup",
+        help="AOT-compile every kernel variant in a shape manifest into "
+        "the persistent compilation cache (zero fresh compiles on the "
+        "next run)",
+    )
+    pwu.add_argument(
+        "manifest",
+        help="shape manifest JSON — written next to the compile cache by "
+        "consensus/select runs (see docs/performance.md, 'Warm start')",
+    )
+    pwu.add_argument(
+        "--compile-cache", metavar="DIR|off", default=None,
+        help="cache directory to populate (default: same resolution as "
+        "consensus/select)",
+    )
+    pwu.add_argument(
+        "--jobs", type=int, default=0, metavar="N",
+        help="concurrent AOT compiles (default: min(8, cores))",
+    )
+    pwu.add_argument(
+        "--journal", metavar="FILE",
+        help="append warmup events (per-kernel compile-vs-cache-hit, "
+        "seconds) to this JSONL journal",
+    )
+    pwu.set_defaults(fn=cmd_warmup)
 
     pst = sub.add_parser(
         "stats",

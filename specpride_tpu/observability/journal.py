@@ -67,6 +67,13 @@ EVENT_FIELDS: dict[str, frozenset] = {
     "quarantine": frozenset({"path", "reason"}),
     # a lane section exceeded --watchdog-timeout
     "watchdog_stall": frozenset({"lane", "elapsed_s"}),
+    # warm-start subsystem (specpride_tpu.warmstart): how the persistent
+    # compilation cache resolved for this run (dir, or the reason it
+    # stayed off) — post-mortems must be able to tell cached from cold
+    "compile_cache": frozenset({"enabled"}),
+    # one AOT bucket-shape warmup compile: persistent-cache hit vs a
+    # fresh XLA compile, and how long it took
+    "warmup": frozenset({"kernel", "cache_hit", "seconds"}),
     "bench_run": frozenset({"method", "phases_s"}),
     "run_end": frozenset({"counters", "phases_s", "elapsed_s", "device"}),
     # v2: one finished tracing span (observability.tracing).  The span's

@@ -79,6 +79,35 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
         if end and end.get("robustness"):
             rb["run_end"] = end["robustness"]
         run["robustness"] = rb
+    # warm-start subsystem: AOT warmup outcomes + persistent-compile-
+    # cache accounting (absent on runs that predate the subsystem or
+    # never touched a device backend)
+    warmups = [e for e in events if e["event"] == "warmup"]
+    cache_ev = next(
+        (e for e in events if e["event"] == "compile_cache"), None
+    )
+    cc = (end or {}).get("compile_cache")
+    if warmups or cache_ev or cc:
+        ws: dict = {}
+        if warmups:
+            ws["kernels_warmed"] = len(warmups)
+            ws["warmup_cache_hits"] = sum(
+                1 for e in warmups if e.get("cache_hit")
+            )
+            ws["warmup_s"] = round(
+                sum(e.get("seconds", 0.0) for e in warmups), 4
+            )
+        if cache_ev:
+            ws["cache_dir"] = (
+                cache_ev.get("dir") if cache_ev.get("enabled")
+                else f"off ({cache_ev.get('reason')})"
+            )
+        if cc:
+            # fresh XLA compiles this run vs persistent-cache loads
+            ws["fresh_compiles"] = cc.get("misses", 0)
+            ws["cache_hits"] = cc.get("hits", 0)
+            ws["compile_s_saved"] = cc.get("saved_s", 0.0)
+        run["warmstart"] = ws
     if start:
         run.update(
             command=start.get("command"),
@@ -192,6 +221,24 @@ def _render_run(run: dict, out) -> None:
                 f"reorder_stall_s={run.get('reorder_stall_s', 0.0):.3f}",
                 file=out,
             )
+    ws = run.get("warmstart")
+    if ws:
+        bits = []
+        if "kernels_warmed" in ws:
+            bits.append(
+                f"kernels_warmed={ws['kernels_warmed']} "
+                f"warmup_cache_hits={ws['warmup_cache_hits']} "
+                f"warmup_s={ws['warmup_s']}"
+            )
+        if "fresh_compiles" in ws:
+            bits.append(
+                f"fresh_compiles={ws['fresh_compiles']} "
+                f"cache_hits={ws['cache_hits']} "
+                f"compile_s_saved={ws['compile_s_saved']}"
+            )
+        if "cache_dir" in ws:
+            bits.append(f"cache={ws['cache_dir']}")
+        print(f"  warmstart: {' '.join(bits)}", file=out)
     rb = run.get("robustness")
     if rb:
         bits = " ".join(

@@ -69,7 +69,7 @@ def _bin_mean_deduped_stats(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("total_cap", "rcap", "lcap")
+    jax.jit, static_argnames=("total_cap", "rcap", "lcap", "impl")
 )
 def bin_mean_flat_intensity(
     intensity: jax.Array,  # (N,) f32, sorted by (row, bin); tail padding
@@ -79,6 +79,7 @@ def bin_mean_flat_intensity(
     total_cap: int,
     rcap: int,  # pow2 >= run count incl. any sentinel tail run
     lcap: int,  # pow2 >= longest real run (<= max n_members after dedup)
+    impl: str = "scan",  # "scan" | "pallas" | "pallas_interpret"
 ):
     """Intensity-only flat binned-mean: per-run intensity means compacted
     by a HOST-shipped keep mask, one (total_cap,) f32 output.
@@ -92,17 +93,38 @@ def bin_mean_flat_intensity(
     one heavy reduction (per-run intensity sums over millions of peaks)
     and ships back only the kept means; m/z never crosses the link at
     all.  Shipping the keep mask (one bool per run) guarantees host and
-    device agree on the compaction layout by construction."""
+    device agree on the compaction layout by construction.
+
+    ``impl`` selects the segmented-reduction core — the log2(lcap)-step
+    XLA shift/select chain, or the fused single-pass Pallas segment-mean
+    kernel (``pallas_kernels.seg_mean_pallas``); the routing table in
+    the tpu backend picks per platform (Pallas is an implementation
+    detail of that backend, never a user-facing mode)."""
     from specpride_tpu.ops import segments as sg
 
     sent = jnp.int32(2**31 - 1)
     valid = gbin != sent
     w = jnp.where(valid, 1.0, 0.0)
     starts = sg.run_starts(gbin)
-    (counts, inten_sum), _ = sg.run_sums(
-        starts, (w, intensity * w), rcap, lcap
-    )
-    inten_mean = inten_sum / jnp.maximum(counts, 1.0)
+    if impl == "scan":
+        (counts, inten_sum), _ = sg.run_sums(
+            starts, (w, intensity * w), rcap, lcap
+        )
+        inten_mean = inten_sum / jnp.maximum(counts, 1.0)
+    else:
+        from specpride_tpu.ops import pallas_kernels as pk
+
+        n = gbin.shape[0]
+        pad = pk.pad_to_block(n) - n
+        # fused pass: run detection + sums + mean in one VMEM transit;
+        # padding extends the sentinel tail run with zero weight
+        mean_elem = pk.seg_mean_pallas(
+            jnp.pad(gbin, (0, pad), constant_values=sent),
+            jnp.pad(w, (0, pad)),
+            jnp.pad(intensity, (0, pad)),
+            interpret=(impl == "pallas_interpret"),
+        )[1]
+        inten_mean = mean_elem[sg.run_end_positions(starts, rcap)]
     (idx,) = jnp.nonzero(keep_runs, size=total_cap, fill_value=rcap)
     ok = idx < rcap
     return jnp.where(

@@ -58,13 +58,20 @@ def _gap_average_segment_stats(
     quorum: jax.Array,  # (B,) i32 — host-f64 ceil(min_fraction * n_members)
     n_members: jax.Array,  # (B,) i32
     config: GapAverageConfig,
+    impl: str = "scan",  # "scan" | "pallas" | "pallas_interpret"
 ):
     """Per-cluster per-group stats (mz mean, intensity, keep mask) at
     GROUP-END positions — the (B, K) core of ``gap_average_compact``.
 
     Row-local segmented scans (``ops.segments.seg_scan``) replace the
     vmapped ``segment_sum`` — TPU scatter-adds with duplicate indices
-    serialize — and stay shard-local under a cluster-axis mesh."""
+    serialize — and stay shard-local under a cluster-axis mesh.
+
+    ``impl="pallas"`` swaps the log2-step scan chain for the fused
+    single-pass Pallas segment-mean kernel over the row-major flattened
+    batch (rows become disjoint key ranges via a (row, seg) composite,
+    so the 1-D kernel respects row boundaries by construction); the
+    routing table in the tpu backend picks per platform."""
     from specpride_tpu.ops import segments as sg
 
     b, k = mz.shape
@@ -76,14 +83,37 @@ def _gap_average_segment_stats(
     # row's FIRST group; remap the tail to its own out-of-range run id
     key = jnp.where(valid, seg, jnp.int32(k + 1))
     starts = sg.run_starts2d(key)
-    sizes, mz_sums, int_sums = sg.seg_scan(
-        starts, (w, mz * w, intensity * w), k
-    )
-    is_end = sg.run_ends2d(starts)
-
     nm = n_members.astype(jnp.float32)[:, None]
-    group_mz = mz_sums / jnp.maximum(sizes, 1.0)
-    group_int = int_sums / jnp.maximum(nm, 1.0)
+    if impl == "scan":
+        sizes, mz_sums, int_sums = sg.seg_scan(
+            starts, (w, mz * w, intensity * w), k
+        )
+        group_mz = mz_sums / jnp.maximum(sizes, 1.0)
+        group_int = int_sums / jnp.maximum(nm, 1.0)
+    else:
+        from specpride_tpu.ops import pallas_kernels as pk
+
+        row = jax.lax.broadcasted_iota(jnp.int32, (b, k), 0)
+        ck = (row * jnp.int32(k + 2) + key).reshape(b * k)
+        n = b * k
+        pad = pk.pad_to_block(n) - n
+        cnt, mean_mz, mean_int = pk.seg_mean_pallas(
+            # -1 never collides with a real composite (all >= 0), so the
+            # pad tail is its own zero-weight run
+            jnp.pad(ck, (0, pad), constant_values=-1),
+            jnp.pad(w.reshape(n), (0, pad)),
+            jnp.pad(mz.reshape(n), (0, pad)),
+            jnp.pad(intensity.reshape(n), (0, pad)),
+            interpret=(impl == "pallas_interpret"),
+        )
+        sizes = cnt[:n].reshape(b, k)
+        group_mz = mean_mz[:n].reshape(b, k)
+        # the kernel fuses the by-count mean; gap intensity divides by
+        # n_members instead (ref :76-77), so scale back through sizes
+        group_int = mean_int[:n].reshape(b, k) * sizes / jnp.maximum(
+            nm, 1.0
+        )
+    is_end = sg.run_ends2d(starts)
 
     keep = (
         is_end
@@ -99,7 +129,9 @@ def _gap_average_segment_stats(
     return group_mz, group_int, keep
 
 
-@functools.partial(jax.jit, static_argnames=("config", "total_cap"))
+@functools.partial(
+    jax.jit, static_argnames=("config", "total_cap", "impl")
+)
 def gap_average_compact(
     mz: jax.Array,  # (B, K) f32
     intensity: jax.Array,  # (B, K) f32
@@ -109,6 +141,7 @@ def gap_average_compact(
     n_members: jax.Array,  # (B,) i32
     config: GapAverageConfig,
     total_cap: int,
+    impl: str = "scan",  # segmented-reduction core, see the stats fn
 ):
     """Globally-compacted gap-average: one fused 1-D output
     ``[flat_mz (total_cap) | flat_intensity (total_cap) | n_out (B)]``.
@@ -121,7 +154,7 @@ def gap_average_compact(
     (input order for singletons, matching ref :88-90)."""
     b, k = mz.shape
     group_mz, group_int, keep = _gap_average_segment_stats(
-        mz, intensity, seg, n_valid, quorum, n_members, config
+        mz, intensity, seg, n_valid, quorum, n_members, config, impl
     )
 
     n_out = jnp.sum(keep, axis=1).astype(jnp.float32)

@@ -1,22 +1,31 @@
 """Pallas TPU kernels (the hand-scheduled alternatives to the XLA
 formulations in ``ops.segments``).
 
-One kernel lives here: ``seg_scan_pallas``, a single-pass segmented
-inclusive scan over sorted run keys — the core primitive of the flat
-bin-mean consensus (K1).  The XLA formulation (``segments.seg_scan``)
-needs log2(lcap) full-array shift/select passes and a packer-guaranteed
-bound on run length; the Pallas version streams blocks through VMEM once,
-carrying the open run's partial sums across the sequential grid in SMEM —
-exact for ANY run length, one HBM read + one write per element.
+Two kernels live here, sharing one block-scan core:
 
-Measured A/B on the 2000-cluster bench workload (v5e, 4M peaks, 3 value
-channels) lives in ``BENCH_METHODS.json`` under ``pallas_ab``; the driver
-(``backends.tpu_backend``) keeps the XLA path as the default because the
-end-to-end flat bin-mean is device->host-transfer-bound, not scan-bound —
-the A/B exists to keep the claim honest either way (VERDICT r3 ask #4).
+* ``seg_scan_pallas`` — a single-pass segmented inclusive scan over
+  sorted run keys (3 fixed value channels), the original A/B subject
+  against ``segments.seg_scan``.
+* ``seg_mean_pallas`` — the FUSED segment-mean kernel: run detection,
+  the valid-mask weighting, segmented sums and the per-run mean all in
+  one VMEM-resident pass (1 or 2 value channels + a count channel).
+  This is the Pallas alternative the routing table
+  (``warmstart.routing``) can select for the flat bin-mean intensity
+  kernel and the bucketized gap-average kernel — the XLA formulation
+  needs log2(lcap) full-array shift/select passes and a
+  packer-guaranteed bound on run length; the Pallas version streams
+  blocks through VMEM once, carrying the open run's partial sums across
+  the sequential grid in SMEM — exact for ANY run length, one HBM read
+  + one write per element, and the division to means happens in the
+  same pass so no separate mean kernel ever materialises.
 
-Import is lazy and soft: ``available()`` is False off-TPU (tests run the
-kernel in interpreter mode explicitly).
+Measured A/B on the bench workload lives in the ``pallas_ab`` section
+of the BENCH reports; promotion to the default path happens through a
+bench-derived routing override, never by edit
+(``docs/performance.md#warm-start``).
+
+Import is lazy and soft: ``has_pallas()`` is False off-TPU (tests run
+the kernels in interpreter mode explicitly).
 """
 
 from __future__ import annotations
@@ -32,23 +41,19 @@ BLK_LANES = 2048  # lane dim (TPU: multiple of 128)
 BLK = BLK_ROWS * BLK_LANES  # elements per grid step
 
 
-def _seg_scan_block_kernel(
-    key_ref, w_ref, x_ref, y_ref,  # inputs (BLK_ROWS, BLK_LANES)
-    ow_ref, ox_ref, oy_ref,  # outputs (BLK_ROWS, BLK_LANES)
-    carry_key, carry_sums,  # SMEM scratch: (1,) i32, (3,) f32
-):
-    """One grid step: within-block segmented scan + cross-block carry.
+def _block_scan_chain(i, key, vs, carry_key, carry_sums):
+    """One grid step's segmented inclusive scan over ``len(vs)`` channels:
+    within-block scan + cross-block carry.
 
     The (BLK_ROWS, BLK_LANES) tile is one contiguous row-major span of
     the flat axis.  Mosaic has no 1-D reshape/cumsum lowerings, so the
     scan is lane-axis Hillis-Steele per row followed by a statically
     unrolled row chain (8 rows), and open-run prefixes are detected by
     key equality (keys are sorted: a row's leading run is exactly
-    ``key == key[row, 0]``)."""
-    i = pl.program_id(0)
-
-    key = key_ref[:]
-    vs = [w_ref[:], x_ref[:], y_ref[:]]
+    ``key == key[row, 0]``).  Returns the chained full-tile prefix per
+    channel and updates the SMEM carries (``carry_key`` (1,) i32,
+    ``carry_sums`` (len(vs),) f32) for the next grid step."""
+    nv = len(vs)
 
     # per-row lane scan: starts at lane 0 and at key changes.  Shifts use
     # pltpu.roll + iota masks with INT32 flags — Mosaic has no lowering
@@ -81,23 +86,63 @@ def _seg_scan_block_kernel(
         & (krows[0][0, 0] == carry_key[0])
         & (i > 0)
     )
-    carries = [carry_sums[0], carry_sums[1], carry_sums[2]]
-    for c in range(3):
-        rows[c][0] = rows[c][0] + jnp.where(cont0, carries[c], 0.0)
+    for c in range(nv):
+        rows[c][0] = rows[c][0] + jnp.where(cont0, carry_sums[c], 0.0)
     for r in range(1, BLK_ROWS):
         ck = krows[r - 1][0, BLK_LANES - 1]
         cont = (krows[r] == krows[r][0, 0]) & (krows[r][0, 0] == ck)
-        for c in range(3):
+        for c in range(nv):
             rows[c][r] = rows[c][r] + jnp.where(
                 cont, rows[c][r - 1][0, BLK_LANES - 1], 0.0
             )
 
-    for ref, c in ((ow_ref, 0), (ox_ref, 1), (oy_ref, 2)):
-        ref[:] = jnp.concatenate(rows[c], axis=0)
-
+    out = [jnp.concatenate(rows[c], axis=0) for c in range(nv)]
     carry_key[0] = key[BLK_ROWS - 1, BLK_LANES - 1]
-    for c in range(3):
+    for c in range(nv):
         carry_sums[c] = rows[c][BLK_ROWS - 1][0, BLK_LANES - 1]
+    return out
+
+
+def _seg_scan_block_kernel(
+    key_ref, w_ref, x_ref, y_ref,  # inputs (BLK_ROWS, BLK_LANES)
+    ow_ref, ox_ref, oy_ref,  # outputs (BLK_ROWS, BLK_LANES)
+    carry_key, carry_sums,  # SMEM scratch: (1,) i32, (3,) f32
+):
+    """Plain 3-channel segmented inclusive scan (``seg_scan_pallas``)."""
+    i = pl.program_id(0)
+    outs = _block_scan_chain(
+        i, key_ref[:], [w_ref[:], x_ref[:], y_ref[:]],
+        carry_key, carry_sums,
+    )
+    for ref, o in zip((ow_ref, ox_ref, oy_ref), outs):
+        ref[:] = o
+
+
+@functools.lru_cache(maxsize=None)
+def _seg_mean_block_kernel(nv: int):
+    """Fused segment-mean kernel body for ``nv`` value channels: the
+    same block scan over (w, v_0 * w, ..) plus the in-pass division to
+    means.  ``w`` is the 0/1 valid mask — invalid (padding/sentinel)
+    elements contribute nothing and read back count 0 / mean 0."""
+
+    def kernel(*refs):
+        key_ref, w_ref = refs[0], refs[1]
+        val_refs = refs[2 : 2 + nv]
+        out_refs = refs[2 + nv : 3 + 2 * nv]
+        carry_key, carry_sums = refs[3 + 2 * nv], refs[4 + 2 * nv]
+        i = pl.program_id(0)
+        w = w_ref[:]
+        sums = _block_scan_chain(
+            i, key_ref[:], [w] + [r[:] * w for r in val_refs],
+            carry_key, carry_sums,
+        )
+        cnt = sums[0]
+        safe = jnp.maximum(cnt, 1.0)
+        out_refs[0][:] = cnt
+        for c in range(nv):
+            out_refs[1 + c][:] = sums[1 + c] / safe
+
+    return kernel
 
 
 def seg_scan_pallas(
@@ -139,12 +184,70 @@ def seg_scan_pallas(
     return tuple(o.reshape(n) for o in out)
 
 
-def available() -> bool:
-    """True when Pallas TPU lowering is usable on the default backend."""
+def seg_mean_pallas(
+    keys: jax.Array,  # (N,) i32 sorted run keys; N a multiple of BLK
+    w: jax.Array,  # (N,) f32 0/1 valid mask (the weight channel)
+    *values: jax.Array,  # 1 or 2 (N,) f32 value channels
+    interpret: bool = False,
+) -> tuple[jax.Array, ...]:
+    """Fused single-pass segment means: ``(count, mean_0[, mean_1])``
+    per element, where ``count`` is the within-run inclusive prefix of
+    ``w`` and ``mean_c = seg_prefix(values[c] * w) / max(count, 1)``.
+
+    At a run's LAST element the prefix covers the whole run, so
+    gathering the outputs at ``segments.run_end_positions`` yields the
+    per-run means directly — callers replace the log2(lcap)-step XLA
+    shift/select chain AND the separate division with this one pass.
+    Invalid elements (``w == 0``: padding tails, sentinel slots) add
+    nothing and report count 0 / mean 0."""
+    nv = len(values)
+    assert nv in (1, 2), nv
+    n = keys.shape[0]
+    assert n % BLK == 0, n
+    nb = n // BLK
+    rows = nb * BLK_ROWS
+    spec = pl.BlockSpec((BLK_ROWS, BLK_LANES), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _seg_mean_block_kernel(nv),
+        grid=(nb,),
+        in_specs=[spec] * (2 + nv),
+        out_specs=[spec] * (1 + nv),
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, BLK_LANES), jnp.float32)
+            for _ in range(1 + nv)
+        ],
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((1 + nv,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        keys.reshape(rows, BLK_LANES),
+        w.reshape(rows, BLK_LANES),
+        *[v.reshape(rows, BLK_LANES) for v in values],
+    )
+    return tuple(o.reshape(n) for o in out)
+
+
+def pad_to_block(n: int) -> int:
+    """Smallest multiple of ``BLK`` >= n (static shape helper for the
+    jit-level wrappers that feed the flat kernels)."""
+    return -(-max(n, 1) // BLK) * BLK
+
+
+def has_pallas() -> bool:
+    """True when Pallas TPU lowering is usable on the default backend
+    (tests run the kernels in interpreter mode explicitly instead)."""
+    if pl is None:
+        return False
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:  # backend init failure — no device path at all
         return False
+
+
+# historical name, kept for external callers
+available = has_pallas
 
 
 try:  # pallas imports kept at module scope for the kernel body

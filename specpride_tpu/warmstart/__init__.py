@@ -1,0 +1,38 @@
+"""Warm-start subsystem: cold-start elimination for the kernel layer.
+
+Three coupled pieces (ROADMAP item 5, the substrate the item-1 daemon's
+"warm kernels" build on):
+
+* ``cache`` — explicit control of JAX's persistent compilation cache
+  (``--compile-cache DIR|off`` replacing the env-var-only wiring), plus
+  per-process hit/miss/saved-seconds accounting via ``jax.monitoring``
+  so a run journal can tell a cached run from a cold one.
+* ``manifest`` + ``registry`` + ``warmup`` — a shape manifest persists
+  every (kernel, shape-class) a workload compiles; ``specpride warmup``
+  (and ``--warmup`` on consensus/select) AOT-compiles them all
+  concurrently (``jit(...).lower().compile()``) before the pack lane
+  starts, so steady-state runs pay zero XLA compiles.
+* ``routing`` — the per-(method, platform) kernel routing table
+  (host-vectorized / XLA seg-scan / Pallas), seeded from measured static
+  defaults plus an optional bench-derived override file; every decision
+  is journaled as the existing ``routing`` event.
+"""
+
+from specpride_tpu.warmstart.cache import (  # noqa: F401
+    cache_state,
+    configure_compile_cache,
+    counters_delta,
+    counters_snapshot,
+    ensure_default_compile_cache,
+)
+from specpride_tpu.warmstart.manifest import (  # noqa: F401
+    ShapeEntry,
+    entries_from_seen,
+    load_manifest,
+    merge_manifest,
+)
+from specpride_tpu.warmstart.routing import (  # noqa: F401
+    Decision,
+    RoutingTable,
+)
+from specpride_tpu.warmstart.warmup import warm_entries  # noqa: F401

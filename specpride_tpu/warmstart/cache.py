@@ -1,0 +1,238 @@
+"""Persistent-compilation-cache control and accounting.
+
+One process-wide state machine replaces the once-per-process env-var
+resolution that used to live in ``backends.tpu_backend``: the CLI's
+``--compile-cache DIR|off`` configures it explicitly, the backend's
+constructor falls back to the default resolution (explicit
+``JAX_COMPILATION_CACHE_DIR`` / already-configured jax / the
+``SPECPRIDE_JAX_CACHE`` env var / a per-platform dir under
+``~/.cache``), and the RESOLUTION IS RECORDED — ``cache_state()``
+returns the dir (or the reason the cache stayed off) so the run journal
+can tell cached runs from cold ones (the old wiring left no trace,
+which made post-mortems guess).
+
+Accounting: ``jax.monitoring`` listeners count persistent-cache hits,
+misses and compile-seconds-saved, process-wide and per-thread (the
+listeners run on the compiling thread, so the warmup pool can attribute
+a hit/miss to the kernel it just compiled even with compiles in
+flight concurrently on other workers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+from specpride_tpu.observability import logger
+
+_lock = threading.Lock()
+_state: "CacheState | None" = None
+_listeners_installed = False
+
+# process-wide persistent-cache counters (mutated by jax.monitoring
+# listeners under the GIL; plain ints are fine)
+_counts = {"hits": 0, "misses": 0, "requests": 0, "saved_s": 0.0}
+_tls = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheState:
+    """How the persistent compilation cache was resolved this process."""
+
+    enabled: bool
+    dir: str | None
+    reason: str  # why it is on/off, e.g. "flag", "env:SPECPRIDE_JAX_CACHE"
+    source: str  # "flag" | "env" | "jax-config" | "default" | "off"
+
+
+def _install_listeners() -> None:
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    _listeners_installed = True
+    try:
+        from jax._src import monitoring
+    except ImportError:  # pragma: no cover - jax always ships monitoring
+        return
+
+    def _on_event(name: str, **kw) -> None:
+        if name == "/jax/compilation_cache/cache_hits":
+            _counts["hits"] += 1
+            _bump_tls("hits")
+        elif name == "/jax/compilation_cache/cache_misses":
+            _counts["misses"] += 1
+            _bump_tls("misses")
+        elif name == "/jax/compilation_cache/compile_requests_use_cache":
+            _counts["requests"] += 1
+            _bump_tls("requests")
+
+    def _on_duration(name: str, secs: float, **kw) -> None:
+        if name == "/jax/compilation_cache/compile_time_saved_sec":
+            _counts["saved_s"] += max(float(secs), 0.0)
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+def _bump_tls(key: str) -> None:
+    counts = getattr(_tls, "counts", None)
+    if counts is not None:
+        counts[key] = counts.get(key, 0) + 1
+
+
+def thread_counts_reset() -> None:
+    """Arm per-thread hit/miss attribution for the CURRENT thread (the
+    warmup pool calls this before each AOT compile)."""
+    _tls.counts = {}
+
+
+def thread_counts() -> dict:
+    return dict(getattr(_tls, "counts", None) or {})
+
+
+def counters_snapshot() -> dict:
+    """Process-wide persistent-cache counters (monotone)."""
+    return {
+        "hits": _counts["hits"],
+        "misses": _counts["misses"],
+        "requests": _counts["requests"],
+        "saved_s": round(_counts["saved_s"], 4),
+    }
+
+
+def counters_delta(since: dict) -> dict:
+    now = counters_snapshot()
+    return {
+        k: round(now[k] - since.get(k, 0), 4) if k == "saved_s"
+        else now[k] - since.get(k, 0)
+        for k in now
+    }
+
+
+def cache_state() -> CacheState:
+    """The resolved cache configuration (resolving with defaults if no
+    explicit ``configure_compile_cache`` ran yet)."""
+    ensure_default_compile_cache()
+    assert _state is not None
+    return _state
+
+
+def configure_compile_cache(spec: str | None) -> CacheState:
+    """Resolve and apply the compilation-cache configuration.
+
+    ``spec``: an explicit directory, ``"off"``, or ``None`` for the
+    default resolution.  Explicit specs override an earlier default
+    resolution (the CLI flag runs before the backend constructor, but
+    in-process test/bench sequences may interleave); the default
+    resolution runs once and then sticks.
+
+    An EXPLICIT directory also drops
+    ``jax_persistent_cache_min_compile_time_secs`` to 0 so every
+    compile is cached — the caller asked for cold-start elimination,
+    and the warm-rerun "zero fresh compiles" guarantee needs the fast
+    compiles cached too.
+    """
+    global _state
+    with _lock:
+        _install_listeners()
+        if spec is None:
+            if _state is None:
+                _state = _resolve_default()
+            return _state
+        if spec == "off":
+            _state = CacheState(False, None, "disabled by --compile-cache off",
+                                "off")
+            _apply(None, None)
+            return _state
+        path = os.path.abspath(os.path.expanduser(spec))
+        if _apply(path, 0.0):
+            _state = CacheState(
+                True, path, "explicit --compile-cache", "flag"
+            )
+        else:
+            # the journal must not claim a cache that jax never got
+            # (unwritable dir, too-old jax): record WHY it is off
+            _state = CacheState(
+                False, None,
+                f"--compile-cache {path} unusable (unwritable or jax "
+                "too old)", "flag",
+            )
+        return _state
+
+
+def ensure_default_compile_cache() -> CacheState:
+    """The backend-constructor entry point: default resolution, once."""
+    return configure_compile_cache(None)
+
+
+def _resolve_default() -> CacheState:
+    """The historical resolution order (see the module docstring)."""
+    import jax
+
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return CacheState(
+            True, os.environ["JAX_COMPILATION_CACHE_DIR"],
+            "JAX_COMPILATION_CACHE_DIR set", "env",
+        )
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return CacheState(
+                True, jax.config.jax_compilation_cache_dir,
+                "jax already configured", "jax-config",
+            )
+    except AttributeError:
+        pass  # older jax without the attribute: treat as not configured
+    path = os.environ.get("SPECPRIDE_JAX_CACHE")
+    if path == "":
+        return CacheState(False, None, "SPECPRIDE_JAX_CACHE empty", "env")
+    source = "env" if path is not None else "default"
+    if path is None:
+        # partition by platform: CPU AOT entries compiled inside a
+        # TPU-plugin process carry different machine-feature flags than a
+        # plain CPU process, and loading a mismatched entry risks SIGILL
+        try:
+            plat = jax.config.jax_platforms or os.environ.get(
+                "JAX_PLATFORMS", ""
+            )
+        except AttributeError:
+            plat = os.environ.get("JAX_PLATFORMS", "")
+        path = os.path.join(
+            os.path.expanduser("~"), ".cache", "specpride_tpu",
+            f"jax_cache_{plat or 'default'}",
+        )
+    # cache even fast compiles beyond 0.2s: the tunnel round-trips during
+    # tracing make every avoided compile worth it
+    if _apply(path, 0.2):
+        return CacheState(True, path, "default location", source)
+    return CacheState(False, None, "cache dir unwritable or jax too old",
+                      source)
+
+
+def _apply(path: str | None, min_secs: float | None) -> bool:
+    import jax
+
+    try:
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        if min_secs is not None:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", min_secs
+            )
+    except (OSError, AttributeError) as e:
+        logger.warning("compilation cache unavailable (%s); running "
+                       "uncached", e)
+        return False
+    # jax memoizes its cache decision + file handle once per process —
+    # a compile that ran BEFORE this configuration (imports, another
+    # backend, a test earlier in the process) would otherwise pin the
+    # cache off/elsewhere forever.  reset_cache() drops the memo so the
+    # new directory takes effect from the next compile on.
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:  # noqa: BLE001 - private API; absent on older jax
+        pass  # the config update alone has to do
+    return True

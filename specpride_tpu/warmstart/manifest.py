@@ -1,0 +1,162 @@
+"""Shape manifests: the persisted record of every (kernel, shape-class)
+a workload compiles.
+
+The backend already tracks dispatched shape classes exactly
+(``TpuBackend._seen_shapes`` — the same set that drives the journal's
+``compile`` events), and kernel shapes are bounded to a few size classes
+precisely so compiled programs can be reused.  A manifest freezes that
+knowledge to disk so a LATER process can AOT-compile every variant
+before its first chunk (``specpride warmup`` / ``--warmup``), turning
+the persistent compilation cache from "warm after the first run" into
+"warm before the first dispatch".
+
+Format (JSON, versioned, additive):
+
+    {"version": 1,
+     "entries": [
+       {"kernel": "gap_average_compact", "shape_key": [64, 2048, 1536],
+        "config": {"type": "GapAverageConfig", "mz_accuracy": 0.01, ...}},
+       {"kernel": "bin_mean_flat_intensity",
+        "shape_key": [262144, 1536, 1536, 8], "config": null}]}
+
+``config`` is present only for kernels whose compilation is keyed by a
+static method-config dataclass (``CONFIG_KERNELS``); everything else a
+kernel needs is in ``shape_key`` (the dispatch sites key their classes
+by every static argument for exactly this reason).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+MANIFEST_VERSION = 1
+
+# default manifest filename inside a --compile-cache dir (the natural
+# home: the manifest indexes what the cache beside it holds)
+DEFAULT_BASENAME = "shape_manifest.json"
+
+# kernels whose jit signature takes a static method-config dataclass
+CONFIG_KERNELS = {
+    "bin_mean_bucketized": "BinMeanConfig",
+    "gap_average_compact": "GapAverageConfig",
+    "gap_average_compact_pallas": "GapAverageConfig",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeEntry:
+    kernel: str
+    shape_key: tuple
+    config: dict | None = None  # {"type": <dataclass name>, **fields}
+
+    def identity(self) -> tuple:
+        return (
+            self.kernel,
+            tuple(self.shape_key),
+            json.dumps(self.config, sort_keys=True),
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "kernel": self.kernel,
+            "shape_key": list(self.shape_key),
+            "config": self.config,
+        }
+
+
+def config_dict(config_obj) -> dict:
+    return {
+        "type": type(config_obj).__name__,
+        **dataclasses.asdict(config_obj),
+    }
+
+
+def entries_from_seen(
+    seen_shapes, method_config=None
+) -> list[ShapeEntry]:
+    """Manifest entries from a backend's ``_seen_shapes`` set (tuples of
+    ``(kernel, *shape_key)``).  ``method_config`` is the run's method
+    config object — attached to the kernels that compile against it."""
+    cfg = config_dict(method_config) if method_config is not None else None
+    out = []
+    for key in sorted(seen_shapes, key=lambda t: (t[0], t[1:])):
+        kernel, shape_key = key[0], tuple(key[1:])
+        want = CONFIG_KERNELS.get(kernel)
+        entry_cfg = (
+            cfg if want is not None and cfg is not None
+            and cfg.get("type") == want else None
+        )
+        if want is not None and entry_cfg is None:
+            # a config-keyed kernel without its config cannot be rebuilt;
+            # skip rather than record an unwarmable entry
+            continue
+        out.append(ShapeEntry(kernel, shape_key, entry_cfg))
+    return out
+
+
+def load_manifest(path: str) -> list[ShapeEntry]:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or "entries" not in doc:
+        raise ValueError(f"{path}: not a shape manifest")
+    version = doc.get("version")
+    if version != MANIFEST_VERSION:
+        raise ValueError(
+            f"{path}: unsupported manifest version {version!r}"
+        )
+    out = []
+    for i, e in enumerate(doc["entries"]):
+        try:
+            out.append(
+                ShapeEntry(
+                    str(e["kernel"]), tuple(e["shape_key"]),
+                    e.get("config"),
+                )
+            )
+        except (KeyError, TypeError) as err:
+            raise ValueError(f"{path}: bad entry #{i}: {err}") from err
+    return out
+
+
+def merge_manifest(path: str, entries: list[ShapeEntry]) -> int:
+    """Union ``entries`` into the manifest at ``path`` (created if
+    absent), atomically.  Returns the total entry count after the merge.
+    Identity is (kernel, shape_key, config) — re-running the same
+    workload leaves the manifest unchanged.
+
+    The read-modify-write runs under an ``flock`` on ``path + ".lock"``:
+    concurrent finishers sharing one compile-cache dir (multi-host
+    ranks, parallel CLI runs) would otherwise each union only their own
+    entries and the last ``os.replace`` would drop the others' shape
+    classes — exactly the classes a later warmup needs."""
+    lock_path = path + ".lock"
+    lock_fh = None
+    try:
+        try:
+            import fcntl
+
+            lock_fh = open(lock_path, "a")
+            fcntl.flock(lock_fh.fileno(), fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            lock_fh = None  # non-POSIX / unwritable: best-effort merge
+        have: dict[tuple, ShapeEntry] = {}
+        if os.path.exists(path):
+            for e in load_manifest(path):
+                have[e.identity()] = e
+        for e in entries:
+            have.setdefault(e.identity(), e)
+        doc = {
+            "version": MANIFEST_VERSION,
+            "entries": [e.to_json() for _, e in sorted(have.items())],
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+        os.replace(tmp, path)
+        return len(have)
+    finally:
+        if lock_fh is not None:
+            lock_fh.close()  # closing drops the flock

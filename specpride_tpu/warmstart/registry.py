@@ -1,0 +1,204 @@
+"""Kernel registry: rebuild a kernel's exact jit call from a manifest
+entry, for AOT warmup.
+
+Every device dispatch site in ``backends.tpu_backend`` keys its shape
+class (``_note_dispatch``'s ``shape_key``) by EVERY static argument of
+the underlying jitted function, so ``(kernel, shape_key[, config])``
+fully determines one XLA compilation.  Each builder here reconstructs
+the ``ShapeDtypeStruct`` argument list + static kwargs for one kernel —
+dtype-exact mirrors of what the dispatch sites ship — so
+``jit(fn).lower(*avals, **statics).compile()`` produces the very
+executable the run would compile, and the persistent compilation cache
+entry it writes is the one the run will load.
+
+Kernels absent from the registry (none today) are skipped by warmup
+with a journal note rather than failing the run.  Mesh-sharded
+dispatches compile against sharded avals and are NOT reproduced here —
+warmup covers the single-host paths (the manifest from a mesh run still
+warms the unsharded variants, which is harmless but unused).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from specpride_tpu.warmstart.manifest import ShapeEntry
+
+_CONFIG_TYPES = None
+
+
+def _configs():
+    global _CONFIG_TYPES
+    if _CONFIG_TYPES is None:
+        from specpride_tpu.config import BinMeanConfig, GapAverageConfig
+
+        _CONFIG_TYPES = {
+            "BinMeanConfig": BinMeanConfig,
+            "GapAverageConfig": GapAverageConfig,
+        }
+    return _CONFIG_TYPES
+
+
+def _rebuild_config(config: dict | None):
+    if config is None:
+        return None
+    fields = dict(config)
+    type_name = fields.pop("type", None)
+    cls = _configs().get(type_name)
+    if cls is None:
+        raise ValueError(f"unknown config type {type_name!r}")
+    return cls(**fields)
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _bin_mean_flat(entry: ShapeEntry, impl: str):
+    from specpride_tpu.ops.binning import bin_mean_flat_intensity
+
+    n_pad, cap, rcap, lcap = entry.shape_key
+    avals = (
+        _sds((n_pad,), jnp.float32),  # intensity
+        _sds((n_pad,), jnp.int32),  # gbin
+        _sds((rcap,), jnp.bool_),  # keep_runs
+    )
+    statics = dict(total_cap=cap, rcap=rcap, lcap=lcap, impl=impl)
+    return bin_mean_flat_intensity, avals, statics
+
+
+def _bin_mean_bucketized(entry: ShapeEntry):
+    from specpride_tpu.ops.binning import bin_mean_deduped_compact
+
+    size, k, cap, lcap = entry.shape_key
+    avals = (
+        _sds((size, k), jnp.float32),  # mz
+        _sds((size, k), jnp.float32),  # intensity
+        _sds((size, k), jnp.int32),  # bins
+        _sds((size,), jnp.int32),  # n_members
+    )
+    statics = dict(
+        config=_rebuild_config(entry.config), total_cap=cap, lcap=lcap
+    )
+    return bin_mean_deduped_compact, avals, statics
+
+
+def _gap_average_compact(entry: ShapeEntry, impl: str):
+    from specpride_tpu.ops.gap_average import gap_average_compact
+
+    size, k, cap = entry.shape_key
+    avals = (
+        _sds((size, k), jnp.float32),  # mz
+        _sds((size, k), jnp.float32),  # intensity
+        _sds((size, k), jnp.int32),  # seg
+        _sds((size,), jnp.int32),  # n_valid
+        _sds((size,), jnp.int32),  # quorum
+        _sds((size,), jnp.int32),  # n_members
+    )
+    statics = dict(
+        config=_rebuild_config(entry.config), total_cap=cap, impl=impl
+    )
+    return gap_average_compact, avals, statics
+
+
+def _medoid_args(size, k, m):
+    return (
+        _sds((size, k), jnp.int32),  # bins, pre-sorted (bin, member)
+        _sds((size, k), jnp.int32),  # member_id, padding = m
+    ), (
+        _sds((size, m), jnp.int32),  # n_peaks
+        _sds((size, m), jnp.bool_),  # member_mask
+        _sds((size,), jnp.int32),  # n_members
+    )
+
+
+def _medoid_select(entry: ShapeEntry):
+    from specpride_tpu.ops.similarity import medoid_select_packed
+
+    size, k, m, lcap = entry.shape_key
+    core, finalize = _medoid_args(size, k, m)
+    return medoid_select_packed, core + finalize, dict(m=m, lcap=lcap)
+
+
+def _shared_bins(entry: ShapeEntry):
+    from specpride_tpu.ops.similarity import shared_bins_packed
+
+    size, k, m, lcap = entry.shape_key
+    core, _ = _medoid_args(size, k, m)
+    return shared_bins_packed, core, dict(m=m, lcap=lcap)
+
+
+def _cosine_packed(entry: ShapeEntry):
+    from specpride_tpu.ops.similarity import cosine_packed
+
+    size, k, pr, m = entry.shape_key
+    avals = (
+        _sds((size, pr), jnp.int32),  # rep_bins
+        _sds((size, pr), jnp.float32),  # rep_int
+        _sds((size,), jnp.int32),  # rep_edges
+        _sds((size, k), jnp.int32),  # mem_bins
+        _sds((size, k), jnp.float32),  # mem_int
+        _sds((size, k), jnp.int32),  # mem_member
+        _sds((size, m), jnp.int32),  # mem_edges
+        _sds((size, m), jnp.bool_),  # member_mask
+        _sds((size,), jnp.int32),  # n_members
+    )
+    return cosine_packed, avals, dict(m=m)
+
+
+def _cosine_flat(entry: ShapeEntry):
+    from specpride_tpu.ops.similarity import cosine_flat
+
+    (
+        n_pad, nr_pad, rows_cap, s_pad,
+        shift, l_rep, l_row, l_spec, l_mem, l_members,
+    ) = entry.shape_key
+    avals = (
+        _sds((nr_pad,), jnp.int32),  # rkey
+        _sds((nr_pad,), jnp.float32),  # rint
+        _sds((n_pad,), jnp.int32),  # mkey
+        _sds((n_pad,), jnp.float32),  # mint
+        _sds((n_pad,), jnp.int32),  # spec_elem
+        _sds((n_pad,), jnp.int32),  # pos
+        _sds((s_pad + 1,), jnp.int32),  # spec_offsets
+        _sds((s_pad,), jnp.int32),  # spec_row
+        _sds((s_pad,), jnp.int32),  # npos
+        _sds((rows_cap + 1,), jnp.int32),  # rep_offsets
+        _sds((rows_cap + 1,), jnp.int32),  # row_spec_offsets
+        _sds((rows_cap,), jnp.int32),  # n_members
+    )
+    statics = dict(
+        shift=shift, l_rep=l_rep, l_row=l_row, l_spec=l_spec,
+        l_mem=l_mem, l_members=l_members,
+    )
+    return cosine_flat, avals, statics
+
+
+_BUILDERS = {
+    "bin_mean_flat_intensity": lambda e: _bin_mean_flat(e, "scan"),
+    "bin_mean_flat_intensity_pallas": lambda e: _bin_mean_flat(e, "pallas"),
+    "bin_mean_bucketized": _bin_mean_bucketized,
+    "gap_average_compact": lambda e: _gap_average_compact(e, "scan"),
+    "gap_average_compact_pallas": lambda e: _gap_average_compact(
+        e, "pallas"
+    ),
+    "medoid_select_packed": _medoid_select,
+    "shared_bins_packed": _shared_bins,
+    "cosine_packed": _cosine_packed,
+    "cosine_flat": _cosine_flat,
+}
+
+
+def known_kernels() -> tuple[str, ...]:
+    return tuple(sorted(_BUILDERS))
+
+
+def build(entry: ShapeEntry):
+    """``(jitted_fn, avals, static_kwargs)`` for a manifest entry, or
+    None for a kernel this registry cannot rebuild."""
+    builder = _BUILDERS.get(entry.kernel)
+    if builder is None:
+        return None
+    return builder(entry)

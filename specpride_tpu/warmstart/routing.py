@@ -1,0 +1,122 @@
+"""Measured kernel routing: one table for every per-(method, platform)
+execution-path decision the device backend makes.
+
+Generalizes the one-off CPU-only gap-average reroute (PR 4): instead of
+an inline ``_cpu_only_devices()`` check, the backend asks this table
+which path carries a method's heavy reduction on the current platform:
+
+* ``host-vectorized`` — the exact-f64 vectorized host consensus (the
+  measured winner for gap-average on CPU-only jax: the device path ran
+  at 0.29x of it, BENCH_r08);
+* ``xla`` — the XLA ``ops.segments`` seg-scan kernels (the log2(lcap)
+  Hillis-Steele formulation);
+* ``pallas`` — the fused single-pass Pallas kernels
+  (``ops.pallas_kernels.seg_mean_pallas``), selectable only where
+  Pallas lowers (the backend falls back to ``xla`` and journals the
+  fallback otherwise).
+
+Decisions are seeded from measured static defaults and optionally
+overridden by a bench-derived file (``--routing-table FILE`` or the
+``SPECPRIDE_ROUTING`` env var; ``bench.py``'s ``pallas_ab`` section
+emits one), so a platform where the Pallas kernel wins its A/B can
+promote it without a code change — and the promotion is visible:
+every decision the backend acts on is journaled as the existing
+``routing`` event.  ``--force-device`` remains the escape hatch that
+pins the requested device kernels.
+
+Override file format:
+
+    {"version": 1, "entries": [
+      {"method": "gap-average", "platform": "tpu",
+       "path": "pallas", "reason": "pallas_ab r10: 1.8x over seg_scan"}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+PATHS = ("host-vectorized", "xla", "pallas")
+
+# measured static defaults; ("*" platform) rows are the fallback.
+# gap-average/cpu pins the BENCH_r08 decision: no accelerator to win on
+# and the CPU 'device' kernel measured 0.29x of the host consensus.
+_STATIC: dict[tuple[str, str], tuple[str, str]] = {
+    ("gap-average", "cpu"): ("host-vectorized", "cpu-only-devices"),
+    ("gap-average", "*"): ("xla", "static-default"),
+    ("bin-mean", "*"): ("xla", "static-default"),
+    ("medoid", "*"): ("xla", "static-default"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    path: str  # one of PATHS
+    reason: str
+    source: str  # "static" | "override"
+
+
+class RoutingTable:
+    """Static defaults + optional override file, queried per decision."""
+
+    def __init__(self, overrides: dict[tuple[str, str], tuple[str, str]]
+                 | None = None, origin: str | None = None):
+        self._overrides = dict(overrides or {})
+        self.origin = origin  # override file path, for logs
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "RoutingTable":
+        """Table with overrides from ``path`` (or ``SPECPRIDE_ROUTING``
+        when unset; no file -> pure static defaults).  A malformed or
+        missing EXPLICIT file raises — a typo'd override must not
+        silently fall back to defaults."""
+        explicit = path is not None
+        path = path or os.environ.get("SPECPRIDE_ROUTING") or None
+        if not path:
+            return cls()
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            entries = doc["entries"] if isinstance(doc, dict) else None
+            if doc.get("version") != 1 or not isinstance(entries, list):
+                raise ValueError("not a v1 routing-override file")
+            overrides = {}
+            for e in entries:
+                p = e["path"]
+                if p not in PATHS:
+                    raise ValueError(f"unknown path {p!r} (want {PATHS})")
+                overrides[(e["method"], e["platform"])] = (
+                    p, str(e.get("reason", "override"))
+                )
+        except (OSError, ValueError, KeyError, TypeError) as err:
+            if explicit:
+                raise SystemExit(f"bad routing table {path}: {err}")
+            from specpride_tpu.observability import logger
+
+            logger.warning(
+                "ignoring SPECPRIDE_ROUTING=%s (%s)", path, err
+            )
+            return cls()
+        return cls(overrides, origin=path)
+
+    def decide(self, method: str, platform: str) -> Decision:
+        for key in ((method, platform), (method, "*")):
+            if key in self._overrides:
+                path, reason = self._overrides[key]
+                return Decision(path, reason, "override")
+        for key in ((method, platform), (method, "*")):
+            if key in _STATIC:
+                path, reason = _STATIC[key]
+                return Decision(path, reason, "static")
+        return Decision("xla", "no-table-entry", "static")
+
+
+def write_overrides(path: str, entries: list[dict]) -> None:
+    """Write a bench-derived override file (``bench.py`` pallas_ab)."""
+    for e in entries:
+        if e.get("path") not in PATHS:
+            raise ValueError(f"unknown path in override entry: {e}")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=1)
+        fh.write("\n")

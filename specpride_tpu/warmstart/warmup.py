@@ -1,0 +1,146 @@
+"""AOT bucket-shape warmup: compile every manifest entry before the
+first chunk dispatches.
+
+``warm_entries`` drives ``jit(fn).lower(avals).compile()`` for each
+shape-manifest entry on a thread pool — XLA compilation releases the
+GIL, so variants compile CONCURRENTLY, and each compile either pays the
+full XLA bill once (then lands in the persistent cache for every later
+process) or loads from the cache in milliseconds.  Per-kernel
+hit/miss attribution uses the per-thread ``jax.monitoring`` counters in
+``warmstart.cache`` (listeners run on the compiling thread, so
+concurrent compiles cannot cross-attribute).
+
+Every outcome is journaled as a ``warmup`` event — per-kernel
+compile-vs-cache-hit and seconds — which ``specpride stats`` rolls up
+into the ``warmstart:`` line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+from specpride_tpu.observability import NullJournal, logger
+from specpride_tpu.observability import tracing
+from specpride_tpu.warmstart import cache, registry
+from specpride_tpu.warmstart.manifest import ShapeEntry
+
+
+@dataclasses.dataclass
+class WarmResult:
+    entry: ShapeEntry
+    status: str  # "compiled" | "cache_hit" | "skipped" | "error"
+    seconds: float
+    detail: str = ""
+
+    @property
+    def cache_hit(self) -> bool:
+        return self.status == "cache_hit"
+
+
+def _compile_one(item) -> tuple[int, WarmResult]:
+    """Pool-worker half: the XLA compile (or persistent-cache load) of
+    an already-lowered entry.  ``seconds`` = this entry's own lowering
+    time plus its compile time — pool QUEUE WAIT is excluded (with more
+    entries than workers it would double-count whole compile rounds
+    into every second-wave entry)."""
+    i, entry, lowered, lower_s = item
+    cache.thread_counts_reset()
+    t0 = time.perf_counter()
+    try:
+        lowered.compile()
+    except Exception as e:  # noqa: BLE001 - a bad variant (e.g. Pallas
+        # Mosaic-compiling off-TPU) must not abort the rest
+        return i, WarmResult(
+            entry, "error", lower_s + time.perf_counter() - t0,
+            f"{type(e).__name__}: {e}",
+        )
+    counts = cache.thread_counts()
+    hit = counts.get("hits", 0) > 0 and counts.get("misses", 0) == 0
+    return i, WarmResult(
+        entry, "cache_hit" if hit else "compiled",
+        lower_s + time.perf_counter() - t0,
+    )
+
+
+def warm_entries(
+    entries: list[ShapeEntry], journal=None, jobs: int = 0,
+) -> list[WarmResult]:
+    """Warm every entry — tracing/lowering SEQUENTIAL, XLA compiles
+    concurrent; journal one ``warmup`` event per entry and return the
+    results (stable entry order).
+
+    The split is load-bearing, not a style choice: jax tracing is where
+    the wall-time is NOT (XLA compilation dominates and releases the
+    GIL), and lowering the same call concurrently with other traces was
+    measured to produce a canonicalization-unstable module — the same
+    (kernel, shape-class) hashed to one of TWO persistent-cache keys
+    depending on thread interleaving, so a warmup entry could silently
+    re-compile instead of hitting the entry its own cold run wrote.
+    Sequential lowering is byte-identical to what a dispatch traces, so
+    warmup keys always match run keys."""
+    journal = journal if journal is not None else NullJournal()
+    if not entries:
+        return []
+    if jobs <= 0:
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            cores = os.cpu_count() or 1
+        jobs = max(1, min(8, cores, len(entries)))
+    import concurrent.futures
+
+    t0 = time.perf_counter()
+    results: list[WarmResult | None] = [None] * len(entries)
+    with tracing.span("warmup", n_entries=len(entries), jobs=jobs):
+        work = []
+        for i, entry in enumerate(entries):
+            t_start = time.perf_counter()
+            try:
+                built = registry.build(entry)
+            except (ValueError, TypeError) as e:
+                results[i] = WarmResult(
+                    entry, "skipped", 0.0, f"bad entry: {e}"
+                )
+                continue
+            if built is None:
+                results[i] = WarmResult(
+                    entry, "skipped", 0.0, "kernel not in warmup registry"
+                )
+                continue
+            fn, avals, statics = built
+            try:
+                lowered = fn.lower(*avals, **statics)
+            except Exception as e:  # noqa: BLE001 - e.g. Pallas off-TPU
+                results[i] = WarmResult(
+                    entry, "error", time.perf_counter() - t_start,
+                    f"{type(e).__name__}: {e}",
+                )
+                continue
+            work.append((i, entry, lowered, time.perf_counter() - t_start))
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=jobs, thread_name_prefix="specpride-warmup"
+        ) as pool:
+            for i, res in pool.map(_compile_one, work):
+                results[i] = res
+    for r in results:
+        journal.emit(
+            "warmup",
+            kernel=r.entry.kernel,
+            shape_key=list(r.entry.shape_key),
+            cache_hit=r.cache_hit,
+            seconds=round(r.seconds, 4),
+            status=r.status,
+            **({"detail": r.detail} if r.detail else {}),
+        )
+    n_hit = sum(r.cache_hit for r in results)
+    n_err = sum(r.status in ("error", "skipped") for r in results)
+    logger.info(
+        "warmup: %d kernel variant(s) in %.2fs — %d compiled, %d cache "
+        "hit(s)%s",
+        len(results), time.perf_counter() - t0,
+        sum(r.status == "compiled" for r in results), n_hit,
+        f", {n_err} skipped/failed" if n_err else "",
+    )
+    return results

@@ -314,6 +314,7 @@ class TestGapAverageRouting:
         assert routing == [{
             "event": "routing", "method": "gap-average",
             "path": "host-vectorized", "reason": "cpu-only-devices",
+            "source": "static",
         }]
         # the host path dispatched no gap kernel
         assert not [e for e in events if e["event"] == "dispatch"]

@@ -1,6 +1,9 @@
-"""Pallas segmented-scan kernel: interpreter-mode correctness vs the XLA
-formulation and a f64 reference (the on-chip A/B perf numbers live in
-BENCH_METHODS.json; CI has no TPU, so only semantics are checked here)."""
+"""Pallas kernels: interpreter-mode correctness vs the XLA formulation
+and f64 references (the on-chip A/B perf numbers live in the BENCH
+reports; CI has no TPU, so only semantics are checked here).  Covers
+both ``seg_scan_pallas`` and the fused ``seg_mean_pallas`` — including
+the full bin-mean/gap-average kernels running with ``impl=
+"pallas_interpret"`` against their numpy oracles."""
 
 import numpy as np
 import pytest
@@ -54,3 +57,163 @@ def test_seg_scan_pallas_run_spanning_many_blocks():
     ow = np.asarray(ow)
     assert ow[n - pk.BLK // 2 - 1] == n - pk.BLK // 2  # long run's last
     assert ow[-1] == pk.BLK // 2  # tail run restarts
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_seg_mean_pallas_interpret(seed):
+    """The fused kernel's count/mean outputs at run-end positions match
+    a sequential f64 reference, with zero-weight (masked) elements
+    contributing nothing."""
+    if pk.pl is None:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(seed)
+    n = 2 * pk.BLK
+    lens = []
+    while sum(lens) < n:
+        lens.append(int(rng.integers(1, pk.BLK // 3)))
+    keys = np.repeat(np.arange(len(lens)), lens)[:n].astype(np.int32)
+    w = (rng.uniform(0, 1, n) < 0.8).astype(np.float32)  # masked slots
+    x = rng.uniform(0.0, 1e4, n).astype(np.float32)
+    y = rng.uniform(0.5, 2.0, n).astype(np.float32)
+
+    cnt, mx, my = pk.seg_mean_pallas(keys, w, x, y, interpret=True)
+    cnt, mx, my = map(np.asarray, (cnt, mx, my))
+
+    ends = np.flatnonzero(
+        np.concatenate([keys[1:] != keys[:-1], [True]])
+    )
+    for e in ends:
+        run = keys == keys[e]
+        c = w[run].sum()
+        assert cnt[e] == pytest.approx(c, rel=1e-6)
+        want_x = (x[run].astype(np.float64) * w[run]).sum() / max(c, 1)
+        want_y = (y[run].astype(np.float64) * w[run]).sum() / max(c, 1)
+        assert mx[e] == pytest.approx(want_x, rel=1e-5)
+        assert my[e] == pytest.approx(want_y, rel=1e-5)
+
+
+def test_seg_mean_pallas_single_channel_and_all_masked():
+    """1-value-channel variant; a fully masked run reads count 0 and
+    mean 0 (the padding/sentinel contract callers rely on)."""
+    if pk.pl is None:
+        pytest.skip("pallas unavailable")
+    n = pk.BLK
+    keys = np.zeros(n, dtype=np.int32)
+    keys[n // 2 :] = 1  # second run fully masked
+    w = np.ones(n, dtype=np.float32)
+    w[n // 2 :] = 0.0
+    x = np.full(n, 3.5, dtype=np.float32)
+    (cnt, mx) = pk.seg_mean_pallas(keys, w, x, interpret=True)
+    cnt, mx = np.asarray(cnt), np.asarray(mx)
+    assert cnt[n // 2 - 1] == n // 2
+    assert mx[n // 2 - 1] == pytest.approx(3.5, rel=1e-6)
+    assert cnt[-1] == 0.0 and mx[-1] == 0.0
+
+
+def _flat_bin_mean_parity(impl):
+    """Full flat bin-mean kernel vs the numpy oracle, per impl."""
+    import jax
+
+    from specpride_tpu.backends import numpy_backend as nb
+    from specpride_tpu.backends.tpu_backend import TpuBackend
+    from specpride_tpu.data.peaks import Cluster, Spectrum
+    from specpride_tpu.ops import binning
+
+    rng = np.random.default_rng(11)
+    clusters = []
+    for i in range(12):
+        m = int(rng.integers(2, 7))
+        base = np.sort(rng.uniform(120, 1800, 80))
+        members = [
+            Spectrum(
+                mz=np.sort(base + rng.normal(0, 0.003, 80)),
+                intensity=rng.uniform(1, 1e4, 80),
+                precursor_mz=500.0, precursor_charge=2, rt=1.0,
+                title=f"c{i};s{k}",
+            )
+            for k in range(m)
+        ]
+        clusters.append(Cluster(f"c{i}", members))
+    oracle = nb.run_bin_mean(clusters)
+
+    orig = binning.bin_mean_flat_intensity
+    calls = []
+
+    def spy(*a, **kw):
+        kw["impl"] = impl
+        calls.append(impl)
+        return orig(*a, **kw)
+
+    backend = TpuBackend(layout="flat")
+    try:
+        binning.bin_mean_flat_intensity = spy
+        got = backend.run_bin_mean(clusters)
+    finally:
+        binning.bin_mean_flat_intensity = orig
+    assert calls, "flat kernel never dispatched"
+    assert len(got) == len(oracle)
+    # same tolerances as the existing flat-vs-oracle parity tests
+    # (test_tpu_parity): f32 device accumulation vs f64 oracle
+    for o, d in zip(oracle, got):
+        assert o.n_peaks == d.n_peaks
+        np.testing.assert_allclose(d.mz, o.mz, rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(
+            d.intensity, o.intensity, rtol=1e-4, atol=1e-3
+        )
+
+
+def test_flat_bin_mean_pallas_oracle_parity():
+    """The routing table's Pallas alternative for the flat bin-mean
+    intensity kernel reproduces the numpy oracle (interpret mode — the
+    same kernel body Mosaic lowers on TPU)."""
+    if pk.pl is None:
+        pytest.skip("pallas unavailable")
+    _flat_bin_mean_parity("pallas_interpret")
+
+
+def test_gap_average_pallas_oracle_parity():
+    """The bucketized gap-average kernel with the fused Pallas core
+    reproduces the numpy oracle on realistic clusters."""
+    if pk.pl is None:
+        pytest.skip("pallas unavailable")
+    from specpride_tpu.backends import numpy_backend as nb
+    from specpride_tpu.backends.tpu_backend import TpuBackend
+    from specpride_tpu.data.peaks import Cluster, Spectrum
+    from specpride_tpu.ops import gap_average as ga
+
+    rng = np.random.default_rng(7)
+    clusters = []
+    for i in range(8):
+        m = int(rng.integers(1, 6))  # incl. a singleton passthrough
+        base = np.sort(rng.uniform(150, 1600, 60))
+        members = [
+            Spectrum(
+                mz=np.sort(base + rng.normal(0, 0.002, 60)),
+                intensity=rng.uniform(1, 1e4, 60),
+                precursor_mz=450.0, precursor_charge=2, rt=1.0,
+                title=f"g{i};s{k}",
+            )
+            for k in range(m)
+        ]
+        clusters.append(Cluster(f"g{i}", members))
+    oracle = nb.run_gap_average(clusters)
+
+    orig = ga.gap_average_compact
+    calls = []
+
+    def spy(*a, **kw):
+        kw["impl"] = "pallas_interpret"
+        calls.append(1)
+        return orig(*a, **kw)
+
+    backend = TpuBackend(layout="bucketized", force_device=True)
+    try:
+        ga.gap_average_compact = spy
+        got = backend.run_gap_average(clusters)
+    finally:
+        ga.gap_average_compact = orig
+    assert calls, "gap kernel never dispatched"
+    for o, d in zip(oracle, got):
+        assert o.n_peaks == d.n_peaks
+        np.testing.assert_allclose(d.mz, o.mz, rtol=1e-5)
+        np.testing.assert_allclose(d.intensity, o.intensity, rtol=1e-4)

@@ -81,6 +81,99 @@ def test_run_ids_and_broadcast():
     np.testing.assert_allclose(per_elem, ref[ids], rtol=1e-5)
 
 
+def _pallas_seg_means(keys, vals):
+    """seg_mean_pallas (interpret) run totals/means gathered at run
+    ends, or None when pallas is unavailable (skip cleanly, as
+    ``pallas_kernels.has_pallas`` does for the real device path)."""
+    from specpride_tpu.ops import pallas_kernels as pk
+
+    if pk.pl is None:
+        return None
+    n = keys.size
+    pad = pk.pad_to_block(n) - n
+    sent = np.int64(2**30)
+    w = (keys != sent).astype(np.float32)
+    cnt, mean = pk.seg_mean_pallas(
+        np.pad(keys, (0, pad), constant_values=sent).astype(np.int32),
+        np.pad(w, (0, pad)),
+        np.pad(vals, (0, pad)),
+        interpret=True,
+    )
+    return np.asarray(cnt)[:n], np.asarray(mean)[:n]
+
+
+def test_run_length_exactly_lcap():
+    """A real run of length EXACTLY lcap is the scan window's boundary
+    case: log2(lcap) shift steps must cover the whole run (a one-off
+    would window it like a sentinel tail).  Both the XLA chain and the
+    fused Pallas kernel must agree with reduceat."""
+    lcap = 16
+    lens = [lcap, 1, lcap, 3]
+    keys = np.repeat(np.arange(len(lens), dtype=np.int64), lens)
+    rng = np.random.default_rng(9)
+    vals = rng.uniform(0.5, 100.0, keys.size).astype(np.float32)
+    tot, cnt, endpos = _sums(
+        jnp.asarray(keys), jnp.asarray(vals), rcap=8, lcap=lcap
+    )
+    want = np.add.reduceat(
+        vals.astype(np.float64),
+        np.flatnonzero(np.concatenate([[True], keys[1:] != keys[:-1]])),
+    )
+    np.testing.assert_allclose(
+        np.asarray(tot)[: len(lens)], want, rtol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cnt)[: len(lens)].astype(int), lens
+    )
+    got = _pallas_seg_means(keys, vals)
+    if got is not None:
+        pcnt, pmean = got
+        ends = np.cumsum(lens) - 1
+        np.testing.assert_array_equal(pcnt[ends].astype(int), lens)
+        np.testing.assert_allclose(
+            pmean[ends], want / np.asarray(lens), rtol=1e-5
+        )
+
+
+def test_all_sentinel_padding_tail():
+    """An input that is NOTHING but sentinel padding: the scan must not
+    crash, every run slot must read back as sentinel-keyed, and the
+    Pallas kernel must report zero counts/means throughout."""
+    sent = np.int64(2**30)
+    keys = np.full(64, sent)
+    vals = np.ones(64, dtype=np.float32)
+    tot, cnt, endpos = _sums(
+        jnp.asarray(keys), jnp.asarray(vals), rcap=4, lcap=4
+    )
+    assert (keys[np.asarray(endpos)] == sent).all()
+    got = _pallas_seg_means(keys, vals)
+    if got is not None:
+        pcnt, pmean = got
+        assert (pcnt == 0).all() and (pmean == 0).all()
+
+
+def test_single_element_runs():
+    """Every run length 1 (fully distinct keys): prefix == value, count
+    == 1, means == values — on both implementations."""
+    n = 100
+    keys = np.arange(n, dtype=np.int64)
+    rng = np.random.default_rng(4)
+    vals = rng.uniform(1.0, 50.0, n).astype(np.float32)
+    tot, cnt, endpos = _sums(
+        jnp.asarray(keys), jnp.asarray(vals), rcap=n + 2, lcap=4
+    )
+    np.testing.assert_allclose(np.asarray(tot)[:n], vals, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(cnt)[:n].astype(int), np.ones(n, int)
+    )
+    np.testing.assert_array_equal(np.asarray(endpos)[:n], np.arange(n))
+    got = _pallas_seg_means(keys, vals)
+    if got is not None:
+        pcnt, pmean = got
+        np.testing.assert_array_equal(pcnt, np.ones(n, np.float32))
+        np.testing.assert_allclose(pmean, vals, rtol=1e-6)
+
+
 def test_runs_longer_than_lcap_are_windowed_not_crashing():
     """Sentinel tail runs exceed lcap by contract; values are garbage but
     the call must not fail and genuine runs stay exact."""

@@ -1,0 +1,419 @@
+"""Warm-start subsystem: shape manifests, the AOT warmup registry, the
+persistent-compile-cache control/accounting, and the measured routing
+table — plus the CLI loop (cold run seeds the manifest, `specpride
+warmup` pre-compiles, the warmed run journals zero fresh compiles and
+byte-identical output)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.io.mgf import write_mgf
+from specpride_tpu.warmstart import (
+    RoutingTable,
+    ShapeEntry,
+    entries_from_seen,
+    load_manifest,
+    merge_manifest,
+)
+from specpride_tpu.warmstart import registry
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(5)
+
+
+def _workload(rng, n=6):
+    clusters = []
+    for i in range(n):
+        m = int(rng.integers(2, 5))
+        base = np.sort(rng.uniform(150, 1500, 50))
+        members = [
+            Spectrum(
+                mz=np.sort(base + rng.normal(0, 0.002, 50)),
+                intensity=rng.uniform(1, 1e4, 50),
+                precursor_mz=400.0, precursor_charge=2, rt=1.0,
+                title=f"w{i};s{k}",
+            )
+            for k in range(m)
+        ]
+        clusters.append(Cluster(f"w{i}", members))
+    return clusters
+
+
+def _write(tmp_path, clusters, name="in.mgf"):
+    path = tmp_path / name
+    write_mgf([s for c in clusters for s in c.members], str(path))
+    return path
+
+
+class TestManifest:
+    def test_round_trip_and_merge_idempotent(self, tmp_path):
+        path = str(tmp_path / "m.json")
+        entries = [
+            ShapeEntry("bin_mean_flat_intensity", (1024, 1024, 1024, 4)),
+            ShapeEntry(
+                "gap_average_compact", (64, 2048, 1536),
+                {"type": "GapAverageConfig", "mz_accuracy": 0.01,
+                 "dyn_range": 1000.0, "min_fraction": 0.5,
+                 "tail_mode": "reference", "pepmass": "lower_median",
+                 "rt": "median"},
+            ),
+        ]
+        assert merge_manifest(path, entries) == 2
+        assert merge_manifest(path, entries) == 2  # union, not append
+        got = load_manifest(path)
+        assert {e.kernel for e in got} == {
+            "bin_mean_flat_intensity", "gap_average_compact"
+        }
+        assert all(isinstance(e.shape_key, tuple) for e in got)
+
+    def test_entries_from_seen_config_binding(self):
+        from specpride_tpu.config import BinMeanConfig
+
+        seen = {
+            ("bin_mean_bucketized", 64, 2048, 1024, 8),
+            ("bin_mean_flat_intensity", 1024, 1024, 1024, 4),
+            ("cosine_flat", 1024, 256, 64, 64, 65536, 4, 256, 256, 4, 32),
+        }
+        entries = entries_from_seen(seen, BinMeanConfig())
+        by_kernel = {e.kernel: e for e in entries}
+        assert by_kernel["bin_mean_bucketized"].config["type"] == (
+            "BinMeanConfig"
+        )
+        assert by_kernel["bin_mean_flat_intensity"].config is None
+        assert by_kernel["cosine_flat"].config is None
+
+    def test_config_keyed_kernel_without_config_is_skipped(self):
+        # a gap kernel recorded while the run's config is bin-mean's
+        # cannot be rebuilt — must be dropped, not mis-recorded
+        from specpride_tpu.config import BinMeanConfig
+
+        entries = entries_from_seen(
+            {("gap_average_compact", 64, 2048, 1536)}, BinMeanConfig()
+        )
+        assert entries == []
+
+    def test_bad_manifest_raises(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text('{"version": 99, "entries": []}')
+        with pytest.raises(ValueError):
+            load_manifest(str(p))
+
+
+class TestRegistry:
+    def test_every_registered_kernel_aot_compiles(self):
+        """Each registry builder must produce a lowerable call on the
+        test platform (the Pallas variants are exercised separately:
+        they only lower on TPU)."""
+        from specpride_tpu.config import BinMeanConfig, GapAverageConfig
+        from specpride_tpu.warmstart.manifest import config_dict
+
+        cases = [
+            ShapeEntry("bin_mean_flat_intensity", (16384, 1024, 1024, 4)),
+            ShapeEntry(
+                "bin_mean_bucketized", (8, 256, 1024, 8),
+                config_dict(BinMeanConfig()),
+            ),
+            ShapeEntry(
+                "gap_average_compact", (8, 256, 1024),
+                config_dict(GapAverageConfig()),
+            ),
+            ShapeEntry("medoid_select_packed", (8, 256, 32, 256)),
+            ShapeEntry("shared_bins_packed", (8, 256, 32, 256)),
+            ShapeEntry("cosine_packed", (8, 256, 256, 32)),
+            ShapeEntry(
+                "cosine_flat",
+                (16384, 256, 64, 64, 65536, 4, 256, 256, 4, 32),
+            ),
+        ]
+        for entry in cases:
+            fn, avals, statics = registry.build(entry)
+            fn.lower(*avals, **statics).compile()
+
+    def test_unknown_kernel_returns_none(self):
+        assert registry.build(ShapeEntry("no_such_kernel", (1,))) is None
+
+    def test_warm_entries_skips_unknown_and_reports(self):
+        from specpride_tpu.warmstart.warmup import warm_entries
+
+        events = []
+
+        class Capture:
+            enabled = True
+
+            def emit(self, event, **fields):
+                events.append({"event": event, **fields})
+                return {}
+
+        results = warm_entries(
+            [
+                ShapeEntry("bin_mean_flat_intensity",
+                           (16384, 1024, 1024, 4)),
+                ShapeEntry("mystery_kernel", (4,)),
+            ],
+            journal=Capture(),
+        )
+        by = {r.entry.kernel: r for r in results}
+        assert by["bin_mean_flat_intensity"].status in (
+            "compiled", "cache_hit"
+        )
+        assert by["mystery_kernel"].status == "skipped"
+        warm_events = [e for e in events if e["event"] == "warmup"]
+        assert len(warm_events) == 2
+        assert all(
+            {"kernel", "cache_hit", "seconds"} <= set(e)
+            for e in warm_events
+        )
+
+
+class TestRouting:
+    def test_static_defaults(self):
+        t = RoutingTable()
+        d = t.decide("gap-average", "cpu")
+        assert (d.path, d.source) == ("host-vectorized", "static")
+        assert t.decide("gap-average", "tpu").path == "xla"
+        assert t.decide("bin-mean", "tpu").path == "xla"
+        assert t.decide("unknown-method", "tpu").path == "xla"
+
+    def test_override_file(self, tmp_path):
+        p = tmp_path / "routing.json"
+        p.write_text(json.dumps({
+            "version": 1,
+            "entries": [{
+                "method": "bin-mean", "platform": "tpu",
+                "path": "pallas", "reason": "pallas_ab: 1.7x",
+            }],
+        }))
+        t = RoutingTable.load(str(p))
+        d = t.decide("bin-mean", "tpu")
+        assert (d.path, d.source) == ("pallas", "override")
+        # untouched decisions keep the static defaults
+        assert t.decide("gap-average", "cpu").path == "host-vectorized"
+
+    def test_bad_explicit_override_fails_loudly(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text('{"version": 1, "entries": [{"method": "x", '
+                     '"platform": "cpu", "path": "warp-drive"}]}')
+        with pytest.raises(SystemExit):
+            RoutingTable.load(str(p))
+
+    def test_backend_consults_table(self, rng):
+        """An override that forces gap-average onto the device on CPU is
+        honored (and journaled with source=override)."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+
+        events = []
+
+        class Capture:
+            enabled = True
+
+            def emit(self, event, **fields):
+                events.append({"event": event, **fields})
+                return {}
+
+        table = RoutingTable(
+            {("gap-average", "cpu"): ("xla", "test-override")}
+        )
+        backend = TpuBackend(layout="bucketized", routing=table)
+        backend.journal = Capture()
+        backend.run_gap_average(_workload(rng, n=3))
+        # the device kernel dispatched (no host reroute)...
+        assert [
+            e for e in events
+            if e["event"] == "dispatch"
+            and e["kernel"] == "gap_average_compact"
+        ]
+        # ...and no host-vectorized routing event was emitted
+        assert not [
+            e for e in events
+            if e["event"] == "routing"
+            and e["path"] == "host-vectorized"
+        ]
+
+    def test_pallas_override_falls_back_off_tpu(self, rng):
+        """path=pallas where Pallas cannot lower → the scan impl runs,
+        and the fallback is journaled."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+        from specpride_tpu.ops import pallas_kernels as pk
+
+        if pk.has_pallas():
+            pytest.skip("test expects a host without Pallas lowering")
+        events = []
+
+        class Capture:
+            enabled = True
+
+            def emit(self, event, **fields):
+                events.append({"event": event, **fields})
+                return {}
+
+        table = RoutingTable({
+            ("gap-average", "cpu"): ("pallas", "forced for test"),
+        })
+        backend = TpuBackend(
+            layout="bucketized", force_device=True, routing=table
+        )
+        backend.journal = Capture()
+        out = backend.run_gap_average(_workload(rng, n=3))
+        assert len(out) == 3
+        assert [
+            e for e in events
+            if e["event"] == "routing" and e["path"] == "xla"
+            and e["reason"] == "pallas-unavailable"
+        ]
+        assert [
+            e for e in events
+            if e["event"] == "dispatch"
+            and e["kernel"] == "gap_average_compact"
+        ]
+
+
+class TestCompileCacheControl:
+    def test_off_and_explicit_dir(self, tmp_path):
+        from specpride_tpu.warmstart import cache
+
+        state = cache.configure_compile_cache("off")
+        assert not state.enabled and state.source == "off"
+        d = str(tmp_path / "cc")
+        state = cache.configure_compile_cache(d)
+        assert state.enabled and state.dir == d and state.source == "flag"
+        import jax
+
+        assert jax.config.jax_compilation_cache_dir == d
+        # explicit dir caches EVERYTHING (the zero-fresh-compiles
+        # guarantee needs fast compiles cached too)
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+
+    def test_counters_delta_shape(self):
+        from specpride_tpu.warmstart import cache
+
+        snap = cache.counters_snapshot()
+        delta = cache.counters_delta(snap)
+        assert set(delta) == {"hits", "misses", "requests", "saved_s"}
+
+
+class TestWarmStartCli:
+    def test_cold_then_warm_zero_fresh_compiles(self, tmp_path, rng):
+        """The acceptance loop, in-process: a cold run against a fresh
+        --compile-cache seeds the manifest and journals fresh compiles;
+        the warmed rerun journals warmup events, ZERO fresh compiles,
+        and byte-identical output."""
+        import jax
+
+        clustered = _write(tmp_path, _workload(rng))
+        cache = str(tmp_path / "cache")
+
+        def run(tag):
+            # drop the in-process jit cache: an earlier test in this
+            # process may have compiled the same shape class, which
+            # would silently absorb the cold run's compile request
+            jax.clear_caches()
+            journal = tmp_path / f"{tag}.jsonl"
+            assert cli_main([
+                "consensus", str(clustered), str(tmp_path / f"{tag}.mgf"),
+                "--method", "bin-mean", "--layout", "flat",
+                "--force-device", "--compile-cache", cache,
+                "--journal", str(journal),
+            ]) == 0
+            return [
+                json.loads(line)
+                for line in journal.read_text().splitlines()
+            ]
+
+        cold = run("cold")
+        end = [e for e in cold if e["event"] == "run_end"][-1]
+        assert end["compile_cache"]["misses"] > 0
+        cc = [e for e in cold if e["event"] == "compile_cache"]
+        assert cc and cc[0]["enabled"] and cc[0]["dir"] == cache
+        manifest = os.path.join(cache, "shape_manifest.json")
+        assert os.path.exists(manifest)
+        assert any(
+            e.kernel == "bin_mean_flat_intensity"
+            for e in load_manifest(manifest)
+        )
+
+        warm = run("warm")
+        end = [e for e in warm if e["event"] == "run_end"][-1]
+        assert end["compile_cache"]["misses"] == 0
+        assert end["compile_cache"]["hits"] > 0
+        warmed = [e for e in warm if e["event"] == "warmup"]
+        assert warmed and all(e["cache_hit"] for e in warmed)
+        assert (tmp_path / "cold.mgf").read_bytes() == (
+            tmp_path / "warm.mgf"
+        ).read_bytes()
+
+    def test_warmup_command_smoke(self, tmp_path, rng):
+        """`specpride warmup MANIFEST` pre-populates a FRESH cache so a
+        first-ever workload run journals zero fresh compiles."""
+        clustered = _write(tmp_path, _workload(rng))
+        cache1 = str(tmp_path / "c1")
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "seed.mgf"),
+            "--method", "bin-mean", "--layout", "flat", "--force-device",
+            "--compile-cache", cache1,
+        ]) == 0
+        manifest = os.path.join(cache1, "shape_manifest.json")
+        cache2 = str(tmp_path / "c2")
+        wu_journal = tmp_path / "wu.jsonl"
+        assert cli_main([
+            "warmup", manifest, "--compile-cache", cache2,
+            "--journal", str(wu_journal),
+        ]) == 0
+        events = [
+            json.loads(line)
+            for line in wu_journal.read_text().splitlines()
+        ]
+        assert [e for e in events if e["event"] == "warmup"]
+        run_journal = tmp_path / "first.jsonl"
+        assert cli_main([
+            "consensus", str(clustered), str(tmp_path / "first.mgf"),
+            "--method", "bin-mean", "--layout", "flat", "--force-device",
+            "--compile-cache", cache2, "--warmup", "off",
+            "--journal", str(run_journal),
+        ]) == 0
+        events = [
+            json.loads(line)
+            for line in run_journal.read_text().splitlines()
+        ]
+        end = [e for e in events if e["event"] == "run_end"][-1]
+        assert end["compile_cache"]["misses"] == 0
+        assert (tmp_path / "seed.mgf").read_bytes() == (
+            tmp_path / "first.mgf"
+        ).read_bytes()
+
+    def test_warmup_manifest_mode_requires_manifest(self, tmp_path, rng):
+        clustered = _write(tmp_path, _workload(rng, n=2))
+        with pytest.raises(SystemExit):
+            cli_main([
+                "consensus", str(clustered), str(tmp_path / "o.mgf"),
+                "--method", "bin-mean",
+                "--compile-cache", str(tmp_path / "empty-cache"),
+                "--warmup", "manifest",
+            ])
+
+    def test_stats_renders_warmstart_line(self, tmp_path, rng, capsys):
+        clustered = _write(tmp_path, _workload(rng, n=3))
+        cache = str(tmp_path / "cache")
+        for tag in ("a", "b"):
+            assert cli_main([
+                "consensus", str(clustered), str(tmp_path / f"{tag}.mgf"),
+                "--method", "bin-mean", "--layout", "flat",
+                "--force-device", "--compile-cache", cache,
+                "--journal", str(tmp_path / f"{tag}.jsonl"),
+            ]) == 0
+        agg = tmp_path / "agg.json"
+        assert cli_main([
+            "stats", str(tmp_path / "b.jsonl"), "--json", str(agg),
+        ]) == 0
+        rendered = capsys.readouterr().out
+        assert "warmstart:" in rendered
+        assert "fresh_compiles=0" in rendered
+        doc = json.loads(agg.read_text())
+        ws = doc["runs"][0]["warmstart"]
+        assert ws["fresh_compiles"] == 0 and ws["kernels_warmed"] >= 1
