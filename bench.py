@@ -585,6 +585,188 @@ def bench_warm_start(clusters, workdir: str) -> dict:
     return {"cache_dir": "fresh per bench invocation", "methods": rows}
 
 
+def bench_serving(
+    clusters, workdir: str, n_serving_clusters: int = 192,
+    seq_runs: int = 4, load_total_jobs: int = 8,
+) -> dict:
+    """``specpride serve`` vs the one-shot CLI — the BENCH_r11
+    acceptance numbers: first-request vs warm-request wall per method
+    through a live daemon, and daemon jobs/sec under a 2- and 8-client
+    closed-loop load generator vs sequential one-shot CLI subprocess
+    runs of the same job.
+
+    The serving workload is a SUBSET of the bench clusters: the
+    daemon's scenario is repeated small/medium jobs, where per-job
+    startup (process + jax import + trace + compile) is the bill being
+    amortized — on one huge job the compute dominates and serving wins
+    nothing by construction.  Device layouts are pinned (bucketized +
+    --force-device, the _WARM_START_METHODS convention) so every method
+    compiles real kernels on any host and the first-vs-warm delta
+    measures the warm-kernel machinery, not a host-path accident."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+    import threading
+
+    from specpride_tpu.io.mgf import write_mgf
+    from specpride_tpu.serve import client as sc
+
+    sub = clusters[: min(n_serving_clusters, len(clusters))]
+    src = os.path.join(workdir, "serving_clustered.mgf")
+    write_mgf([s for c in sub for s in c.members], src)
+    sock = os.path.join(workdir, "serve.sock")
+    cache = os.path.join(workdir, "serve_cache")  # fresh per bench
+    journal = os.path.join(workdir, "serve.jsonl")
+    t_boot0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "specpride_tpu", "serve",
+         "--socket", sock, "--compile-cache", cache,
+         "--layout", "bucketized", "--force-device",
+         "--journal", journal, "--max-queue", "32"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        assert sc.wait_for_socket(sock, timeout=300), "daemon never booted"
+        boot_s = time.perf_counter() - t_boot0
+
+        def served(tag, method, command):
+            out = os.path.join(workdir, f"sv_{tag}.mgf")
+            t0 = time.perf_counter()
+            term = sc.submit_wait(
+                sock, [command, src, out, "--method", method], timeout=600
+            )
+            wall = time.perf_counter() - t0
+            assert term["status"] == "done", (tag, term)
+            return wall, term, out
+
+        rows = []
+        for method, command in _SWEEP_METHODS:
+            tagm = method.replace("-", "_")
+            first_wall, first, _ = served(f"{tagm}_first", method, command)
+            warm_wall, warm, _ = served(f"{tagm}_warm", method, command)
+            row = {
+                "method": method,
+                "first_request_wall_s": round(first_wall, 3),
+                "warm_request_wall_s": round(warm_wall, 3),
+                "warm_speedup": round(first_wall / warm_wall, 3),
+                "first_fresh_compiles": first["compile_cache"]["misses"],
+                "warm_fresh_compiles": warm["compile_cache"]["misses"],
+            }
+            assert row["warm_fresh_compiles"] == 0, row
+            rows.append(row)
+            eprint(
+                f"[serving:{method}] first {first_wall:.2f}s "
+                f"({row['first_fresh_compiles']} fresh compiles) -> warm "
+                f"{warm_wall:.2f}s = {row['warm_speedup']}x"
+            )
+
+        # sequential one-shot CLI baseline: the SAME bin-mean job, a
+        # fresh process per run, against the daemon's (now warm) compile
+        # cache — the fairest baseline: it still pays process + jax
+        # start + in-process trace per run, which is exactly the bill
+        # serving deletes
+        seq_out = os.path.join(workdir, "seq_out.mgf")
+        argv = [
+            sys.executable, "-m", "specpride_tpu", "consensus", src,
+            seq_out, "--method", "bin-mean",
+            "--layout", "bucketized", "--force-device",
+            "--compile-cache", cache,
+        ]
+        seq_walls = []
+        for _ in range(seq_runs):
+            t0 = time.perf_counter()
+            p = subprocess.run(
+                argv, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
+            )
+            assert p.returncode == 0, \
+                p.stderr.decode(errors="replace")[-2000:]
+            seq_walls.append(time.perf_counter() - t0)
+        cli_jobs_per_sec = seq_runs / sum(seq_walls)
+        eprint(
+            f"[serving] sequential one-shot CLI: "
+            f"{cli_jobs_per_sec:.3f} jobs/sec "
+            f"(walls {[round(w, 2) for w in seq_walls]})"
+        )
+
+        load_rows = []
+        for n_clients in (2, 8):
+            jobs_per_client = max(1, load_total_jobs // n_clients)
+            total = jobs_per_client * n_clients
+            errors: list = []
+
+            def _client(cid, jobs_per_client=jobs_per_client,
+                        n_clients=n_clients):
+                try:
+                    for j in range(jobs_per_client):
+                        out = os.path.join(
+                            workdir, f"load_{n_clients}_{cid}_{j}.mgf"
+                        )
+                        term = sc.submit_wait(
+                            sock,
+                            ["consensus", src, out, "--method", "bin-mean"],
+                            timeout=600,
+                            # distinct scheduling identity per simulated
+                            # client, so the load exercises the daemon's
+                            # round-robin fairness, not one-client FIFO
+                            client=f"loadgen-{n_clients}-{cid}",
+                        )
+                        if term.get("status") != "done":
+                            errors.append(term)
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    errors.append(repr(e))
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=_client, args=(c,))
+                for c in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            assert not errors, errors[:3]
+            jobs_per_sec = total / wall
+            load_rows.append({
+                "clients": n_clients,
+                "jobs": total,
+                "wall_s": round(wall, 3),
+                "jobs_per_sec": round(jobs_per_sec, 3),
+                "speedup_vs_sequential_cli": round(
+                    jobs_per_sec / cli_jobs_per_sec, 3
+                ),
+            })
+            eprint(
+                f"[serving] {n_clients}-client closed loop: {total} jobs "
+                f"in {wall:.2f}s = {jobs_per_sec:.3f} jobs/sec "
+                f"({load_rows[-1]['speedup_vs_sequential_cli']}x vs "
+                "sequential CLI)"
+            )
+        # served-vs-CLI byte parity held under load too
+        with open(seq_out, "rb") as fh:
+            cli_bytes = fh.read()
+        with open(os.path.join(workdir, "load_2_0_0.mgf"), "rb") as fh:
+            assert fh.read() == cli_bytes, \
+                "served load output diverged from the one-shot CLI's"
+        proc.send_signal(_signal.SIGTERM)
+        rc = proc.wait(timeout=300)
+        assert rc == 0, f"daemon SIGTERM drain exited {rc}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    return {
+        "n_serving_clusters": len(sub),
+        "boot_s": round(boot_s, 3),
+        "methods": rows,
+        "sequential_cli_wall_s": [round(w, 3) for w in seq_walls],
+        "sequential_cli_jobs_per_sec": round(cli_jobs_per_sec, 3),
+        "load": load_rows,
+        "drain": "SIGTERM exit 0 after load",
+    }
+
+
 def bench_medoid_d2h(clusters) -> dict:
     """Medoid device path D2H bytes: index-only selection
     (``medoid_device_select``, the default) vs the count-matrix fetch it
@@ -825,7 +1007,8 @@ def main() -> None:
         "--sections", default=None, metavar="LIST",
         help="with --report: comma list of report sections to run "
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
-        "prefetch_sweep,worker_sweep,fault_overhead,warm_start,pallas",
+        "prefetch_sweep,worker_sweep,fault_overhead,warm_start,serving,"
+        "pallas",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -849,7 +1032,7 @@ def main() -> None:
     # never produce a silently empty report)
     all_sections = (
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
-        "worker_sweep,fault_overhead,warm_start,pallas"
+        "worker_sweep,fault_overhead,warm_start,serving,pallas"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -990,6 +1173,8 @@ def main() -> None:
                     report["warm_start"] = bench_warm_start(
                         clusters, workdir
                     )
+                if "serving" in secs:
+                    report["serving"] = bench_serving(clusters, workdir)
             if "pallas" in secs:
                 ab = pallas_ab(clusters, report_path=args.report)
                 if ab is not None:

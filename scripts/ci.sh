@@ -290,6 +290,83 @@ print("specpride warmup OK: first-ever run after standalone warmup "
 EOF
 rm -rf "$ws_tmp"
 
+echo "== serve: warm-kernel daemon (boot, parity, warm requests, drain) =="
+# boot the daemon against a FRESH compile cache, run the three methods
+# through it twice (the warm pair of second submissions CONCURRENTLY),
+# and assert: byte parity vs one-shot CLI runs, warm submissions journal
+# ZERO fresh compiles, `stats` renders the serving summary, and SIGTERM
+# drains cleanly (exit 0, complete schema-valid journal)
+sv_tmp=$(mktemp -d)
+SV_IN=tests/data/golden_clustered.mgf
+SOCK="$sv_tmp/serve.sock"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    serve --socket "$SOCK" --compile-cache "$sv_tmp/cache" \
+    --journal "$sv_tmp/serve.jsonl" &
+SV_PID=$!
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$SOCK" <<'EOF'
+import sys
+from specpride_tpu.serve.client import wait_for_socket
+assert wait_for_socket(sys.argv[1], timeout=180), "daemon never came up"
+EOF
+sv_submit() { # $1 = method; $2 = command; $3 = phase
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        submit --socket "$SOCK" -- \
+        "$2" "$SV_IN" "$sv_tmp/served_$1_$3.mgf" --method "$1" \
+        --journal "$sv_tmp/job_$1_$3.jsonl" > /dev/null
+}
+# NOTE: no `set --` here — it would clobber the script's own "$1"
+# (--fast) that the native section below still reads
+for spec in "bin-mean:consensus" "gap-average:consensus" "medoid:select"; do
+    M=${spec%%:*}; CMD=${spec#*:}
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        "$CMD" "$SV_IN" "$sv_tmp/cli_$M.mgf" --method "$M"
+    sv_submit "$M" "$CMD" cold
+    cmp "$sv_tmp/cli_$M.mgf" "$sv_tmp/served_${M}_cold.mgf"
+done
+# warm second submissions; bin-mean + gap-average submitted CONCURRENTLY
+sv_submit bin-mean consensus warm &
+SV_J1=$!
+sv_submit gap-average consensus warm &
+SV_J2=$!
+wait $SV_J1
+wait $SV_J2
+sv_submit medoid select warm
+for M in bin-mean gap-average medoid; do
+    cmp "$sv_tmp/cli_$M.mgf" "$sv_tmp/served_${M}_warm.mgf"
+done
+# the daemon is still LIVE: stats must render the serving summary off
+# the (run_end-less) journal
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$sv_tmp/serve.jsonl" | grep -q "serving:"
+kill -TERM $SV_PID
+SV_RC=0; wait $SV_PID || SV_RC=$?
+test "$SV_RC" -eq 0
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$sv_tmp" <<'EOF'
+import glob, json, os, sys
+tmp = sys.argv[1]
+# warm submissions journal ZERO fresh compiles, per-job (the serving
+# acceptance bar: the daemon's whole point is warm-request latency)
+for path in sorted(glob.glob(os.path.join(tmp, "job_*_warm.jsonl"))):
+    events = [json.loads(l) for l in open(path)]
+    end = [e for e in events if e["event"] == "run_end"][-1]
+    assert end["compile_cache"]["misses"] == 0, \
+        f"{path}: warm served job still compiled {end['compile_cache']}"
+serve = [json.loads(l) for l in open(os.path.join(tmp, "serve.jsonl"))]
+jd = [e for e in serve if e["event"] == "job_done"]
+assert len(jd) == 6 and all(e["status"] == "done" for e in jd), jd
+warm = [e for e in jd[3:]]
+assert all(e["fresh_compiles"] == 0 for e in warm), warm
+# SIGTERM drained cleanly: journal complete and schema-valid
+from specpride_tpu.observability.journal import read_events
+events, violations = read_events(os.path.join(tmp, "serve.jsonl"))
+assert not violations, violations
+names = [e["event"] for e in events]
+assert "serve_drain" in names and names[-1] == "run_end", names[-6:]
+print("serve OK: 6 served jobs byte-identical to CLI, warm jobs 0 fresh "
+      "compiles, clean SIGTERM drain")
+EOF
+rm -rf "$sv_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

@@ -1822,6 +1822,12 @@ def _run_warmup(args, backend, journal) -> None:
     mode = getattr(args, "warmup", "auto")
     if mode == "off" or not hasattr(backend, "_seen_shapes"):
         return  # disabled, or the numpy oracle (nothing to compile)
+    if getattr(args, "_resident_warm", False):
+        # serving daemon: boot already AOT-warmed the manifest and the
+        # resident backend's jit caches hold everything since — a
+        # per-request re-warm would re-lower every manifest entry for
+        # nothing.  (Manifest SAVING still runs: jobs seed future boots.)
+        return
     path = _warmup_manifest_path(args)
     exists = path is not None and os.path.exists(path)
     if mode == "manifest" and not exists:
@@ -1876,6 +1882,16 @@ def _save_shape_manifest(args, backend) -> None:
     seen = getattr(backend, "_seen_shapes", None)
     if not seen:
         return  # numpy backend, or a run that never dispatched
+    snapshot = getattr(args, "_shapes_snapshot", None)
+    if snapshot:
+        # multi-job processes (the serving daemon): persist only THIS
+        # run's new shape classes.  Re-persisting another job's shapes
+        # under this job's method config would mint spurious
+        # (shape, config) manifest entries no dispatch ever performs —
+        # entries a later warmup would then compile for nothing.
+        seen = set(seen) - snapshot
+        if not seen:
+            return
     path = _warmup_manifest_path(args)
     if path is None:
         return
@@ -1951,6 +1967,15 @@ def _open_run_journal(args, backend, command: str, n_clusters: int):
             reason=state.reason, source=state.source,
         )
         args._cc_snapshot = ws_cache.counters_snapshot()
+        # per-run deltas for the OTHER process-wide singletons a
+        # long-lived multi-job process (the serving daemon) accumulates
+        # across jobs: the bucket-plan cache counters and the backend's
+        # seen-shape set.  Snapshot here, diff in _finish_run — never a
+        # reset, which would zero a concurrent consumer's accounting.
+        from specpride_tpu.data.packed import plan_cache_info
+
+        args._plan_snapshot = plan_cache_info()
+        args._shapes_snapshot = set(backend._seen_shapes)
     chrome = getattr(args, "chrome_trace", None)
     if journal.enabled or chrome:
         # spans ride the SAME journal stream as the v1 events; kept in
@@ -1983,6 +2008,21 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         compile_cache = ws_cache.counters_delta(cc_snapshot)
     else:
         compile_cache = None
+    plan_snapshot = args.__dict__.pop("_plan_snapshot", None)
+    if plan_snapshot is not None:
+        from specpride_tpu.data.packed import plan_cache_delta
+
+        plan_cache = plan_cache_delta(plan_snapshot)
+    else:
+        plan_cache = None
+    shapes_snapshot = args.__dict__.pop("_shapes_snapshot", None)
+    if shapes_snapshot is not None:
+        seen = getattr(backend, "_seen_shapes", set())
+        shape_classes = {
+            "new": len(seen - shapes_snapshot), "total": len(seen),
+        }
+    else:
+        shape_classes = None
     journal.emit(
         "run_end",
         counters=dict(stats.counters),
@@ -2006,6 +2046,12 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         # a warmed rerun reports misses == 0 (absent on oracle runs)
         **({"compile_cache": compile_cache} if compile_cache is not None
            else {}),
+        # bucket-plan-cache traffic THIS run caused, and the shape
+        # classes THIS run dispatched first — snapshot-and-diff deltas,
+        # correct even deep into a multi-job serving process
+        **({"plan_cache": plan_cache} if plan_cache is not None else {}),
+        **({"shape_classes": shape_classes} if shape_classes is not None
+           else {}),
     )
     tracer = tracing.current()
     _restore_tracer(args)  # only uninstalls what this run installed
@@ -2023,9 +2069,20 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         logger.info("metrics -> %s", args.metrics_out)
 
 
-def cmd_consensus(args) -> int:
+def _run_pipeline_command(args, command: str, backend=None) -> dict:
+    """THE consensus/select execution body — the one copy both one-shot
+    CLI commands and the serving daemon's job runner execute, so a
+    served job can never drift behaviorally from its CLI equivalent
+    (that parity is what tests/test_serve.py and the ci.sh serve pass
+    byte-compare).
+
+    ``backend``: an already-constructed resident backend (the daemon's
+    warm one, jit caches and seen-shape manifest intact) or None to
+    construct per run from the args as the CLI always did.  Returns the
+    run's stats summary (the CLI prints it on stderr; the daemon ships
+    it in the job's terminal response)."""
     stats = RunStats()
-    if args.method == "bin-mean":
+    if command == "consensus" and args.method == "bin-mean":
         try:
             _bin_mean_config(args)
         except ValueError as e:
@@ -2036,6 +2093,7 @@ def cmd_consensus(args) -> int:
         if getattr(args, "on_error", "abort") == "skip" else None
     )
     args._quarantine = quarantine  # _shard_for_process renames per rank
+    journal = None
     try:
         if _is_mzml(args.input):
             clusters = _clusters_from_mzml(args.input, args, stats)
@@ -2044,7 +2102,7 @@ def cmd_consensus(args) -> int:
                 args.input, stats, getattr(args, "stream_clusters", "off"),
                 quarantine=quarantine,
             )
-        if args.single:
+        if command == "consensus" and args.single:
             # whole file = one cluster; the reference titles the result
             # with the output filename (ref
             # average_spectrum_clustering.py:203-205).  Zero input spectra
@@ -2052,54 +2110,14 @@ def cmd_consensus(args) -> int:
             # backends.
             spectra = [s for c in clusters for s in c.members]
             clusters = [Cluster(args.output, spectra)] if spectra else []
-        backend = _get_backend(args)
-        clusters, args.output = _shard_for_process(clusters, args)
-        journal = _open_run_journal(args, backend, "consensus", len(clusters))
-        if quarantine is not None:
-            quarantine.bind(journal)  # flush blocks found during parse
-        _run_warmup(args, backend, journal)
-        qc = [] if getattr(args, "qc_report", None) else None
-        with device_trace(getattr(args, "trace_dir", None)):
-            resumed, failed, qc_failed = _checkpointed_run(
-                backend, args.method, clusters, args, stats, qc=qc,
-                journal=journal, quarantine=quarantine,
-            )
-        if qc is not None:
-            _write_qc_report(args, backend, clusters, qc, stats, resumed,
-                             failed, qc_failed)
-        _save_shape_manifest(args, backend)
-        logger.info(
-            "consensus done: %.1f clusters/sec", stats.throughput("clusters")
+        if backend is None:
+            backend = _get_backend(args)
+        scores = (
+            _load_scores(args)
+            if command == "select" and args.method == "best" else None
         )
-        _finish_run(args, backend, stats, journal)
-    finally:
-        if quarantine is not None:
-            quarantine.close()
-        _restore_tracer(args)  # no-op after a clean _finish_run
-    print(json.dumps(stats.summary()), file=sys.stderr)
-    return 0
-
-
-def cmd_select(args) -> int:
-    stats = RunStats()
-    _install_tracer_early(args)
-    quarantine = (
-        Quarantine(args.output + ".quarantine.mgf")
-        if getattr(args, "on_error", "abort") == "skip" else None
-    )
-    args._quarantine = quarantine  # _shard_for_process renames per rank
-    try:
-        if _is_mzml(args.input):
-            clusters = _clusters_from_mzml(args.input, args, stats)
-        else:
-            clusters = _load_clusters(
-                args.input, stats, getattr(args, "stream_clusters", "off"),
-                quarantine=quarantine,
-            )
-        backend = _get_backend(args)
-        scores = _load_scores(args) if args.method == "best" else None
         clusters, args.output = _shard_for_process(clusters, args)
-        journal = _open_run_journal(args, backend, "select", len(clusters))
+        journal = _open_run_journal(args, backend, command, len(clusters))
         if quarantine is not None:
             quarantine.bind(journal)  # flush blocks found during parse
         _run_warmup(args, backend, journal)
@@ -2113,12 +2131,34 @@ def cmd_select(args) -> int:
             _write_qc_report(args, backend, clusters, qc, stats, resumed,
                              failed, qc_failed)
         _save_shape_manifest(args, backend)
+        if command == "consensus":
+            logger.info(
+                "consensus done: %.1f clusters/sec",
+                stats.throughput("clusters"),
+            )
         _finish_run(args, backend, stats, journal)
     finally:
         if quarantine is not None:
             quarantine.close()
         _restore_tracer(args)  # no-op after a clean _finish_run
-    print(json.dumps(stats.summary()), file=sys.stderr)
+        if journal is not None:
+            # a failed run must not leak the journal fd: the one-shot
+            # CLI's process exit used to hide this, a serving daemon
+            # running thousands of jobs does not (close() after
+            # _finish_run's own close is a guarded no-op)
+            journal.close()
+    return stats.summary()
+
+
+def cmd_consensus(args) -> int:
+    print(json.dumps(_run_pipeline_command(args, "consensus")),
+          file=sys.stderr)
+    return 0
+
+
+def cmd_select(args) -> int:
+    print(json.dumps(_run_pipeline_command(args, "select")),
+          file=sys.stderr)
     return 0
 
 
@@ -2186,9 +2226,71 @@ def cmd_warmup(args) -> int:
     return 0
 
 
-def cmd_stats(args) -> int:
-    from specpride_tpu.observability.stats_cli import run_stats
+def cmd_serve(args) -> int:
+    """``specpride serve``: boot the warm-kernel consensus daemon and
+    serve consensus/select jobs over a local socket until SIGTERM
+    (graceful drain).  See docs/serving.md."""
+    from specpride_tpu.serve.daemon import ServeDaemon
 
+    return ServeDaemon(
+        args.socket,
+        max_queue=args.max_queue,
+        compile_cache=args.compile_cache,
+        routing_table=args.routing_table,
+        layout=args.layout,
+        force_device=args.force_device,
+        warmup=args.warmup,
+        warmup_manifest=args.warmup_manifest,
+        warmup_jobs=args.warmup_jobs,
+        watchdog_timeout=args.watchdog_timeout,
+        journal_path=args.journal,
+    ).run()
+
+
+def cmd_submit(args) -> int:
+    """``specpride submit -- consensus IN OUT ...``: run one job through
+    a serving daemon.  Streams the daemon's status lines as JSON on
+    stdout; exit code 0 = done, 75 = retriable rejection (queue full /
+    draining — resubmit after backoff), 2 = permanently rejected,
+    1 = job error."""
+    from specpride_tpu.serve import client as serve_client
+
+    job = list(args.job)
+    if job and job[0] == "--":
+        job = job[1:]
+    if not job:
+        raise SystemExit(
+            "submit needs a job argv after --, e.g.: "
+            "specpride submit -- consensus in.mgf out.mgf --method bin-mean"
+        )
+    last = None
+    try:
+        for msg in serve_client.submit(args.socket, job,
+                                       timeout=args.timeout):
+            print(json.dumps(msg), flush=True)
+            last = msg
+    except (OSError, serve_client.ServeError) as e:
+        print(
+            json.dumps({
+                "ok": False, "status": "error",
+                "error": f"{type(e).__name__}: {e}", "retriable": True,
+            }),
+            flush=True,
+        )
+        return 75
+    return serve_client.exit_code(last)
+
+
+def cmd_stats(args) -> int:
+    from specpride_tpu.observability.stats_cli import follow_stats, run_stats
+
+    if getattr(args, "follow", False):
+        if len(args.journals) != 1:
+            raise SystemExit("--follow tails exactly one journal")
+        return follow_stats(
+            args.journals[0], interval=args.interval,
+            top_spans=args.top_spans,
+        )
     return run_stats(
         args.journals, json_out=args.json, top_spans=args.top_spans
     )
@@ -2516,6 +2618,95 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pwu.set_defaults(fn=cmd_warmup)
 
+    psv = sub.add_parser(
+        "serve",
+        help="long-lived warm-kernel daemon: boot once (compile cache + "
+        "AOT shape-manifest warmup), then serve consensus/select jobs "
+        "over a local unix socket at warm-request latency (submit with "
+        "`specpride submit`; SIGTERM drains gracefully)",
+    )
+    psv.add_argument(
+        "--socket", metavar="PATH", default=None,
+        help="unix socket to serve on (default: $SPECPRIDE_SOCKET or "
+        "~/.cache/specpride_tpu/serve.sock)",
+    )
+    psv.add_argument(
+        "--max-queue", type=int, default=16, metavar="N",
+        help="admission bound: total queued jobs across all clients; at "
+        "capacity new submits are rejected with a retriable status "
+        "(default 16)",
+    )
+    psv.add_argument(
+        "--compile-cache", metavar="DIR|off", default=None,
+        help="persistent XLA compilation cache (same resolution as "
+        "consensus/select; resolved ONCE at boot — jobs may not "
+        "override it)",
+    )
+    psv.add_argument(
+        "--routing-table", metavar="FILE",
+        help="bench-derived kernel-routing override file for the "
+        "resident backend",
+    )
+    psv.add_argument(
+        "--layout", choices=["auto", "flat", "bucketized"], default="auto",
+        help="resident backend device layout (jobs may not override)",
+    )
+    psv.add_argument(
+        "--force-device", action="store_true",
+        help="pin device kernels on CPU-only jax (see consensus --help)",
+    )
+    psv.add_argument(
+        "--warmup", choices=["auto", "manifest", "off"], default="auto",
+        help="boot-time AOT warmup from the shape manifest beside the "
+        "compile cache: auto warms when one exists, manifest requires "
+        "one, off skips (default auto)",
+    )
+    psv.add_argument(
+        "--warmup-manifest", metavar="FILE",
+        help="shape manifest path (default: <compile-cache dir>/"
+        "shape_manifest.json)",
+    )
+    psv.add_argument(
+        "--warmup-jobs", type=int, default=0, metavar="N",
+        help="concurrent boot AOT compiles (default: min(8, cores))",
+    )
+    psv.add_argument(
+        "--watchdog-timeout", type=float, default=0.0, metavar="S",
+        help="journal a watchdog_stall when a served job busies the "
+        "execution lane longer than S seconds (default 0 = off)",
+    )
+    psv.add_argument(
+        "--journal", metavar="FILE",
+        help="daemon lifecycle + per-job serving telemetry (serve_start, "
+        "job_queued/job_start/job_done/job_rejected, serve_drain) — "
+        "watch live with `specpride stats --follow`",
+    )
+    psv.set_defaults(fn=cmd_serve)
+
+    psb = sub.add_parser(
+        "submit",
+        help="submit one consensus/select job to a serving daemon and "
+        "stream its status lines (exit 0 done, 75 retriable rejection, "
+        "2 rejected, 1 error)",
+    )
+    psb.add_argument(
+        "--socket", metavar="PATH", default=None,
+        help="daemon socket (default: $SPECPRIDE_SOCKET or "
+        "~/.cache/specpride_tpu/serve.sock)",
+    )
+    psb.add_argument(
+        "--timeout", type=float, default=30.0, metavar="S",
+        help="connect + admission timeout in seconds; once accepted the "
+        "job waits unbounded (default 30)",
+    )
+    psb.add_argument(
+        "job", nargs=argparse.REMAINDER,
+        help="the one-shot CLI argv to run, after --: consensus|select "
+        "INPUT OUTPUT [flags] (daemon-owned flags like --compile-cache "
+        "and --layout are rejected)",
+    )
+    psb.set_defaults(fn=cmd_submit)
+
     pst = sub.add_parser(
         "stats",
         help="summarize run journals (accepts base paths; multi-host "
@@ -2529,6 +2720,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--top-spans", type=int, default=0, metavar="N",
         help="also render the N slowest tracing spans (self time, count, "
         "p50/p99) from the journals' v2 span events",
+    )
+    pst.add_argument(
+        "--follow", action="store_true",
+        help="tail ONE live journal (a serving daemon's or a running "
+        "batch job's) and re-render the summary incrementally as events "
+        "land; Ctrl-C exits",
+    )
+    pst.add_argument(
+        "--interval", type=float, default=1.0, metavar="S",
+        help="poll interval for --follow (default 1s)",
     )
     pst.set_defaults(fn=cmd_stats)
 

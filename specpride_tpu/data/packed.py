@@ -199,6 +199,21 @@ def plan_cache_info() -> dict:
         return dict(_PLAN_CACHE_COUNTS, size=len(_PLAN_CACHE))
 
 
+def plan_cache_delta(since: dict) -> dict:
+    """Per-run view of the process-wide plan-cache counters: hits and
+    misses since ``since`` (a ``plan_cache_info()`` snapshot), plus the
+    absolute cache size.  Snapshot-and-diff, never reset: a long-lived
+    multi-job process (the serving daemon) must attribute traffic to
+    the job that caused it without zeroing another job's accounting
+    mid-run."""
+    now = plan_cache_info()
+    return {
+        "hits": now["hits"] - int(since.get("hits", 0)),
+        "misses": now["misses"] - int(since.get("misses", 0)),
+        "size": now["size"],
+    }
+
+
 def clear_plan_cache() -> None:
     with _PLAN_CACHE_LOCK:
         _PLAN_CACHE.clear()

@@ -74,6 +74,16 @@ EVENT_FIELDS: dict[str, frozenset] = {
     # one AOT bucket-shape warmup compile: persistent-cache hit vs a
     # fresh XLA compile, and how long it took
     "warmup": frozenset({"kernel", "cache_hit", "seconds"}),
+    # serving daemon (specpride_tpu.serve): lifecycle + per-job
+    # telemetry.  The daemon's own journal is one run (run_start
+    # command="serve" ... run_end at drain) with these in between;
+    # each JOB additionally writes its own --journal like any CLI run.
+    "serve_start": frozenset({"socket", "max_queue"}),
+    "job_queued": frozenset({"job_id", "client"}),
+    "job_start": frozenset({"job_id"}),
+    "job_done": frozenset({"job_id", "status", "wall_s"}),
+    "job_rejected": frozenset({"reason"}),
+    "serve_drain": frozenset({"n_rejected"}),
     "bench_run": frozenset({"method", "phases_s"}),
     "run_end": frozenset({"counters", "phases_s", "elapsed_s", "device"}),
     # v2: one finished tracing span (observability.tracing).  The span's
@@ -130,7 +140,11 @@ class Journal:
         rec.update(fields)
         line = json.dumps(rec, default=_json_default) + "\n"
         with self._lock:
-            self._fh.write(line)
+            # a multi-thread producer (the serving daemon's reader
+            # threads) may race close(); dropping a late event beats
+            # crashing the thread on a closed file
+            if not self._fh.closed:
+                self._fh.write(line)
         return rec
 
     def close(self) -> None:

@@ -16,7 +16,11 @@ from __future__ import annotations
 import json
 import sys
 
-from specpride_tpu.observability.journal import expand_parts, read_events
+from specpride_tpu.observability.journal import (
+    expand_parts,
+    read_events,
+    validate_event,
+)
 from specpride_tpu.observability.tracing import (
     aggregate_spans,
     render_top_spans,
@@ -107,7 +111,64 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
             ws["fresh_compiles"] = cc.get("misses", 0)
             ws["cache_hits"] = cc.get("hits", 0)
             ws["compile_s_saved"] = cc.get("saved_s", 0.0)
+        # per-run snapshot-and-diff deltas of the process-wide
+        # singletons (meaningful in multi-job serving processes):
+        # bucket-plan-cache traffic and first-dispatch shape classes
+        pc = (end or {}).get("plan_cache")
+        if pc:
+            ws["plan_cache_hits"] = pc.get("hits", 0)
+            ws["plan_cache_misses"] = pc.get("misses", 0)
+        sc = (end or {}).get("shape_classes")
+        if sc:
+            ws["new_shape_classes"] = sc.get("new", 0)
         run["warmstart"] = ws
+    # serving daemon journal (command == "serve"): per-job telemetry
+    # rolled up into the operator's at-a-glance serving summary
+    serve_ev = next(
+        (e for e in events if e["event"] == "serve_start"), None
+    )
+    jobs = [e for e in events if e["event"] == "job_done"]
+    rejected = [e for e in events if e["event"] == "job_rejected"]
+    if serve_ev or jobs or rejected:
+        sv: dict = {
+            "jobs_done": sum(1 for e in jobs if e.get("status") == "done"),
+            "jobs_failed": sum(
+                1 for e in jobs if e.get("status") != "done"
+            ),
+            "jobs_rejected": len(rejected),
+        }
+        if serve_ev:
+            sv["socket"] = serve_ev.get("socket")
+            sv["warmed_kernels"] = serve_ev.get("warmed_kernels", 0)
+        walls = [e["wall_s"] for e in jobs]
+        if walls:
+            sv["mean_wall_s"] = round(sum(walls) / len(walls), 4)
+            sv["max_wall_s"] = round(max(walls), 4)
+        waits = [
+            e["queue_wait_s"] for e in jobs
+            if isinstance(e.get("queue_wait_s"), (int, float))
+        ]
+        if waits:
+            sv["max_queue_wait_s"] = round(max(waits), 4)
+        # warm jobs: requests that journaled ZERO fresh XLA compiles —
+        # the serving acceptance number (steady state should be 100%)
+        fresh = [
+            e["fresh_compiles"] for e in jobs
+            if isinstance(e.get("fresh_compiles"), int)
+        ]
+        if fresh:
+            sv["warm_jobs"] = sum(1 for f in fresh if f == 0)
+        monos = [
+            e["mono"] for e in jobs if isinstance(e.get("mono"), (int, float))
+        ]
+        anchor = (
+            serve_ev.get("mono") if serve_ev else (min(monos) if monos else 0)
+        )
+        if jobs and isinstance(anchor, (int, float)):
+            span = max(monos) - anchor if monos else 0.0
+            if span > 0:
+                sv["jobs_per_sec"] = round(len(jobs) / span, 3)
+        run["serving"] = sv
     if start:
         run.update(
             command=start.get("command"),
@@ -160,6 +221,27 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
     return run
 
 
+def _render_serving(sv: dict, out) -> None:
+    """The serving daemon's at-a-glance line: job outcomes, warm-request
+    count (jobs with zero fresh compiles), latency and queue pressure."""
+    bits = [
+        f"jobs_done={sv.get('jobs_done', 0)}",
+        f"failed={sv.get('jobs_failed', 0)}",
+        f"rejected={sv.get('jobs_rejected', 0)}",
+    ]
+    if "warm_jobs" in sv:
+        bits.append(f"warm={sv['warm_jobs']}")
+    if "mean_wall_s" in sv:
+        bits.append(f"mean_wall_s={sv['mean_wall_s']}")
+    if "max_queue_wait_s" in sv:
+        bits.append(f"max_queue_wait_s={sv['max_queue_wait_s']}")
+    if "jobs_per_sec" in sv:
+        bits.append(f"jobs_per_sec={sv['jobs_per_sec']}")
+    if "warmed_kernels" in sv:
+        bits.append(f"warmed_kernels={sv['warmed_kernels']}")
+    print(f"  serving: {' '.join(bits)}", file=out)
+
+
 def _render_run(run: dict, out) -> None:
     head = (
         f"{run['journal']}: {run.get('command', '?')}"
@@ -167,9 +249,12 @@ def _render_run(run: dict, out) -> None:
     )
     print(head, file=out)
     if not run["complete"]:
+        live = run.get("serving")
         print(
-            "  INCOMPLETE — no run_end event (crashed or still running); "
-            f"{run['chunks']} chunk(s) journaled", file=out,
+            "  INCOMPLETE — no run_end event ("
+            + ("live daemon or crashed" if live else "crashed or still "
+               "running")
+            + f"); {run['chunks']} chunk(s) journaled", file=out,
         )
         if "last_chunk" in run:
             lc = run["last_chunk"]
@@ -178,6 +263,8 @@ def _render_run(run: dict, out) -> None:
                 f"({lc['n_clusters']} clusters, "
                 f"{lc['clusters_per_sec']:.1f} cl/s)", file=out,
             )
+        if live:
+            _render_serving(live, out)
         return
     counters = run.get("counters", {})
     print(
@@ -221,6 +308,8 @@ def _render_run(run: dict, out) -> None:
                 f"reorder_stall_s={run.get('reorder_stall_s', 0.0):.3f}",
                 file=out,
             )
+    if run.get("serving"):
+        _render_serving(run["serving"], out)
     ws = run.get("warmstart")
     if ws:
         bits = []
@@ -236,6 +325,13 @@ def _render_run(run: dict, out) -> None:
                 f"cache_hits={ws['cache_hits']} "
                 f"compile_s_saved={ws['compile_s_saved']}"
             )
+        if "plan_cache_hits" in ws:
+            bits.append(
+                f"plan_cache={ws['plan_cache_hits']}h/"
+                f"{ws['plan_cache_misses']}m"
+            )
+        if "new_shape_classes" in ws:
+            bits.append(f"new_shape_classes={ws['new_shape_classes']}")
         if "cache_dir" in ws:
             bits.append(f"cache={ws['cache_dir']}")
         print(f"  warmstart: {' '.join(bits)}", file=out)
@@ -273,6 +369,94 @@ def _render_run(run: dict, out) -> None:
         f"h2d={run['bytes_h2d']}B d2h={run['bytes_d2h']}B "
         f"peak_device_mem={run['device_peak_bytes_in_use']}B", file=out,
     )
+
+
+def _read_new_events(path: str, offset: int) -> tuple[list[dict], int]:
+    """Complete journal lines past ``offset`` -> (events, new offset).
+
+    Reads only up to the LAST newline, so a line the writer is mid-way
+    through never parses torn — it is consumed whole on a later poll.
+    A missing file (daemon not booted yet) is simply "nothing new";
+    schema-invalid lines are skipped (a live tail must keep rendering,
+    the strict exit-nonzero audit belongs to one-shot ``stats``)."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(offset)
+            blob = fh.read()
+    except FileNotFoundError:
+        return [], offset
+    end = blob.rfind(b"\n")
+    if end < 0:
+        return [], offset
+    chunk = blob[: end + 1]
+    events: list[dict] = []
+    for line in chunk.decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not validate_event(rec):
+            events.append(rec)
+    return events, offset + len(chunk)
+
+
+def follow_stats(
+    path: str, out=None, interval: float = 1.0, stop=None,
+    max_updates: int = 0, top_spans: int = 0,
+) -> int:
+    """``specpride stats --follow``: tail ONE live journal (a serving
+    daemon's or a running batch job's) and re-render the summary every
+    time new complete events land — an operator watches a daemon
+    without restarting ``stats`` per look.
+
+    Renders the LAST run segment in the journal (the live one; a
+    journal reopened across runs holds several).  ``stop`` (a
+    ``threading.Event``) and ``max_updates`` are programmatic exits for
+    tests; interactively Ctrl-C exits 0."""
+    import time as _time
+
+    out = out or sys.stdout
+    offset = 0
+    events: list[dict] = []
+    updates = 0
+    try:
+        while True:
+            new_events, offset = _read_new_events(path, offset)
+            if new_events:
+                events.extend(new_events)
+                # only the LAST run segment is ever rendered: drop the
+                # prefix before the most recent run_start so a days-long
+                # daemon tail stays O(current run), not O(uptime)
+                for i in range(len(events) - 1, 0, -1):
+                    if events[i]["event"] == "run_start":
+                        del events[:i]
+                        break
+                updates += 1
+                segments = _split_runs(events) or [[]]
+                stamp = _time.strftime("%H:%M:%S")
+                print(
+                    f"--- {stamp} update {updates}: {len(events)} "
+                    f"event(s) ---", file=out,
+                )
+                _render_run(_summarize_run(path, segments[-1]), out)
+                if top_spans:
+                    render_top_spans(
+                        aggregate_spans([events]), top_spans, out
+                    )
+                try:
+                    out.flush()
+                except (AttributeError, OSError):
+                    pass
+            if stop is not None and stop.is_set():
+                return 0
+            if max_updates and updates >= max_updates:
+                return 0
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def run_stats(

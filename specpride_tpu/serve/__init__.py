@@ -1,0 +1,33 @@
+"""Consensus-as-a-service: the ``specpride serve`` daemon (ROADMAP
+item 1).
+
+Every one-shot CLI run pays parse + trace + XLA compile + lane spin-up
+from cold.  This package turns the pipeline into a long-lived process
+that pays those costs ONCE — at boot it resolves the persistent compile
+cache and AOT-warms the shape manifest (reusing ``warmstart``), then
+holds the backend, routing table, bucket-plan cache and jit caches
+resident — and serves consensus/select jobs over a local unix socket at
+warm-request latency:
+
+* ``protocol`` — the JSON-lines request/response wire format and the
+  job-validation rules (which flags the daemon owns vs the job);
+* ``scheduler`` — the bounded admission queue with FIFO-fair
+  round-robin scheduling across concurrent clients;
+* ``daemon`` — boot / accept / execute / drain lifecycle (SIGTERM
+  drains: in-flight jobs commit through the ordered write lane, queued
+  jobs are rejected with a retriable status);
+* ``client`` — the thin ``specpride submit`` client.
+
+Jobs run through the exact CLI execution body
+(``cli._run_pipeline_command``) with the daemon's resident backend, so
+served output is byte-identical to the one-shot CLI's — the parity the
+test suite and CI enforce.
+"""
+
+from specpride_tpu.serve.protocol import (  # noqa: F401
+    DAEMON_ONLY_FLAGS,
+    PROTOCOL_VERSION,
+    SERVABLE_COMMANDS,
+    default_socket_path,
+)
+from specpride_tpu.serve.scheduler import AdmissionQueue  # noqa: F401

@@ -1,0 +1,135 @@
+"""Thin client for the serve daemon: ``specpride submit`` and the
+helpers tests/bench drive directly.
+
+``submit`` is a generator so callers can stream the admission line
+("accepted", with the queue depth) before the job finishes — an
+operator watching a loaded daemon sees immediately whether the job
+queued or was rejected, then waits only for the terminal line.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+from specpride_tpu.serve import protocol
+
+
+class ServeError(RuntimeError):
+    """The daemon broke the protocol (connection torn mid-job, non-JSON
+    line).  Transient from the client's point of view: the job may well
+    have completed server-side — resubmitting is safe only because
+    served jobs are idempotent (same argv -> same bytes)."""
+
+
+def _connect(socket_path: str | None, timeout: float | None):
+    path = socket_path or protocol.default_socket_path()
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    sock.connect(path)
+    return sock
+
+
+def request(
+    socket_path: str | None, payload: dict, timeout: float | None = 30.0
+) -> dict:
+    """One-shot ops (``ping`` / ``status``): send, read one reply."""
+    sock = _connect(socket_path, timeout)
+    try:
+        fh = sock.makefile("rw", encoding="utf-8", newline="\n")
+        protocol.write_msg(fh, **payload)
+        msg = protocol.read_msg(fh)
+        if msg is None:
+            raise ServeError("daemon closed the connection without a reply")
+        return msg
+    finally:
+        sock.close()
+
+
+def _default_client_id() -> str:
+    """One submitting PROCESS = one scheduling client: the daemon's
+    FIFO-fair round-robin keys on this, so a process bursting jobs
+    interleaves with its neighbours instead of monopolizing the queue
+    (each job is its own connection, so without an explicit identity
+    every job would look like a distinct one-job client and fairness
+    would degenerate to global FIFO)."""
+    import os
+
+    return f"{os.getuid()}.{os.getpid()}"
+
+
+def submit(
+    socket_path: str | None, argv: list[str], timeout: float | None = 30.0,
+    client: str | None = None,
+):
+    """Submit one job; yield every server message (admission line first,
+    terminal line last).  ``timeout`` bounds connect + admission only —
+    once the job is accepted the wait is unbounded (it may legitimately
+    sit behind other clients' jobs).  ``client`` overrides the
+    per-process scheduling identity (load generators simulating
+    distinct tenants)."""
+    sock = _connect(socket_path, timeout)
+    try:
+        fh = sock.makefile("rw", encoding="utf-8", newline="\n")
+        protocol.write_msg(
+            fh, op="submit", argv=list(argv),
+            client=client or _default_client_id(),
+        )
+        while True:
+            try:
+                msg = protocol.read_msg(fh)
+            except (ValueError, json.JSONDecodeError) as e:
+                raise ServeError(f"bad protocol line from daemon: {e}")
+            if msg is None:
+                raise ServeError("connection closed before a terminal "
+                                 "response (daemon killed mid-job?)")
+            yield msg
+            status = msg.get("status")
+            if status == "accepted":
+                sock.settimeout(None)  # the job may queue; wait it out
+            if status in ("done", "error", "rejected"):
+                return
+    finally:
+        sock.close()
+
+
+def submit_wait(
+    socket_path: str | None, argv: list[str], timeout: float | None = 30.0,
+    client: str | None = None,
+) -> dict:
+    """Submit and return only the terminal message."""
+    last: dict = {}
+    for last in submit(socket_path, argv, timeout=timeout, client=client):
+        pass
+    return last
+
+
+def exit_code(msg: dict | None) -> int:
+    """Map a terminal message to a shell exit code: done -> the job's
+    rc; retriable rejection/error -> 75 (``EX_TEMPFAIL``, resubmit
+    later); permanent rejection -> 2 (usage); permanent error -> 1."""
+    if not msg:
+        return 1
+    status = msg.get("status")
+    if status == "done":
+        return int(msg.get("rc", 0))
+    if msg.get("retriable"):
+        return protocol.EX_TEMPFAIL
+    return 2 if status == "rejected" else 1
+
+
+def wait_for_socket(
+    socket_path: str | None, timeout: float = 60.0, interval: float = 0.1
+) -> bool:
+    """Poll until the daemon answers a ``ping`` (boot can take a while:
+    jax import + AOT warmup).  False on timeout."""
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        try:
+            if request(socket_path, {"op": "ping"}, timeout=2.0).get("ok"):
+                return True
+        except (OSError, ServeError):
+            pass
+        time.sleep(interval)
+    return False

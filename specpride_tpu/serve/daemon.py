@@ -1,0 +1,639 @@
+"""``specpride serve``: the warm-kernel consensus daemon.
+
+Lifecycle (documented in docs/serving.md):
+
+* **boot** — resolve the persistent compile cache ONCE
+  (``--compile-cache``), load the routing table, construct the resident
+  ``TpuBackend``, and AOT-warm the shape manifest beside the cache
+  (reusing ``warmstart.warmup`` — the same pass ``specpride warmup``
+  runs), so the first request already hits compiled kernels.  Then bind
+  the unix socket and start accepting.
+* **serve** — each connection is one job: the reader thread validates
+  the argv with the CLI's own parser and offers it to the bounded
+  FIFO-fair :class:`~specpride_tpu.serve.scheduler.AdmissionQueue`;
+  the single execution worker pops jobs and runs them through the exact
+  CLI execution body (``cli._run_pipeline_command``) with the resident
+  backend — the three-lane executor, per-job journal, per-job
+  ``run_end`` stats and the robustness harness all behave exactly as
+  one-shot runs, so served output is byte-identical to the CLI's.
+* **drain** — SIGTERM (or SIGINT): stop accepting, reject every
+  *queued* job with a retriable status, let the *in-flight* job commit
+  through its ordered write lane, journal ``serve_drain`` +
+  ``run_end``, remove the socket, exit 0.
+
+Per-job resident-backend hygiene: jobs serialize on the execution lane,
+and between jobs the worker resets exactly the per-run backend state —
+metrics registry, run stats, journal hook, routing-note memo — while
+the warm state (jit caches, ``_seen_shapes``, plan cache, persistent
+compile cache) stays resident.  Per-job deltas of the process-wide
+singletons are snapshot-and-diffed by ``cli._open_run_journal`` /
+``_finish_run`` (never reset mid-run), so every job's ``run_end``
+reports its own compile/plan-cache traffic even deep into a long-lived
+process.
+
+Robustness: the request loop is guarded by the shared error taxonomy —
+transient socket errors on accept retry with a short backoff instead of
+killing the daemon, execution errors are classified
+retriable-vs-permanent in the terminal response, and
+``--watchdog-timeout`` arms the per-lane watchdog over the execution
+lane (a wedged job journals ``watchdog_stall`` with the lane name).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+from specpride_tpu.observability import (
+    MetricsRegistry,
+    RunStats,
+    device_summary,
+    logger,
+    open_journal,
+)
+from specpride_tpu.robustness import errors as rb_errors
+from specpride_tpu.robustness.watchdog import Watchdog
+from specpride_tpu.serve import protocol
+from specpride_tpu.serve.scheduler import AdmissionQueue
+
+
+class Job:
+    """One admitted request: parsed args + the connection awaiting the
+    terminal response."""
+
+    __slots__ = (
+        "job_id", "client", "argv", "args", "command", "conn", "fh",
+        "t_enqueued", "ack",
+    )
+
+    def __init__(self, job_id, client, argv, args, command, conn, fh):
+        self.job_id = job_id
+        self.client = client
+        self.argv = argv
+        self.args = args
+        self.command = command
+        self.conn = conn
+        self.fh = fh
+        self.t_enqueued = time.perf_counter()
+        # set once the reader has WRITTEN the "accepted" line: the
+        # worker (or drain) waits on it before the terminal line, so
+        # the two threads can never interleave bytes on one connection
+        self.ack = threading.Event()
+
+
+class ServeDaemon:
+    def __init__(
+        self,
+        socket_path: str | None = None,
+        *,
+        max_queue: int = 16,
+        compile_cache: str | None = None,
+        routing_table: str | None = None,
+        layout: str = "auto",
+        force_device: bool = False,
+        warmup: str = "auto",
+        warmup_manifest: str | None = None,
+        warmup_jobs: int = 0,
+        watchdog_timeout: float = 0.0,
+        journal_path: str | None = None,
+    ):
+        self.socket_path = socket_path or protocol.default_socket_path()
+        self.compile_cache = compile_cache
+        self.routing_table = routing_table
+        self.layout = layout
+        self.force_device = force_device
+        self.warmup = warmup
+        self.warmup_manifest = warmup_manifest
+        self.warmup_jobs = warmup_jobs
+        self.queue = AdmissionQueue(max_queue)
+        self.journal_path = journal_path
+        self.journal = None
+        self.backend = None
+        self.watchdog = Watchdog(watchdog_timeout)
+        self.warmed_kernels = 0
+        self.jobs_done = 0
+        self.jobs_failed = 0
+        self.jobs_rejected = 0
+        self._job_ids = iter(range(1, 1 << 62)).__next__
+        self._listener: socket.socket | None = None
+        self._stop = threading.Event()
+        self._draining = False
+        self._drain_lock = threading.Lock()
+        self._t_boot = 0.0
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="specpride-serve-worker",
+            daemon=True,
+        )
+        # test seam: the worker waits on this gate between popping a job
+        # and executing it, so drain-with-in-flight-work is testable
+        # deterministically (set by default — production never waits);
+        # _inflight is the popped-but-not-yet-replied job, observable by
+        # the same tests
+        self._gate = threading.Event()
+        self._gate.set()
+        self._inflight: Job | None = None
+
+    # -- boot -----------------------------------------------------------
+
+    def boot(self) -> "ServeDaemon":
+        """Pay every cold-start cost once, before the socket exists."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+        from specpride_tpu.warmstart import cache as ws_cache
+        from specpride_tpu.warmstart.routing import RoutingTable
+
+        self._t_boot = time.perf_counter()
+        self.journal = open_journal(self.journal_path)
+        self.journal.emit(
+            "run_start", command="serve", method="serve", backend="tpu",
+            n_clusters=0, socket=self.socket_path,
+        )
+        ws_cache.configure_compile_cache(self.compile_cache)
+        state = ws_cache.cache_state()
+        self.journal.emit(
+            "compile_cache", enabled=state.enabled, dir=state.dir,
+            reason=state.reason, source=state.source,
+        )
+        self.watchdog.journal = self.journal
+        routing = RoutingTable.load(self.routing_table)
+        self.backend = TpuBackend(
+            layout=self.layout, force_device=self.force_device,
+            routing=routing,
+        )
+        self._boot_warmup(state)
+        sock_dir = os.path.dirname(self.socket_path)
+        if sock_dir:
+            os.makedirs(sock_dir, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            # a stale socket from a dead daemon blocks bind(); a LIVE
+            # daemon must not be evicted silently
+            if self._socket_alive():
+                raise SystemExit(
+                    f"another daemon is serving on {self.socket_path} "
+                    "(pass a different --socket, or stop it first)"
+                )
+            os.unlink(self.socket_path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(64)
+        # a blocked accept() is NOT reliably interrupted by close() from
+        # another thread (drain(), the in-process test path) — poll on a
+        # short timeout so the stop flag is always observed promptly
+        self._listener.settimeout(0.5)
+        boot_s = time.perf_counter() - self._t_boot
+        self.journal.emit(
+            "serve_start", socket=self.socket_path,
+            max_queue=self.queue.capacity,
+            warmed_kernels=self.warmed_kernels,
+            boot_s=round(boot_s, 4),
+        )
+        logger.info(
+            "serving on %s (boot %.2fs, %d kernel variants warmed, "
+            "queue depth %d)", self.socket_path, boot_s,
+            self.warmed_kernels, self.queue.capacity,
+        )
+        return self
+
+    def _socket_alive(self) -> bool:
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        probe.settimeout(1.0)
+        try:
+            probe.connect(self.socket_path)
+            return True
+        except OSError:
+            return False
+        finally:
+            probe.close()
+
+    def _boot_warmup(self, state) -> None:
+        """AOT-warm the shape manifest once, at boot — the per-request
+        path never compiles what a previous process already recorded."""
+        if self.warmup == "off":
+            return
+        from specpride_tpu.warmstart.manifest import (
+            DEFAULT_BASENAME,
+            load_manifest,
+        )
+        from specpride_tpu.warmstart.warmup import warm_entries
+
+        path = self.warmup_manifest
+        if path is None and state.enabled and state.dir:
+            path = os.path.join(state.dir, DEFAULT_BASENAME)
+        if path is None or not os.path.exists(path):
+            if self.warmup == "manifest":
+                raise SystemExit(
+                    "serve --warmup manifest: no shape manifest at "
+                    f"{path or '<no --warmup-manifest and no compile cache>'}"
+                )
+            logger.info(
+                "serve: no shape manifest yet (%s); first requests will "
+                "seed it", path,
+            )
+            return
+        try:
+            entries = load_manifest(path)
+        except (OSError, ValueError) as e:
+            if self.warmup == "manifest":
+                raise SystemExit(f"unreadable shape manifest {path}: {e}")
+            logger.warning("ignoring shape manifest %s (%s)", path, e)
+            return
+        results = warm_entries(
+            entries, journal=self.journal, jobs=self.warmup_jobs
+        )
+        self.warmed_kernels = len(results)
+
+    # -- request loop ---------------------------------------------------
+
+    def run(self) -> int:
+        """Boot, then serve until SIGTERM/SIGINT (or :meth:`drain` from
+        another thread, the in-process test path)."""
+        self.boot()
+        if threading.current_thread() is threading.main_thread():
+            import signal
+
+            signal.signal(signal.SIGTERM, self._on_signal)
+            signal.signal(signal.SIGINT, self._on_signal)
+        self._worker.start()
+        try:
+            self._accept_loop()
+        finally:
+            self.drain()
+        return 0
+
+    def _on_signal(self, signum, frame) -> None:
+        logger.info("signal %d: draining", signum)
+        self._stop.set()
+        # closing the listener pops the accept loop out of accept();
+        # the run() finally performs the actual drain
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except TimeoutError:
+                continue  # poll tick: re-check the stop flag
+            except OSError as e:
+                if self._stop.is_set():
+                    return
+                # the retry taxonomy guards the request loop: a
+                # transient accept failure (EMFILE burst, interrupted
+                # call) backs off instead of killing the daemon
+                if rb_errors.is_transient(e):
+                    logger.warning("accept failed transiently (%s)", e)
+                    time.sleep(0.1)
+                    continue
+                raise
+            t = threading.Thread(
+                target=self._handle_conn, args=(conn,),
+                name="specpride-serve-reader", daemon=True,
+            )
+            t.start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        # bound the ADMISSION read: a client that connects and goes
+        # silent must not pin a reader thread forever.  Execution-side
+        # waits are unaffected (the worker only writes).
+        conn.settimeout(60.0)
+        fh = conn.makefile("rw", encoding="utf-8", newline="\n")
+        keep_open = False
+        try:
+            try:
+                msg = protocol.read_msg(fh)
+            except ValueError as e:
+                protocol.write_msg(
+                    fh, ok=False, status="rejected",
+                    reason=f"bad message: {e}", retriable=False,
+                )
+                return
+            if msg is None:
+                return
+            op = msg.get("op")
+            if op == "ping":
+                protocol.write_msg(
+                    fh, ok=True, status="pong", v=protocol.PROTOCOL_VERSION,
+                )
+            elif op == "status":
+                protocol.write_msg(fh, ok=True, **self.status())
+            elif op == "submit":
+                keep_open = self._admit(msg, conn, fh)
+            else:
+                protocol.write_msg(
+                    fh, ok=False, status="rejected",
+                    reason=f"unknown op {op!r}", retriable=False,
+                )
+        except OSError as e:
+            logger.warning("connection died during admission: %s", e)
+        finally:
+            if not keep_open:
+                self._close(conn, fh)
+
+    def _admit(self, msg: dict, conn, fh) -> bool:
+        """Validate + enqueue one submit.  Returns True when the worker
+        now owns the connection (it sends the terminal response)."""
+        argv = msg.get("argv")
+        job_id = self._job_ids()
+
+        def reject(reason: str, retriable: bool) -> bool:
+            self.jobs_rejected += 1
+            self.journal.emit(
+                "job_rejected", job_id=job_id, reason=reason,
+                retriable=retriable,
+            )
+            protocol.write_msg(
+                fh, ok=False, status="rejected", job_id=job_id,
+                reason=reason, retriable=retriable,
+            )
+            return False
+
+        if not isinstance(argv, list) or not all(
+            isinstance(a, str) for a in argv
+        ):
+            return reject("argv must be a list of strings", False)
+        client = msg.get("client")
+        if client is not None and not isinstance(client, str):
+            # the scheduling key must be hashable and sane; an array/
+            # object here would TypeError inside the queue otherwise
+            return reject("client must be a string", False)
+        if self._draining or self._stop.is_set():
+            return reject("draining", True)
+        if not argv or argv[0] not in protocol.SERVABLE_COMMANDS:
+            return reject(
+                f"command must be one of {list(protocol.SERVABLE_COMMANDS)}",
+                False,
+            )
+        forbidden = protocol.forbidden_flags(argv)
+        if forbidden:
+            return reject(
+                f"daemon-owned flags on a job: {forbidden} (set them on "
+                "`specpride serve` at boot)", False,
+            )
+        try:
+            args = _parse_job_argv(argv)
+        except ValueError as e:
+            return reject(str(e), False)
+        overridden = protocol.overridden_daemon_flags(args)
+        if overridden:
+            # abbreviation-proof: argparse accepts unambiguous prefixes
+            # (--layou), which the token scan above cannot see — the
+            # parsed namespace is the truth
+            return reject(
+                f"daemon-owned flags on a job: {overridden} (set them on "
+                "`specpride serve` at boot)", False,
+            )
+        job = Job(job_id, client or id(conn), argv, args,
+                  argv[0], conn, fh)
+        if not self.queue.offer(job.client, job):
+            return reject(
+                "draining" if self._draining else "queue_full", True
+            )
+        self.journal.emit(
+            "job_queued", job_id=job_id, client=str(job.client),
+            command=job.command, method=getattr(args, "method", None),
+        )
+        try:
+            protocol.write_msg(
+                fh, ok=True, status="accepted", job_id=job_id,
+                queue_depth=len(self.queue),
+            )
+        finally:
+            job.ack.set()  # even on a dead client the worker must not wait
+        return True
+
+    # -- execution lane -------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        from specpride_tpu.warmstart import cache as ws_cache
+
+        while True:
+            job = self.queue.pop()
+            if job is None:
+                return
+            self._inflight = job
+            self._gate.wait()
+            wait_s = time.perf_counter() - job.t_enqueued
+            self.journal.emit(
+                "job_start", job_id=job.job_id, command=job.command,
+                method=getattr(job.args, "method", None),
+                queue_wait_s=round(wait_s, 4),
+            )
+            t0 = time.perf_counter()
+            cc0 = ws_cache.counters_snapshot()
+            status, rc, err, retriable, summary = "done", 0, None, False, None
+            try:
+                with self.watchdog.section("serve:job"):
+                    summary = self._execute(job)
+            except SystemExit as e:
+                # CLI-style usage/abort error (bad input file, refused
+                # resume): permanent from the daemon's point of view
+                status, rc = "error", 1
+                err = str(e.code) if not isinstance(e.code, int) else \
+                    f"exit {e.code}"
+            except BaseException as e:  # noqa: BLE001 - reported to client
+                status, rc = "error", 1
+                err = f"{type(e).__name__}: {e}"
+                retriable = rb_errors.is_transient(e)
+            wall = time.perf_counter() - t0
+            cc = ws_cache.counters_delta(cc0)
+            if status == "done":
+                self.jobs_done += 1
+            else:
+                self.jobs_failed += 1
+            self.journal.emit(
+                "job_done", job_id=job.job_id, status=status,
+                wall_s=round(wall, 4), queue_wait_s=round(wait_s, 4),
+                command=job.command,
+                method=getattr(job.args, "method", None),
+                fresh_compiles=cc.get("misses", 0),
+                **({"error": err} if err else {}),
+            )
+            job.ack.wait(timeout=10.0)  # admission line strictly first
+            try:
+                if status == "done":
+                    protocol.write_msg(
+                        job.fh, ok=True, status="done", job_id=job.job_id,
+                        rc=rc, wall_s=round(wall, 4),
+                        queue_wait_s=round(wait_s, 4), stats=summary,
+                        compile_cache=cc,
+                    )
+                else:
+                    protocol.write_msg(
+                        job.fh, ok=False, status="error", job_id=job.job_id,
+                        error=err, retriable=retriable,
+                    )
+            except (OSError, ValueError):
+                # the client went away while its job ran (ValueError:
+                # the admission path already closed the fh after a
+                # failed accepted-write); the output is on disk
+                # regardless — log, never crash the lane
+                logger.warning(
+                    "job %d: client disconnected before the terminal "
+                    "response", job.job_id,
+                )
+            self._close(job.conn, job.fh)
+            self._inflight = None
+
+    def _execute(self, job: Job) -> dict:
+        """Run one job through THE CLI execution body with the resident
+        backend, resetting exactly the per-run backend state first."""
+        from specpride_tpu import cli
+
+        backend = None
+        if getattr(job.args, "backend", "tpu") == "tpu":
+            backend = self.backend
+            # per-job telemetry state on the shared backend: metrics and
+            # run stats are per-run by contract; the journal hook and
+            # pack accounting are (re)set by _open_run_journal, and the
+            # routing-note memo clears so EVERY job's journal carries
+            # the routing events that applied to it.  Warm state
+            # (_seen_shapes, jit caches) deliberately survives.
+            backend.metrics = MetricsRegistry()
+            backend.stats = RunStats()
+            backend.pack_accounting = False
+            backend._routing_noted.clear()
+            # boot warmed the manifest once and the jit caches stay
+            # resident: per-job AOT re-warming is pure request latency
+            # (manifest saving still runs so jobs seed future boots)
+            job.args._resident_warm = True
+        return cli._run_pipeline_command(job.args, job.command,
+                                         backend=backend)
+
+    # -- shutdown -------------------------------------------------------
+
+    def drain(self) -> None:
+        """Graceful shutdown: reject queued jobs (retriable), commit the
+        in-flight one, close everything.  Idempotent and callable from
+        any thread (signal path and in-process tests share it)."""
+        with self._drain_lock:
+            if self._draining:
+                return
+            self._draining = True
+        self._stop.set()
+        if self.journal is None:
+            return  # boot never completed; nothing to flush or reject
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        rejected = self.queue.drain()
+        for job in rejected:
+            self.jobs_rejected += 1
+            self.journal.emit(
+                "job_rejected", job_id=job.job_id, reason="draining",
+                retriable=True,
+            )
+            job.ack.wait(timeout=10.0)  # admission line strictly first
+            try:
+                protocol.write_msg(
+                    job.fh, ok=False, status="rejected", job_id=job.job_id,
+                    reason="draining", retriable=True,
+                )
+            except (OSError, ValueError):
+                pass  # client already gone / fh closed by its reader
+            self._close(job.conn, job.fh)
+        self._gate.set()  # a held test gate must not deadlock the drain
+        if self._worker.is_alive():
+            self._worker.join()
+        self.watchdog.stop()
+        uptime = time.perf_counter() - self._t_boot
+        self.journal.emit(
+            "serve_drain", n_rejected=len(rejected),
+            jobs_done=self.jobs_done, jobs_failed=self.jobs_failed,
+        )
+        self.journal.emit(
+            "run_end",
+            counters={
+                "jobs_done": self.jobs_done,
+                "jobs_failed": self.jobs_failed,
+                "jobs_rejected": self.jobs_rejected,
+            },
+            phases_s={"serve": round(uptime, 4)},
+            elapsed_s=round(uptime, 4),
+            device=device_summary(None),
+        )
+        self.journal.close()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        logger.info(
+            "drained: %d done, %d failed, %d rejected",
+            self.jobs_done, self.jobs_failed, self.jobs_rejected,
+        )
+
+    def status(self) -> dict:
+        return {
+            "status": "serving" if not self._draining else "draining",
+            "socket": self.socket_path,
+            "queue_depth": len(self.queue),
+            "max_queue": self.queue.capacity,
+            "jobs_done": self.jobs_done,
+            "jobs_failed": self.jobs_failed,
+            "jobs_rejected": self.jobs_rejected,
+            "warmed_kernels": self.warmed_kernels,
+            "uptime_s": round(time.perf_counter() - self._t_boot, 2),
+        }
+
+    @staticmethod
+    def _close(conn, fh) -> None:
+        for closer in (fh, conn):
+            try:
+                closer.close()
+            except OSError:
+                pass
+
+
+_parser_lock = threading.Lock()
+_job_parser = None
+
+
+def _build_job_parser():
+    """The CLI's OWN parser with every error() (top level AND each
+    subparser) rebound to raise ValueError in place of argparse's
+    print-to-stderr + SystemExit.  Rebinding — not
+    ``contextlib.redirect_stderr`` — because admission runs on
+    concurrent reader threads and redirecting the PROCESS-global
+    ``sys.stderr`` there cross-attributes error text between clients
+    and can leave stderr pointing at a dead buffer."""
+    from specpride_tpu.cli import build_parser
+
+    ap = build_parser()
+
+    def _raise(message: str):
+        raise ValueError(f"argv rejected by the CLI parser: {message}")
+
+    ap.error = _raise
+    if ap._subparsers is not None:
+        for action in ap._subparsers._group_actions:
+            for sub in (getattr(action, "choices", None) or {}).values():
+                sub.error = _raise
+    return ap
+
+
+def _parse_job_argv(argv: list[str]):
+    """Parse a job argv with the (cached) CLI parser, so served jobs
+    accept exactly what one-shot runs accept.  Raises ValueError with
+    the parser's own message on rejection; ``--help``-style exits are
+    rejections too (a job must never print help into the daemon)."""
+    global _job_parser
+    with _parser_lock:
+        # one parser for the daemon's lifetime (admission is the hot
+        # path; rebuilding the full subcommand tree per request is
+        # waste), serialized — parse_args builds a fresh Namespace but
+        # argparse makes no thread-safety promises
+        if _job_parser is None:
+            _job_parser = _build_job_parser()
+        try:
+            return _job_parser.parse_args(argv)
+        except SystemExit:
+            # e.g. --help / --version actions exit without error()
+            raise ValueError(
+                f"argv rejected by the CLI parser: {json.dumps(argv)}"
+            ) from None

@@ -1,0 +1,150 @@
+"""JSON-lines wire protocol for the ``specpride serve`` daemon.
+
+Transport: a local **unix-domain stream socket**, one connection per
+job (concurrent clients = concurrent connections).  Every message is
+one JSON object per line, newline-terminated — the same framing as the
+run journal, so both ends stay greppable and a protocol trace reads
+like any other JSONL stream.
+
+Client -> server (one request per connection)::
+
+    {"op": "submit", "argv": ["consensus", IN, OUT, "--method", ...]}
+    {"op": "ping"}
+    {"op": "status"}
+
+Server -> client, for ``submit``: an admission line first, then —
+unless the job was rejected — exactly one terminal line when the job
+leaves the execution lane::
+
+    {"ok": true,  "status": "accepted", "job_id": 3, "queue_depth": 1}
+    {"ok": true,  "status": "done", "job_id": 3, "rc": 0,
+     "wall_s": 1.23, "queue_wait_s": 0.0, "stats": {...},
+     "compile_cache": {"hits": 0, "misses": 0, ...}}
+    {"ok": false, "status": "rejected", "reason": "queue_full",
+     "retriable": true}
+    {"ok": false, "status": "error", "job_id": 3,
+     "error": "ValueError: ...", "retriable": false}
+
+``retriable`` follows the robustness error taxonomy
+(``robustness.errors``): admission rejections (``queue_full``,
+``draining``) are always retriable — resubmit after backoff — while
+execution errors are retriable only when the taxonomy classifies them
+transient.  ``specpride submit`` maps a retriable non-success to exit
+code 75 (BSD ``EX_TEMPFAIL``), so shell callers can retry on ``$? ==
+75`` without parsing JSON.
+
+A job's ``argv`` is the exact one-shot CLI argv (``consensus``/
+``select`` only) — the daemon parses it with the CLI's own parser, so a
+served job can never accept flags the CLI would reject.  The flags in
+``DAEMON_ONLY_FLAGS`` configure the daemon's resident backend at boot
+and are refused on jobs: silently accepting a per-job ``--layout`` that
+cannot apply to the already-constructed backend would be a lie.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+PROTOCOL_VERSION = 1
+
+# commands a job may run: the chunked pipeline commands that benefit
+# from (and are safe under) the resident warm backend
+SERVABLE_COMMANDS = ("consensus", "select")
+
+# flags the DAEMON owns (boot-time backend/cache construction): a job
+# carrying one is rejected, never silently ignored
+DAEMON_ONLY_FLAGS = (
+    "--compile-cache",
+    "--routing-table",
+    "--layout",
+    "--force-device",
+    "--mesh",
+    "--coordinator",
+    "--num-processes",
+    "--process-id",
+)
+
+# `specpride submit` exit code for a retriable non-success (BSD
+# EX_TEMPFAIL — the sysexits convention for "try again later")
+EX_TEMPFAIL = 75
+
+
+def default_socket_path() -> str:
+    """Where daemon and client meet when ``--socket`` is not given:
+    ``SPECPRIDE_SOCKET``, else a per-user path under ``~/.cache``."""
+    env = os.environ.get("SPECPRIDE_SOCKET")
+    if env:
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "specpride_tpu", "serve.sock"
+    )
+
+
+def write_msg(fh, **payload) -> None:
+    """One protocol message -> one flushed JSON line."""
+    fh.write(json.dumps(payload) + "\n")
+    fh.flush()
+
+
+def read_msg(fh) -> dict | None:
+    """The next message, ``None`` on EOF.  Raises ``ValueError`` on a
+    line that is not a JSON object — a protocol violation the caller
+    turns into a rejection (server) or ``ServeError`` (client)."""
+    line = fh.readline()
+    if not line:
+        return None
+    msg = json.loads(line)
+    if not isinstance(msg, dict):
+        raise ValueError(f"protocol message is not an object: {msg!r}")
+    return msg
+
+
+def forbidden_flags(argv: list[str]) -> list[str]:
+    """Daemon-only flags present in a job argv (``--flag`` and
+    ``--flag=value`` spellings both count)."""
+    return sorted({
+        tok.split("=", 1)[0]
+        for tok in argv
+        if tok.split("=", 1)[0] in DAEMON_ONLY_FLAGS
+    })
+
+
+# parser dests of the daemon-owned flags: a PARSED job namespace whose
+# value differs from the CLI default was set by the argv, whatever
+# spelling reached the parser (argparse accepts unambiguous prefixes
+# like --layou, which the token scan above cannot see)
+_DAEMON_OWNED_DESTS = (
+    "compile_cache", "routing_table", "layout", "force_device",
+    "mesh", "coordinator", "num_processes", "process_id",
+)
+
+_daemon_owned_defaults: dict | None = None
+
+
+def _owned_defaults() -> dict:
+    """The CLI parser's OWN defaults for the daemon-owned dests, read
+    once from a bare parse — never a hardcoded copy, which would drift
+    the moment a CLI default changes (rejecting every job, or letting
+    the old default through).  consensus and select share these flags
+    via one ``_add_backend``, so either subcommand's baseline works."""
+    global _daemon_owned_defaults
+    if _daemon_owned_defaults is None:
+        from specpride_tpu.cli import build_parser
+
+        base = build_parser().parse_args(["consensus", "", ""])
+        _daemon_owned_defaults = {
+            dest: getattr(base, dest) for dest in _DAEMON_OWNED_DESTS
+        }
+    return _daemon_owned_defaults
+
+
+def overridden_daemon_flags(args) -> list[str]:
+    """Daemon-owned flags a PARSED job namespace overrides from their
+    CLI defaults — the abbreviation-proof second line of defence behind
+    :func:`forbidden_flags`."""
+    return sorted(
+        "--" + dest.replace("_", "-")
+        for dest, default in _owned_defaults().items()
+        if getattr(args, dest, default) != default
+    )

@@ -1,0 +1,98 @@
+"""Bounded admission queue with FIFO-fair scheduling across clients.
+
+The daemon's execution lane is singular (jobs multiplex the device
+through one three-lane executor at a time), so *admission* is where
+fairness lives: each client (connection origin) gets its own FIFO, the
+worker pops **round-robin across clients**, and the total queued count
+is bounded — a burst from one chatty client can neither starve a
+neighbour (round-robin) nor queue unboundedly (``offer`` refuses at
+capacity and the daemon replies ``queue_full``, retriable).
+
+Fairness semantics: within one client, jobs run in submission order
+(FIFO); across clients, the pop order interleaves one job per client
+per round, clients served in first-submission order.  A client with an
+empty queue leaves the rotation and re-enters at the tail on its next
+submission — exactly the behaviour of a round-robin packet scheduler.
+
+Thread contract: ``offer`` runs on connection reader threads, ``pop``
+on the single worker thread, ``drain`` on whichever thread initiates
+shutdown; everything synchronizes on one condition variable.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class AdmissionQueue:
+    def __init__(self, capacity: int):
+        self.capacity = max(int(capacity), 1)
+        self._cond = threading.Condition()
+        # client id -> FIFO of jobs; dict order IS the round-robin
+        # rotation (clients rotate by delete + re-insert on pop)
+        self._queues: "collections.OrderedDict[object, collections.deque]" \
+            = collections.OrderedDict()
+        self._total = 0
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cond:
+            return self._total
+
+    def offer(self, client, job) -> bool:
+        """Enqueue ``job`` for ``client``; ``False`` when the queue is at
+        capacity or closed (the caller rejects with a retriable
+        status)."""
+        with self._cond:
+            if self._closed or self._total >= self.capacity:
+                return False
+            self._queues.setdefault(client, collections.deque()).append(job)
+            self._total += 1
+            self._cond.notify_all()
+            return True
+
+    def pop(self, timeout: float | None = None):
+        """The next job in round-robin-fair order; blocks while empty.
+        Returns ``None`` once the queue is closed and empty (worker
+        shutdown), or on ``timeout``."""
+        with self._cond:
+            while self._total == 0:
+                if self._closed:
+                    return None
+                if not self._cond.wait(timeout=timeout):
+                    return None
+            client, q = next(iter(self._queues.items()))
+            job = q.popleft()
+            self._total -= 1
+            # rotate: the served client moves to the tail if it still
+            # has queued jobs, else leaves the rotation entirely
+            del self._queues[client]
+            if q:
+                self._queues[client] = q
+            return job
+
+    def close(self) -> None:
+        """Stop admitting; ``pop`` drains what is queued then returns
+        ``None``."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def drain(self) -> list:
+        """Close AND empty the queue, returning every still-queued job
+        (submission order per client, round-robin across clients — the
+        order they would have run) so the daemon can reject each with a
+        retriable status."""
+        with self._cond:
+            self._closed = True
+            out = []
+            while self._total:
+                client, q = next(iter(self._queues.items()))
+                out.append(q.popleft())
+                self._total -= 1
+                del self._queues[client]
+                if q:
+                    self._queues[client] = q
+            self._cond.notify_all()
+            return out
