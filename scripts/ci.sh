@@ -291,17 +291,24 @@ EOF
 rm -rf "$ws_tmp"
 
 echo "== serve: warm-kernel daemon (boot, parity, warm requests, drain) =="
-# boot the daemon against a FRESH compile cache, run the three methods
-# through it twice (the warm pair of second submissions CONCURRENTLY),
-# and assert: byte parity vs one-shot CLI runs, warm submissions journal
-# ZERO fresh compiles, `stats` renders the serving summary, and SIGTERM
-# drains cleanly (exit 0, complete schema-valid journal)
+# boot the daemon against a FRESH compile cache — with the live
+# telemetry plane armed (/metrics endpoint, SLO objectives, drain-time
+# textfile) — run the three methods through it twice (the warm pair of
+# second submissions CONCURRENTLY), and assert: byte parity vs one-shot
+# CLI runs, warm submissions journal ZERO fresh compiles, a mid-load
+# /metrics scrape is strictly format-valid with queue/in-flight/latency
+# series, `specpride profile` captures a device trace off the warm
+# daemon, `stats` renders the serving summary + `stats --slo` the burn
+# table, and SIGTERM drains cleanly (exit 0, schema-valid journal,
+# final --metrics-out snapshot on disk)
 sv_tmp=$(mktemp -d)
 SV_IN=tests/data/golden_clustered.mgf
 SOCK="$sv_tmp/serve.sock"
 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     serve --socket "$SOCK" --compile-cache "$sv_tmp/cache" \
-    --journal "$sv_tmp/serve.jsonl" &
+    --journal "$sv_tmp/serve.jsonl" \
+    --metrics-port 0 --metrics-out "$sv_tmp/serve.prom" \
+    --slo "bin-mean=300,gap-average=300,medoid=0.000001" &
 SV_PID=$!
 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$SOCK" <<'EOF'
 import sys
@@ -328,16 +335,65 @@ sv_submit bin-mean consensus warm &
 SV_J1=$!
 sv_submit gap-average consensus warm &
 SV_J2=$!
+# mid-load /metrics scrape while the warm pair runs: strictly
+# format-valid exposition carrying queue / in-flight / latency series
+# and live job counters
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$SOCK" <<'EOF'
+import sys, urllib.request
+from specpride_tpu.serve.client import request
+from specpride_tpu.observability.exporter import parse_exposition
+status = request(sys.argv[1], {"op": "status"})
+url = status["metrics_url"]
+text = urllib.request.urlopen(url, timeout=10).read().decode()
+samples, problems = parse_exposition(text)
+assert not problems, problems
+names = {name for name, _ in samples}
+for need in ("specpride_serve_queue_depth", "specpride_serve_inflight",
+             "specpride_serve_uptime_seconds",
+             "specpride_serve_job_wall_seconds_bucket",
+             "specpride_serve_job_queue_wait_seconds_bucket",
+             "specpride_serve_jobs_done_total",
+             "specpride_serve_slo_objective_seconds"):
+    assert need in names, f"missing series {need}; have {sorted(names)}"
+done = sum(v for (n, _), v in samples.items()
+           if n == "specpride_serve_jobs_done_total")
+assert done >= 3, f"mid-load scrape saw only {done} done jobs"
+print(f"mid-load scrape OK: {len(samples)} series samples, "
+      f"{done:.0f} jobs done, exposition strictly valid")
+EOF
 wait $SV_J1
 wait $SV_J2
 sv_submit medoid select warm
 for M in bin-mean gap-average medoid; do
     cmp "$sv_tmp/cli_$M.mgf" "$sv_tmp/served_${M}_warm.mgf"
 done
+# on-demand device profiling against the WARM daemon: a bounded
+# jax.profiler window with artifacts, no restart — and the warm checks
+# after drain prove the next jobs still compiled nothing fresh
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    profile --socket "$SOCK" --seconds 1 --trace-dir "$sv_tmp/prof" \
+    > "$sv_tmp/profile.json"
+python - "$sv_tmp" <<'EOF'
+import json, os, sys
+rep = json.load(open(os.path.join(sys.argv[1], "profile.json")))
+assert rep["status"] == "profiled", rep
+assert rep["artifacts"], "profile produced no device-trace artifacts"
+for rel in rep["artifacts"]:
+    assert os.path.isfile(os.path.join(rep["trace_dir"], rel)), rel
+print(f"profile OK: {len(rep['artifacts'])} artifact(s) in "
+      f"{rep['trace_dir']}")
+EOF
+# one more warm job AFTER the capture: profiling must not have
+# disturbed the warm jit caches (asserted with the other warm jobs in
+# the post-drain python block below)
+sv_submit bin-mean consensus postprof
+cmp "$sv_tmp/cli_bin-mean.mgf" "$sv_tmp/served_bin-mean_postprof.mgf"
 # the daemon is still LIVE: stats must render the serving summary off
-# the (run_end-less) journal
+# the (run_end-less) journal, and --slo the per-method burn table
 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     stats "$sv_tmp/serve.jsonl" | grep -q "serving:"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$sv_tmp/serve.jsonl" --slo | grep -q "slo: method=medoid"
 kill -TERM $SV_PID
 SV_RC=0; wait $SV_PID || SV_RC=$?
 test "$SV_RC" -eq 0
@@ -353,17 +409,37 @@ for path in sorted(glob.glob(os.path.join(tmp, "job_*_warm.jsonl"))):
         f"{path}: warm served job still compiled {end['compile_cache']}"
 serve = [json.loads(l) for l in open(os.path.join(tmp, "serve.jsonl"))]
 jd = [e for e in serve if e["event"] == "job_done"]
-assert len(jd) == 6 and all(e["status"] == "done" for e in jd), jd
+assert len(jd) == 7 and all(e["status"] == "done" for e in jd), jd
 warm = [e for e in jd[3:]]
 assert all(e["fresh_compiles"] == 0 for e in warm), warm
-# SIGTERM drained cleanly: journal complete and schema-valid
+# SLO evaluations rode every job_done (medoid's impossible objective
+# burned; the 300s ones did not)
+assert all("slo_ok" in e for e in jd), jd
+assert all(e["slo_ok"] is False for e in jd if e["method"] == "medoid")
+assert all(e["slo_ok"] is True for e in jd if e["method"] != "medoid")
+# SIGTERM drained cleanly: journal complete and schema-valid, and the
+# profile capture journaled its window
 from specpride_tpu.observability.journal import read_events
 events, violations = read_events(os.path.join(tmp, "serve.jsonl"))
 assert not violations, violations
 names = [e["event"] for e in events]
 assert "serve_drain" in names and names[-1] == "run_end", names[-6:]
-print("serve OK: 6 served jobs byte-identical to CLI, warm jobs 0 fresh "
-      "compiles, clean SIGTERM drain")
+assert "profile_start" in names and "profile_done" in names
+# the drain-time --metrics-out snapshot: strictly valid exposition whose
+# totals equal the journal-derived serving summary
+from specpride_tpu.observability.exporter import parse_exposition
+final_text = open(os.path.join(tmp, "serve.prom")).read()
+samples, problems = parse_exposition(final_text)
+assert not problems, problems
+done = sum(v for (n, _), v in samples.items()
+           if n == "specpride_serve_jobs_done_total")
+assert done == len(jd), (done, len(jd))
+breaches = sum(v for (n, _), v in samples.items()
+               if n == "specpride_serve_slo_breaches_total")
+assert breaches == sum(1 for e in jd if not e["slo_ok"]), breaches
+print("serve OK: 7 served jobs byte-identical to CLI, warm jobs 0 fresh "
+      "compiles, live scrape + profile + SLO burn + drain snapshot, "
+      "clean SIGTERM drain")
 EOF
 rm -rf "$sv_tmp"
 

@@ -40,6 +40,7 @@ from specpride_tpu.observability import (
     RunStats,
     Tracer,
     configure_logging,
+    device_counters_snapshot,
     device_summary,
     device_trace,
     export_run_metrics,
@@ -1976,6 +1977,12 @@ def _open_run_journal(args, backend, command: str, n_clusters: int):
 
         args._plan_snapshot = plan_cache_info()
         args._shapes_snapshot = set(backend._seen_shapes)
+        # the backend's metrics registry is ALSO a process-wide singleton
+        # in a serving daemon (kept resident so the live /metrics
+        # exporter serves monotone Prometheus counters): snapshot its
+        # device counters so run_end.device reports THIS job's traffic,
+        # not the daemon's cumulative total
+        args._device_snapshot = device_counters_snapshot(backend.metrics)
     chrome = getattr(args, "chrome_trace", None)
     if journal.enabled or chrome:
         # spans ride the SAME journal stream as the v1 events; kept in
@@ -2000,7 +2007,10 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
     """Emit ``run_end`` (full summary + the device-telemetry dict both
     backends share), write the Chrome trace and the Prometheus textfile
     if requested, and uninstall the run's tracer."""
-    device = device_summary(getattr(backend, "metrics", None))
+    device = device_summary(
+        getattr(backend, "metrics", None),
+        since=args.__dict__.pop("_device_snapshot", None),
+    )
     cc_snapshot = args.__dict__.pop("_cc_snapshot", None)
     if cc_snapshot is not None:
         from specpride_tpu.warmstart import cache as ws_cache
@@ -2230,8 +2240,13 @@ def cmd_serve(args) -> int:
     """``specpride serve``: boot the warm-kernel consensus daemon and
     serve consensus/select jobs over a local socket until SIGTERM
     (graceful drain).  See docs/serving.md."""
+    from specpride_tpu.observability.exporter import parse_slo_spec
     from specpride_tpu.serve.daemon import ServeDaemon
 
+    try:
+        slo = parse_slo_spec(args.slo)
+    except ValueError as e:
+        raise SystemExit(str(e))
     return ServeDaemon(
         args.socket,
         max_queue=args.max_queue,
@@ -2244,7 +2259,43 @@ def cmd_serve(args) -> int:
         warmup_jobs=args.warmup_jobs,
         watchdog_timeout=args.watchdog_timeout,
         journal_path=args.journal,
+        metrics_port=args.metrics_port,
+        metrics_host=args.metrics_host,
+        metrics_out=args.metrics_out,
+        slo=slo,
     ).run()
+
+
+def cmd_profile(args) -> int:
+    """``specpride profile``: capture a bounded ``jax.profiler`` device
+    trace (plus the daemon-journal window) on a RUNNING warm daemon —
+    no restart, no cold recompile on the next job.  Prints the reply
+    JSON (artifact paths) on stdout; exit 0 captured, 75 retriable
+    (another capture in flight — retry later), 2 rejected, 1 error."""
+    from specpride_tpu.serve import client as serve_client
+    from specpride_tpu.serve import protocol as serve_protocol
+
+    try:
+        msg = serve_client.profile(
+            args.socket, seconds=args.seconds,
+            trace_dir=args.trace_dir, chrome_trace=args.chrome_trace,
+            timeout=args.timeout,
+        )
+    except (OSError, serve_client.ServeError) as e:
+        print(
+            json.dumps({
+                "ok": False, "status": "error",
+                "error": f"{type(e).__name__}: {e}", "retriable": True,
+            }),
+            flush=True,
+        )
+        return serve_protocol.EX_TEMPFAIL
+    print(json.dumps(msg), flush=True)
+    if msg.get("status") == "profiled":
+        return 0
+    if msg.get("retriable"):
+        return serve_protocol.EX_TEMPFAIL
+    return 2 if msg.get("status") == "rejected" else 1
 
 
 def cmd_submit(args) -> int:
@@ -2289,10 +2340,11 @@ def cmd_stats(args) -> int:
             raise SystemExit("--follow tails exactly one journal")
         return follow_stats(
             args.journals[0], interval=args.interval,
-            top_spans=args.top_spans,
+            top_spans=args.top_spans, slo=args.slo,
         )
     return run_stats(
-        args.journals, json_out=args.json, top_spans=args.top_spans
+        args.journals, json_out=args.json, top_spans=args.top_spans,
+        slo=args.slo,
     )
 
 
@@ -2681,7 +2733,67 @@ def build_parser() -> argparse.ArgumentParser:
         "job_queued/job_start/job_done/job_rejected, serve_drain) — "
         "watch live with `specpride stats --follow`",
     )
+    psv.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="serve a live Prometheus /metrics endpoint on this port "
+        "(0 = ephemeral, read the bound port from the serve_start "
+        "journal event or the status op; default: off).  Loopback only "
+        "unless --metrics-host widens it",
+    )
+    psv.add_argument(
+        "--metrics-host", default="127.0.0.1", metavar="HOST",
+        help="bind address for --metrics-port (default 127.0.0.1 — the "
+        "telemetry plane is an operator surface; exposing it beyond "
+        "the host is an explicit decision)",
+    )
+    psv.add_argument(
+        "--metrics-out", metavar="FILE",
+        help="flush a final Prometheus textfile snapshot of the serving "
+        "metrics at SIGTERM drain (same exposition /metrics serves)",
+    )
+    psv.add_argument(
+        "--slo", metavar="METHOD=SECONDS,...",
+        help="per-method latency objectives, e.g. "
+        "'bin-mean=2,gap-average=3,*=10' ('*' = catch-all): each job's "
+        "queue wait + wall is evaluated against its objective, "
+        "journaled on job_done (slo_ok / slo_latency_s) and exported "
+        "as burn counters on /metrics; render with "
+        "`specpride stats --slo`",
+    )
     psv.set_defaults(fn=cmd_serve)
+
+    ppr = sub.add_parser(
+        "profile",
+        help="capture an on-demand jax.profiler device trace (plus the "
+        "daemon-journal window) on a RUNNING warm serve daemon — no "
+        "restart, no cold recompile on the next job",
+    )
+    ppr.add_argument(
+        "--socket", metavar="PATH", default=None,
+        help="daemon socket (default: $SPECPRIDE_SOCKET or "
+        "~/.cache/specpride_tpu/serve.sock)",
+    )
+    ppr.add_argument(
+        "--seconds", type=float, default=3.0, metavar="S",
+        help="capture window length (default 3; bounded server-side)",
+    )
+    ppr.add_argument(
+        "--trace-dir", metavar="DIR", default=None,
+        help="where the daemon writes the device trace (default: a "
+        "fresh temp dir, named in the reply) — view with TensorBoard "
+        "or Perfetto",
+    )
+    ppr.add_argument(
+        "--chrome-trace", metavar="FILE", default=None,
+        help="also copy the capture's perfetto trace (gzipped "
+        "chrome-loadable JSON) to this path",
+    )
+    ppr.add_argument(
+        "--timeout", type=float, default=30.0, metavar="S",
+        help="connect/reply margin beyond the capture window "
+        "(default 30)",
+    )
+    ppr.set_defaults(fn=cmd_profile)
 
     psb = sub.add_parser(
         "submit",
@@ -2730,6 +2842,12 @@ def build_parser() -> argparse.ArgumentParser:
     pst.add_argument(
         "--interval", type=float, default=1.0, metavar="S",
         help="poll interval for --follow (default 1s)",
+    )
+    pst.add_argument(
+        "--slo", action="store_true",
+        help="also render the per-method SLO table (objective, jobs, "
+        "breaches, burn) from a serving daemon's job_done events — "
+        "works with --follow for a live view",
     )
     pst.set_defaults(fn=cmd_stats)
 

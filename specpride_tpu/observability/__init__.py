@@ -18,6 +18,12 @@ Four pieces:
                  Chrome trace-event JSON (``--chrome-trace`` /
                  ``specpride trace``), aggregated by
                  ``specpride stats --top-spans``
+* ``exporter`` — LIVE telemetry plane for the serving daemon: an
+                 in-process Prometheus ``/metrics`` HTTP endpoint
+                 (``specpride serve --metrics-port``), per-method SLO
+                 burn accounting (``--slo``), and the strict text-
+                 format checker the tests/CI scrape pass share
+                 (imported lazily — one-shot runs never pay for it)
 """
 
 from specpride_tpu.observability.journal import (
@@ -37,6 +43,7 @@ from specpride_tpu.observability.tracing import (
 )
 from specpride_tpu.observability.registry import (
     MetricsRegistry,
+    device_counters_snapshot,
     device_summary,
     export_run_metrics,
 )
@@ -58,6 +65,7 @@ __all__ = [
     "Tracer",
     "build_chrome_trace",
     "configure_logging",
+    "device_counters_snapshot",
     "device_summary",
     "device_trace",
     "expand_parts",

@@ -1,0 +1,574 @@
+"""Live telemetry plane: in-process Prometheus exporter + SLO accounting.
+
+Everything the repo had before this module — journal, ``--metrics-out``
+textfile, Chrome traces, ``specpride stats`` — is an end-of-run
+artifact.  A long-lived ``specpride serve`` daemon is operated from
+LIVE metrics: this module gives it
+
+* :class:`ServeTelemetry` — the daemon's resident metric registry plus
+  the event hooks (``job_done`` / ``job_rejected`` / SLO evaluation)
+  and scrape-time samplers (queue depth, in-flight, the process-wide
+  compile-cache / bucket-plan-cache singletons) that keep it current;
+* :class:`MetricsExporter` — a background HTTP ``/metrics`` endpoint
+  (stdlib ``http.server``, loopback by default, ``--metrics-port`` on
+  ``specpride serve``) serving the Prometheus text exposition sampled
+  at scrape time;
+* :func:`parse_slo_spec` — the ``--slo method=seconds,...`` parser; a
+  job's latency objective is evaluated per job (queue wait + wall),
+  journaled on ``job_done`` and exposed as burn counters;
+* :func:`parse_exposition` / :func:`validate_exposition` — a strict
+  text-format checker shared by the tests and the CI scrape pass, so
+  the endpoint can never drift from what a Prometheus scraper parses.
+
+Thread contract: the exporter renders on HTTP handler threads while the
+daemon's worker and reader threads update the registries — safe because
+``MetricsRegistry`` locks per metric (see ``registry.py``).  Counters
+here are cumulative over the daemon's lifetime (Prometheus semantics);
+per-job attribution stays with the journal's snapshot-and-diff deltas.
+"""
+
+from __future__ import annotations
+
+import http.server
+import os
+import re
+import threading
+
+from specpride_tpu.observability.registry import MetricsRegistry
+from specpride_tpu.observability.stats import logger
+
+# seconds buckets sized for SERVED JOBS (queue wait + execution wall):
+# sub-second warm requests up to multi-minute cold/huge ones — a coarser
+# ladder than the dispatch-latency DEFAULT_BUCKETS
+JOB_SECONDS_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+# -- SLO specification --------------------------------------------------
+
+
+def parse_slo_spec(spec: str | None) -> dict[str, float]:
+    """``--slo method=seconds,...`` -> ``{method: objective_seconds}``.
+
+    ``*`` is the catch-all objective for methods not named explicitly.
+    Raises ``ValueError`` on malformed entries (the CLI turns it into a
+    usage error at boot, never mid-serve)."""
+    out: dict[str, float] = {}
+    if not spec:
+        return out
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        method, sep, value = item.partition("=")
+        method = method.strip()
+        if not sep or not method:
+            raise ValueError(
+                f"--slo entry {item!r} is not method=seconds"
+            )
+        try:
+            seconds = float(value)
+        except ValueError:
+            raise ValueError(
+                f"--slo {method}: {value!r} is not a number of seconds"
+            ) from None
+        if not seconds > 0:
+            raise ValueError(
+                f"--slo {method}: objective must be > 0 (got {seconds})"
+            )
+        out[method] = seconds
+    return out
+
+
+def slo_objective(slo: dict[str, float], method: str | None) -> float | None:
+    """The objective that applies to ``method`` (explicit beats ``*``),
+    or None when no SLO covers it."""
+    if method is not None and method in slo:
+        return slo[method]
+    return slo.get("*")
+
+
+# -- the daemon's live registry -----------------------------------------
+
+
+class ServeTelemetry:
+    """Resident metric state for one serving daemon.
+
+    The daemon calls the ``job_*`` hooks from its worker/reader threads
+    as events happen; scrape-time state (queue depth, in-flight,
+    uptime, the process-wide cache singletons) is pulled by
+    :meth:`exposition` via the ``sampler`` callback the daemon installs
+    — so a scrape is always CURRENT, not a stale end-of-job snapshot.
+
+    ``extra_registries`` ride along in the exposition (the daemon passes
+    its resident backend's registry, so per-kernel dispatch counters,
+    the dispatch-latency histogram and the device peak-memory watermark
+    are served live).  Metric names across registries must be disjoint
+    — ``specpride_serve_*`` here vs ``specpride_*`` on the backend."""
+
+    def __init__(
+        self,
+        slo: dict[str, float] | None = None,
+        extra_registries: tuple = (),
+    ):
+        self.slo = dict(slo or {})
+        self.extra_registries = tuple(extra_registries)
+        # the daemon installs a fn(telemetry) that refreshes live gauges
+        # (queue depth, in-flight, uptime) right before each render
+        self.sampler = None
+        self._lock = threading.Lock()  # guards the singleton-sync deltas
+        # one render at a time: the sampler's clear/zero-then-set gauge
+        # refresh must not interleave with another scrape's render
+        # (ThreadingHTTPServer runs concurrent GETs), or a parallel
+        # scrape could serve a spurious idle/empty gauge view
+        self._render_lock = threading.Lock()
+        self._singletons_last: dict[str, float] = {}
+        r = self.registry = MetricsRegistry()
+        self.jobs_done = r.counter(
+            "specpride_serve_jobs_done_total",
+            "served jobs that completed successfully",
+            labels=("command", "method"),
+        )
+        self.jobs_failed = r.counter(
+            "specpride_serve_jobs_failed_total",
+            "served jobs that errored",
+            labels=("command", "method"),
+        )
+        self.jobs_rejected = r.counter(
+            "specpride_serve_jobs_rejected_total",
+            "submissions rejected at admission (by reason)",
+            labels=("reason",),
+        )
+        self.job_wall = r.histogram(
+            "specpride_serve_job_wall_seconds",
+            "execution wall seconds per served job",
+            labels=("method",), buckets=JOB_SECONDS_BUCKETS,
+        )
+        self.job_queue_wait = r.histogram(
+            "specpride_serve_job_queue_wait_seconds",
+            "admission-to-execution queue wait per served job",
+            labels=("method",), buckets=JOB_SECONDS_BUCKETS,
+        )
+        self.lane_busy = r.counter(
+            "specpride_serve_lane_busy_seconds_total",
+            "per-lane busy seconds across served jobs (pack worker pool / "
+            "dispatch / ordered write lane)",
+            labels=("lane",),
+        )
+        self.queue_depth = r.gauge(
+            "specpride_serve_queue_depth", "jobs queued for execution"
+        )
+        self.queue_depth_client = r.gauge(
+            "specpride_serve_queue_depth_client",
+            "queued jobs per scheduling client",
+            labels=("client",),
+        )
+        self.inflight_total = r.gauge(
+            "specpride_serve_inflight",
+            "jobs on the execution lane right now (0 or 1)",
+        )
+        self.inflight = r.gauge(
+            "specpride_serve_inflight_jobs",
+            "jobs on the execution lane right now, by job labels "
+            "(0 or 1)",
+            labels=("command", "method", "backend"),
+        )
+        self.uptime = r.gauge(
+            "specpride_serve_uptime_seconds", "seconds since daemon boot"
+        )
+        self.slo_jobs = r.counter(
+            "specpride_serve_slo_jobs_total",
+            "served jobs evaluated against a latency objective",
+            labels=("method",),
+        )
+        self.slo_breaches = r.counter(
+            "specpride_serve_slo_breaches_total",
+            "served jobs whose latency (queue wait + wall) exceeded their "
+            "objective — the SLO burn counter",
+            labels=("method",),
+        )
+        slo_objective_g = r.gauge(
+            "specpride_serve_slo_objective_seconds",
+            "configured per-method latency objective",
+            labels=("method",),
+        )
+        for method, seconds in self.slo.items():
+            slo_objective_g.set(seconds, method=method)
+
+    # -- event hooks (worker / reader threads) -------------------------
+
+    def job_rejected(self, reason: str) -> None:
+        self.jobs_rejected.inc(1, reason=reason)
+
+    def job_done(
+        self, *, command: str, method: str | None, status: str,
+        wall_s: float, queue_wait_s: float, summary: dict | None = None,
+    ) -> dict:
+        """Fold one finished job in; returns the SLO fields (empty when
+        no objective covers the method) for the daemon to journal on its
+        ``job_done`` event."""
+        m = method or "-"
+        if status == "done":
+            self.jobs_done.inc(1, command=command, method=m)
+        else:
+            self.jobs_failed.inc(1, command=command, method=m)
+        self.job_wall.observe(wall_s, method=m)
+        self.job_queue_wait.observe(queue_wait_s, method=m)
+        self._fold_lanes(summary or {})
+        objective = slo_objective(self.slo, method)
+        if objective is None:
+            return {}
+        latency = wall_s + queue_wait_s
+        ok = latency <= objective
+        self.slo_jobs.inc(1, method=m)
+        if not ok:
+            self.slo_breaches.inc(1, method=m)
+        return {
+            "slo_objective_s": objective,
+            "slo_latency_s": round(latency, 4),
+            "slo_ok": ok,
+        }
+
+    def _fold_lanes(self, summary: dict) -> None:
+        """Per-lane busy seconds from one job's stats summary: the
+        multi-lane executor's span accounting (``pipeline.pack_busy_s``
+        per worker, ``write_busy_s``) when the job pipelined, the plain
+        phase timers otherwise; the dispatch lane is the consumer
+        thread's compute phase either way."""
+        phases = summary.get("phases_s") or {}
+        pipeline = summary.get("pipeline") or {}
+        pack = (
+            sum(pipeline["pack_busy_s"])
+            if pipeline.get("pack_busy_s")
+            else phases.get("pack", 0.0)
+        )
+        write = (
+            pipeline["write_busy_s"]
+            if pipeline.get("async_write")
+            else phases.get("write", 0.0)
+        )
+        dispatch = phases.get("compute", 0.0)
+        for lane, busy in (
+            ("pack", pack), ("dispatch", dispatch), ("write", write),
+        ):
+            if busy and busy > 0:
+                self.lane_busy.inc(float(busy), lane=lane)
+
+    # -- scrape-time state ---------------------------------------------
+
+    def sync_singletons(self) -> None:
+        """Mirror the process-wide warm-start singletons into Prometheus
+        counters: compile-cache hits/misses/saved-seconds and bucket-
+        plan-cache traffic.  The singletons are already monotone, so the
+        mirror incs by delta since the last scrape — never a set, which
+        Counter (correctly) refuses."""
+        from specpride_tpu.data.packed import plan_cache_info
+        from specpride_tpu.warmstart import cache as ws_cache
+
+        cc = ws_cache.counters_snapshot()
+        pc = plan_cache_info()
+        totals = {
+            "specpride_compile_cache_hits_total": (
+                cc["hits"], "persistent compile-cache hits"),
+            "specpride_compile_cache_misses_total": (
+                cc["misses"], "persistent compile-cache misses "
+                "(fresh XLA compiles)"),
+            "specpride_compile_cache_requests_total": (
+                cc["requests"], "compile requests consulting the "
+                "persistent cache"),
+            "specpride_compile_cache_saved_seconds_total": (
+                cc["saved_s"], "compile seconds avoided by persistent-"
+                "cache hits"),
+            "specpride_plan_cache_hits_total": (
+                pc["hits"], "bucket-plan cache hits"),
+            "specpride_plan_cache_misses_total": (
+                pc["misses"], "bucket-plan cache misses"),
+        }
+        with self._lock:
+            for name, (total, help_) in totals.items():
+                last = self._singletons_last.get(name, 0.0)
+                if total > last:
+                    self.registry.counter(name, help_).inc(total - last)
+                self._singletons_last[name] = max(float(total), last)
+        self.registry.gauge(
+            "specpride_plan_cache_size", "bucket plans resident in cache"
+        ).set(pc["size"])
+
+    def exposition(self) -> str:
+        """The full Prometheus text exposition, sampled NOW."""
+        with self._render_lock:
+            sampler = self.sampler
+            if sampler is not None:
+                sampler(self)
+            self.sync_singletons()
+            parts = [self.registry.to_prometheus_text()]
+            parts.extend(
+                r.to_prometheus_text() for r in self.extra_registries
+            )
+            return "".join(parts)
+
+    def write_textfile(self, path: str) -> None:
+        """Atomic snapshot of the current exposition — the daemon's
+        final ``--metrics-out`` flush at SIGTERM drain."""
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(self.exposition())
+        os.replace(tmp, path)
+
+
+# -- the HTTP endpoint --------------------------------------------------
+
+
+class MetricsExporter:
+    """Background ``/metrics`` HTTP endpoint over a render callback.
+
+    Binds ``host:port`` (port 0 = ephemeral; read the bound port back
+    from ``.port``), serves ``GET /metrics`` with the Prometheus text
+    content type and ``GET /healthz`` with a one-line liveness body, on
+    a daemon thread pool (``ThreadingHTTPServer``) so a slow scraper
+    never blocks the next one.  Loopback by default: the telemetry
+    plane is an OPERATOR surface, exposing it beyond the host is an
+    explicit ``--metrics-host`` decision."""
+
+    CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+    def __init__(self, render, host: str = "127.0.0.1", port: int = 0):
+        self._render = render
+        self.host = host
+        self._requested_port = port
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            return self._requested_port
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def start(self) -> "MetricsExporter":
+        render = self._render
+        content_type = self.CONTENT_TYPE
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            # the exporter must never spam the daemon's stderr per scrape
+            def log_message(self, fmt, *args):  # noqa: A002 - stdlib sig
+                pass
+
+            def _reply(self, body: bytes, ctype: str) -> None:
+                # a scraper with a short timeout may drop the connection
+                # mid-body: that's its problem, not a stderr traceback
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass
+
+            def do_GET(self):  # noqa: N802 - stdlib naming
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    try:
+                        body = render().encode("utf-8")
+                    except Exception as e:  # noqa: BLE001 - 500, not a crash
+                        logger.warning("metrics render failed: %s", e)
+                        self.send_error(500, f"render failed: {e}")
+                        return
+                    self._reply(body, content_type)
+                elif path == "/healthz":
+                    self._reply(b"ok\n", "text/plain")
+                else:
+                    self.send_error(404, "only /metrics and /healthz")
+
+        class _Server(http.server.ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # socketserver prints a full traceback here by default —
+                # an aborted scrape (BrokenPipeError past the handler's
+                # own guard) must stay silent on the daemon's stderr
+                pass
+
+        self._httpd = _Server((self.host, self._requested_port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="specpride-metrics-exporter", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._httpd = None
+        self._thread = None
+
+
+# -- strict text-format checker (tests + CI) ----------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>\S+)(?: (?P<ts>-?\d+))?$"
+)
+_LABEL_RE = re.compile(
+    r'(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"'
+)
+_TYPES = frozenset({"counter", "gauge", "histogram", "summary", "untyped"})
+
+
+def _parse_value(tok: str) -> float | None:
+    if tok in ("+Inf", "Inf"):
+        return float("inf")
+    if tok == "-Inf":
+        return float("-inf")
+    try:
+        return float(tok)
+    except ValueError:
+        return None
+
+
+def _parse_labels(raw: str, problems: list, lineno: int) -> tuple | None:
+    """``a="x",b="y"`` -> sorted ((name, value), ...) or None on error."""
+    out = []
+    pos = 0
+    raw = raw.strip()
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            problems.append(f"line {lineno}: malformed label at {raw[pos:]!r}")
+            return None
+        out.append((m.group("name"), m.group("value")))
+        pos = m.end()
+        if pos < len(raw):
+            if raw[pos] != ",":
+                problems.append(
+                    f"line {lineno}: expected ',' between labels"
+                )
+                return None
+            pos += 1
+    names = [n for n, _ in out]
+    if len(names) != len(set(names)):
+        problems.append(f"line {lineno}: duplicate label name")
+        return None
+    return tuple(sorted(out))
+
+
+def parse_exposition(text: str) -> tuple[dict, list[str]]:
+    """Strictly parse a Prometheus text exposition.
+
+    Returns ``(samples, problems)`` — ``samples`` maps ``(metric_name,
+    ((label, value), ...))`` to the float value.  ``problems`` is empty
+    for a conforming exposition; the checks cover what a real scraper
+    enforces plus the histogram invariants: TYPE before (and at most
+    once per) metric, valid metric/label names, parseable values, no
+    duplicate series, cumulative non-decreasing ``_bucket`` counts with
+    a ``+Inf`` bucket equal to ``_count``, and a trailing newline."""
+    problems: list[str] = []
+    samples: dict[tuple, float] = {}
+    typed: dict[str, str] = {}
+    seen_sample_of: set[str] = set()
+    if text and not text.endswith("\n"):
+        problems.append("exposition does not end with a newline")
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] in ("HELP", "TYPE"):
+                if len(parts) < 3 or not _NAME_RE.fullmatch(parts[2]):
+                    problems.append(
+                        f"line {lineno}: malformed {parts[1]} comment"
+                    )
+                    continue
+                if parts[1] == "TYPE":
+                    name = parts[2]
+                    mtype = parts[3].strip() if len(parts) > 3 else ""
+                    if mtype not in _TYPES:
+                        problems.append(
+                            f"line {lineno}: unknown TYPE {mtype!r}"
+                        )
+                    if name in typed:
+                        problems.append(
+                            f"line {lineno}: duplicate TYPE for {name}"
+                        )
+                    if name in seen_sample_of:
+                        problems.append(
+                            f"line {lineno}: TYPE for {name} after its "
+                            "samples"
+                        )
+                    typed[name] = mtype
+            # other comments are allowed and ignored
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        name = m.group("name")
+        value = _parse_value(m.group("value"))
+        if value is None:
+            problems.append(
+                f"line {lineno}: bad value {m.group('value')!r}"
+            )
+            continue
+        labels = _parse_labels(m.group("labels") or "", problems, lineno)
+        if labels is None:
+            continue
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        seen_sample_of.add(name)
+        seen_sample_of.add(base)
+        key = (name, labels)
+        if key in samples:
+            problems.append(f"line {lineno}: duplicate series {key}")
+        samples[key] = value
+    # histogram invariants per (base name, non-le label set)
+    for name, mtype in typed.items():
+        if mtype != "histogram":
+            continue
+        series: dict[tuple, list] = {}
+        counts: dict[tuple, float] = {}
+        for (sname, labels), value in samples.items():
+            rest = tuple(kv for kv in labels if kv[0] != "le")
+            if sname == f"{name}_bucket":
+                le = dict(labels).get("le")
+                if le is None:
+                    problems.append(f"{name}_bucket missing le label")
+                    continue
+                series.setdefault(rest, []).append(
+                    (_parse_value(le), value)
+                )
+            elif sname == f"{name}_count":
+                counts[labels] = value
+        for rest, buckets in series.items():
+            buckets.sort(key=lambda b: b[0])
+            cum = [v for _, v in buckets]
+            if any(b > a for a, b in zip(cum[1:], cum)):
+                problems.append(
+                    f"{name}{dict(rest)}: bucket counts not cumulative"
+                )
+            if not buckets or buckets[-1][0] != float("inf"):
+                problems.append(f"{name}{dict(rest)}: no +Inf bucket")
+            elif counts.get(rest) is not None and (
+                buckets[-1][1] != counts[rest]
+            ):
+                problems.append(
+                    f"{name}{dict(rest)}: +Inf bucket != _count"
+                )
+            if rest not in counts:
+                problems.append(f"{name}{dict(rest)}: missing _count")
+    return samples, problems
+
+
+def validate_exposition(text: str) -> list[str]:
+    """Problems list (empty = conforming); see :func:`parse_exposition`."""
+    return parse_exposition(text)[1]

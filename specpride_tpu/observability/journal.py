@@ -81,9 +81,16 @@ EVENT_FIELDS: dict[str, frozenset] = {
     "serve_start": frozenset({"socket", "max_queue"}),
     "job_queued": frozenset({"job_id", "client"}),
     "job_start": frozenset({"job_id"}),
+    # job_done optionally carries the SLO evaluation (`slo_objective_s`,
+    # `slo_latency_s`, `slo_ok`) when the daemon booted with --slo —
+    # additive fields, so pre-SLO consumers keep validating
     "job_done": frozenset({"job_id", "status", "wall_s"}),
     "job_rejected": frozenset({"reason"}),
     "serve_drain": frozenset({"n_rejected"}),
+    # on-demand device profiling (`specpride profile` against a live
+    # daemon): one bounded jax.profiler capture window
+    "profile_start": frozenset({"seconds"}),
+    "profile_done": frozenset({"seconds", "trace_dir"}),
     "bench_run": frozenset({"method", "phases_s"}),
     "run_end": frozenset({"counters", "phases_s", "elapsed_s", "device"}),
     # v2: one finished tracing span (observability.tracing).  The span's

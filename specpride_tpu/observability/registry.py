@@ -6,12 +6,24 @@ and a plain JSON dict (folded into the run journal's ``run_end`` event).
 Counters are cumulative over the registry's lifetime — Prometheus
 semantics — so re-exporting after more work is monotone, and rewriting
 the textfile is idempotent for an unchanged registry.
+
+Thread contract: every mutator (``inc`` / ``set`` / ``observe``) and
+every export view locks per metric, so a live scraper (the serving
+daemon's ``/metrics`` endpoint, ``observability.exporter``) can render
+the registry WHILE the dispatch lane and async-fetch threads update it
+— no torn histogram states, no dict-changed-during-iteration.  The
+registry-level ``_metrics`` index has its own lock.  Multi-job
+processes keep ONE registry resident (Prometheus counters must be
+process-monotone); per-job attribution is snapshot-and-diff
+(``device_counters_snapshot`` + ``device_summary(since=...)``), never
+a reset.
 """
 
 from __future__ import annotations
 
 import math
 import os
+import threading
 
 # seconds buckets sized for dispatch/transfer latencies: sub-ms XLA calls
 # up to multi-second tunneled round trips
@@ -49,6 +61,10 @@ class _Metric:
         self.label_names = tuple(label_names)
         # label-values tuple -> float (counter/gauge) or histogram state
         self.samples: dict[tuple, object] = {}
+        # guards `samples` (and histogram state) against a concurrent
+        # scrape: per metric, so the dispatch hot path never contends
+        # with unrelated metrics
+        self._lock = threading.Lock()
 
     def _key(self, labels: dict) -> tuple:
         if set(labels) != set(self.label_names):
@@ -58,24 +74,45 @@ class _Metric:
             )
         return tuple(str(labels[n]) for n in self.label_names)
 
+    def clear(self) -> None:
+        """Drop every labeled sample (the live exporter resets ephemeral
+        label sets — per-client queue depths — each scrape, so departed
+        clients don't accumulate as stale series forever)."""
+        with self._lock:
+            self.samples.clear()
+
 
 class Counter(_Metric):
     def inc(self, n: float = 1, **labels) -> None:
         if n < 0:
             raise ValueError(f"{self.name}: counters only go up (got {n})")
         key = self._key(labels)
-        self.samples[key] = self.samples.get(key, 0.0) + n
+        with self._lock:
+            self.samples[key] = self.samples.get(key, 0.0) + n
 
     def value(self, **labels) -> float:
-        return float(self.samples.get(self._key(labels), 0.0))
+        key = self._key(labels)
+        with self._lock:
+            return float(self.samples.get(key, 0.0))
 
 
 class Gauge(_Metric):
     def set(self, v: float, **labels) -> None:
-        self.samples[self._key(labels)] = float(v)
+        key = self._key(labels)
+        with self._lock:
+            self.samples[key] = float(v)
+
+    def zero_all(self) -> None:
+        """Reset every existing labeled sample to 0 (keeps the series
+        alive — a scraper sees in-flight drop to 0, not disappear)."""
+        with self._lock:
+            for key in self.samples:
+                self.samples[key] = 0.0
 
     def value(self, **labels) -> float:
-        return float(self.samples.get(self._key(labels), 0.0))
+        key = self._key(labels)
+        with self._lock:
+            return float(self.samples.get(key, 0.0))
 
 
 class _HistState:
@@ -94,32 +131,41 @@ class Histogram(_Metric):
 
     def observe(self, v: float, **labels) -> None:
         key = self._key(labels)
-        st = self.samples.get(key)
-        if st is None:
-            st = self.samples[key] = _HistState(len(self.buckets))
-        for i, le in enumerate(self.buckets):
-            if v <= le:
-                st.counts[i] += 1
-                break
-        st.total += v
-        st.n += 1
+        with self._lock:
+            st = self.samples.get(key)
+            if st is None:
+                st = self.samples[key] = _HistState(len(self.buckets))
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    st.counts[i] += 1
+                    break
+            st.total += v
+            st.n += 1
 
 
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
+        self._index_lock = threading.Lock()
 
     def _register(self, cls, kind, name, help, labels, **kw) -> _Metric:
-        m = self._metrics.get(name)
-        if m is not None:
-            if m.kind != kind or m.label_names != tuple(labels):
-                raise ValueError(
-                    f"metric {name} re-registered as {kind}"
-                    f"{tuple(labels)} (was {m.kind}{m.label_names})"
-                )
+        with self._index_lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if m.kind != kind or m.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name} re-registered as {kind}"
+                        f"{tuple(labels)} (was {m.kind}{m.label_names})"
+                    )
+                return m
+            m = self._metrics[name] = cls(
+                kind, name, help, tuple(labels), **kw
+            )
             return m
-        m = self._metrics[name] = cls(kind, name, help, tuple(labels), **kw)
-        return m
+
+    def _sorted_metrics(self) -> list:
+        with self._index_lock:
+            return [self._metrics[name] for name in sorted(self._metrics)]
 
     def counter(self, name: str, help: str = "", labels=()) -> Counter:
         return self._register(Counter, "counter", name, help, labels)
@@ -139,33 +185,49 @@ class MetricsRegistry:
 
     def to_prometheus_text(self) -> str:
         lines: list[str] = []
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
+        for m in self._sorted_metrics():
+            name = m.name
+            with m._lock:
+                # consistent per-metric snapshot under its lock:
+                # histogram states copy so cum-bucket math reads a
+                # frozen view even while observes continue
+                samples = {
+                    key: (
+                        (tuple(st.counts), st.total, st.n)
+                        if isinstance(m, Histogram)
+                        else st
+                    )
+                    for key, st in m.samples.items()
+                }
+            if not samples:
+                # registered but never touched: a bare TYPE line with no
+                # samples is legal but pure noise — skip it
+                continue
             if m.help:
                 lines.append(f"# HELP {name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {name} {m.kind}")
-            for key in sorted(m.samples):
+            for key in sorted(samples):
                 labelstr = ",".join(
                     f'{ln}="{_escape_label(lv)}"'
                     for ln, lv in zip(m.label_names, key)
                 )
                 if isinstance(m, Histogram):
-                    st = m.samples[key]
+                    counts, total, n = samples[key]
                     cum = 0
-                    for le, c in zip(m.buckets, st.counts):
+                    for le, c in zip(m.buckets, counts):
                         cum += c
                         blabel = ",".join(
                             filter(None, [labelstr, f'le="{_fmt(le)}"'])
                         )
                         lines.append(f"{name}_bucket{{{blabel}}} {cum}")
                     blabel = ",".join(filter(None, [labelstr, 'le="+Inf"']))
-                    lines.append(f"{name}_bucket{{{blabel}}} {st.n}")
+                    lines.append(f"{name}_bucket{{{blabel}}} {n}")
                     base = f"{{{labelstr}}}" if labelstr else ""
-                    lines.append(f"{name}_sum{base} {_fmt(st.total)}")
-                    lines.append(f"{name}_count{base} {st.n}")
+                    lines.append(f"{name}_sum{base} {_fmt(total)}")
+                    lines.append(f"{name}_count{base} {n}")
                 else:
                     base = f"{{{labelstr}}}" if labelstr else ""
-                    lines.append(f"{name}{base} {_fmt(m.samples[key])}")
+                    lines.append(f"{name}{base} {_fmt(samples[key])}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_textfile(self, path: str) -> None:
@@ -178,25 +240,28 @@ class MetricsRegistry:
 
     def to_json(self) -> dict:
         out: dict = {}
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
-            if isinstance(m, Histogram):
-                out[name] = {
-                    "|".join(key) or "": {"sum": st.total, "count": st.n}
-                    for key, st in m.samples.items()
-                }
-            else:
-                out[name] = {
-                    "|".join(key) or "": v for key, v in m.samples.items()
-                }
+        for m in self._sorted_metrics():
+            with m._lock:
+                if isinstance(m, Histogram):
+                    out[m.name] = {
+                        "|".join(key) or "": {"sum": st.total, "count": st.n}
+                        for key, st in m.samples.items()
+                    }
+                else:
+                    out[m.name] = {
+                        "|".join(key) or "": v
+                        for key, v in m.samples.items()
+                    }
         return out
 
     def sum_counter(self, name: str) -> float:
         """Total over all label combinations (0.0 when never registered)."""
-        m = self._metrics.get(name)
+        with self._index_lock:
+            m = self._metrics.get(name)
         if m is None or isinstance(m, Histogram):
             return 0.0
-        return float(sum(m.samples.values()))
+        with m._lock:
+            return float(sum(m.samples.values()))
 
 
 # -- the device schema both backends share ------------------------------
@@ -209,40 +274,77 @@ _DEVICE_KEYS = (
 )
 
 
-def device_summary(registry: MetricsRegistry | None) -> dict:
+# the per-(kernel)-labeled counters device_summary folds; snapshot-and-
+# diff these when one registry outlives a single run (the serving
+# daemon's resident backend keeps ONE registry so /metrics stays
+# Prometheus-monotone — run_end must still report each job's OWN traffic)
+_DEVICE_COUNTERS = (
+    "specpride_compiles_total",
+    "specpride_dispatches_total",
+    "specpride_bytes_h2d_total",
+    "specpride_bytes_d2h_total",
+    "specpride_pack_real_elements_total",
+    "specpride_pack_padded_elements_total",
+    "specpride_rows_real_total",
+    "specpride_rows_padded_total",
+)
+
+
+def device_counters_snapshot(registry: MetricsRegistry | None) -> dict:
+    """Point-in-time totals of the device counters, for
+    ``device_summary(since=...)`` diffs (the same pattern as the
+    compile-cache / plan-cache run_end deltas)."""
+    if registry is None:
+        return {}
+    return {name: registry.sum_counter(name) for name in _DEVICE_COUNTERS}
+
+
+def device_summary(
+    registry: MetricsRegistry | None, since: dict | None = None
+) -> dict:
     """Scalar device-telemetry dict with a FIXED key set, for the journal's
     ``run_end.device`` field.  A numpy-backend run (no registry, or one the
     device instrumentation never touched) reports the same keys as zeros,
-    so oracle-vs-device journals diff cleanly."""
+    so oracle-vs-device journals diff cleanly.
+
+    ``since`` (a ``device_counters_snapshot``): report only the traffic
+    AFTER the snapshot — a long-lived multi-job process (the serving
+    daemon) attributes counters to the job that caused them without
+    resetting the resident registry mid-flight.  The peak-memory gauge is
+    a process watermark and reports its absolute value either way."""
     out = {k: 0 for k in _DEVICE_KEYS}
     if registry is None:
         return out
-    out["compiles"] = int(registry.sum_counter("specpride_compiles_total"))
-    out["dispatches"] = int(
-        registry.sum_counter("specpride_dispatches_total")
-    )
-    out["bytes_h2d"] = int(registry.sum_counter("specpride_bytes_h2d_total"))
-    out["bytes_d2h"] = int(registry.sum_counter("specpride_bytes_d2h_total"))
-    real = registry.sum_counter("specpride_pack_real_elements_total")
-    padded = registry.sum_counter("specpride_pack_padded_elements_total")
+    since = since or {}
+
+    def total(name: str) -> float:
+        return registry.sum_counter(name) - float(since.get(name, 0))
+
+    out["compiles"] = int(total("specpride_compiles_total"))
+    out["dispatches"] = int(total("specpride_dispatches_total"))
+    out["bytes_h2d"] = int(total("specpride_bytes_h2d_total"))
+    out["bytes_d2h"] = int(total("specpride_bytes_d2h_total"))
+    real = total("specpride_pack_real_elements_total")
+    padded = total("specpride_pack_padded_elements_total")
     out["pack_real_elements"] = int(real)
     out["pack_padded_elements"] = int(padded)
     out["padding_waste_frac"] = (
         round(1.0 - real / padded, 4) if padded > 0 else 0.0
     )
-    rows_r = registry.sum_counter("specpride_rows_real_total")
-    rows_p = registry.sum_counter("specpride_rows_padded_total")
+    rows_r = total("specpride_rows_real_total")
+    rows_p = total("specpride_rows_padded_total")
     out["rows_real"] = int(rows_r)
     out["rows_padded"] = int(rows_p)
     out["bucket_occupancy_frac"] = (
         round(rows_r / rows_p, 4) if rows_p > 0 else 0.0
     )
-    # read-only probe: must not register the gauge as a side effect (an
-    # empty metric would clutter the textfile with a sample-less TYPE line)
-    peak = registry._metrics.get("specpride_device_peak_bytes_in_use")
-    out["device_peak_bytes_in_use"] = int(
-        max(peak.samples.values()) if peak and peak.samples else 0
-    )
+    # read-only probe: must not register the gauge as a side effect
+    with registry._index_lock:
+        peak = registry._metrics.get("specpride_device_peak_bytes_in_use")
+    if peak is not None:
+        with peak._lock:
+            values = list(peak.samples.values())
+        out["device_peak_bytes_in_use"] = int(max(values) if values else 0)
     return out
 
 

@@ -126,6 +126,10 @@ class RunStats:
             "elapsed_s": round(self.elapsed, 3),
             "counters": dict(self.counters),
             "phases_s": {k: round(v, 3) for k, v in self.phases.items()},
+            # multi-lane executor accounting rides the summary so the
+            # serving daemon's terminal response (and its per-lane
+            # busy-seconds telemetry) sees it without re-reading journals
+            **({"pipeline": self.pipeline} if self.pipeline else {}),
         }
 
     def log_summary(self) -> None:

@@ -158,6 +158,30 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
         ]
         if fresh:
             sv["warm_jobs"] = sum(1 for f in fresh if f == 0)
+        # SLO accounting (daemons booted with --slo): job_done carries
+        # the per-job evaluation — aggregate per method into objective /
+        # jobs / breaches / burn, the view `stats --slo` renders
+        slo_jobs = [e for e in jobs if "slo_objective_s" in e]
+        if slo_jobs:
+            slo: dict = {}
+            for e in slo_jobs:
+                m = str(e.get("method") or "-")
+                row = slo.setdefault(m, {
+                    "objective_s": e["slo_objective_s"], "jobs": 0,
+                    "breaches": 0, "max_latency_s": 0.0,
+                })
+                row["objective_s"] = e["slo_objective_s"]
+                row["jobs"] += 1
+                if not e.get("slo_ok", True):
+                    row["breaches"] += 1
+                lat = e.get("slo_latency_s")
+                if isinstance(lat, (int, float)):
+                    row["max_latency_s"] = max(row["max_latency_s"], lat)
+            for row in slo.values():
+                row["burn_frac"] = round(row["breaches"] / row["jobs"], 4)
+                row["max_latency_s"] = round(row["max_latency_s"], 4)
+            sv["slo"] = slo
+            sv["slo_breaches"] = sum(r["breaches"] for r in slo.values())
         monos = [
             e["mono"] for e in jobs if isinstance(e.get("mono"), (int, float))
         ]
@@ -239,10 +263,34 @@ def _render_serving(sv: dict, out) -> None:
         bits.append(f"jobs_per_sec={sv['jobs_per_sec']}")
     if "warmed_kernels" in sv:
         bits.append(f"warmed_kernels={sv['warmed_kernels']}")
+    if "slo_breaches" in sv:
+        bits.append(f"slo_breaches={sv['slo_breaches']}")
     print(f"  serving: {' '.join(bits)}", file=out)
 
 
-def _render_run(run: dict, out) -> None:
+def _render_slo(run: dict, out) -> None:
+    """``stats --slo``: the per-method SLO table from a serving
+    journal's job_done evaluations (objective vs measured queue-wait +
+    wall latency, breach count, burn fraction)."""
+    sv = run.get("serving") or {}
+    slo = sv.get("slo")
+    if not slo:
+        print(
+            "  slo: no SLO-evaluated jobs in this journal (was the "
+            "daemon booted with --slo?)", file=out,
+        )
+        return
+    for method in sorted(slo):
+        row = slo[method]
+        print(
+            f"  slo: method={method} objective_s={row['objective_s']} "
+            f"jobs={row['jobs']} breaches={row['breaches']} "
+            f"burn={row['burn_frac']:.1%} "
+            f"max_latency_s={row['max_latency_s']}", file=out,
+        )
+
+
+def _render_run(run: dict, out, slo: bool = False) -> None:
     head = (
         f"{run['journal']}: {run.get('command', '?')}"
         f"/{run.get('method', '?')} backend={run.get('backend', '?')}"
@@ -265,6 +313,8 @@ def _render_run(run: dict, out) -> None:
             )
         if live:
             _render_serving(live, out)
+            if slo:
+                _render_slo(run, out)
         return
     counters = run.get("counters", {})
     print(
@@ -310,6 +360,8 @@ def _render_run(run: dict, out) -> None:
             )
     if run.get("serving"):
         _render_serving(run["serving"], out)
+        if slo:
+            _render_slo(run, out)
     ws = run.get("warmstart")
     if ws:
         bits = []
@@ -405,7 +457,7 @@ def _read_new_events(path: str, offset: int) -> tuple[list[dict], int]:
 
 def follow_stats(
     path: str, out=None, interval: float = 1.0, stop=None,
-    max_updates: int = 0, top_spans: int = 0,
+    max_updates: int = 0, top_spans: int = 0, slo: bool = False,
 ) -> int:
     """``specpride stats --follow``: tail ONE live journal (a serving
     daemon's or a running batch job's) and re-render the summary every
@@ -441,7 +493,8 @@ def follow_stats(
                     f"--- {stamp} update {updates}: {len(events)} "
                     f"event(s) ---", file=out,
                 )
-                _render_run(_summarize_run(path, segments[-1]), out)
+                _render_run(_summarize_run(path, segments[-1]), out,
+                            slo=slo)
                 if top_spans:
                     render_top_spans(
                         aggregate_spans([events]), top_spans, out
@@ -461,7 +514,7 @@ def follow_stats(
 
 def run_stats(
     journal_paths: list[str], json_out: str | None = None, out=None,
-    top_spans: int = 0,
+    top_spans: int = 0, slo: bool = False,
 ) -> int:
     out = out or sys.stdout
     files: list[str] = []
@@ -489,7 +542,7 @@ def run_stats(
             runs.append(_summarize_run(label, seg))
 
     for run in runs:
-        _render_run(run, out)
+        _render_run(run, out, slo=slo)
     span_rows = aggregate_spans(events_per_file) if top_spans else []
     if top_spans:
         render_top_spans(span_rows, top_spans, out)

@@ -119,6 +119,26 @@ def exit_code(msg: dict | None) -> int:
     return 2 if status == "rejected" else 1
 
 
+def profile(
+    socket_path: str | None, seconds: float = 3.0,
+    trace_dir: str | None = None, chrome_trace: str | None = None,
+    timeout: float | None = 30.0,
+) -> dict:
+    """``specpride profile``: one bounded ``jax.profiler`` capture on a
+    live daemon.  Blocks for roughly ``seconds`` (the daemon replies
+    when the window closes); ``timeout`` covers connect + the margin
+    past the window."""
+    payload: dict = {"op": "profile", "seconds": float(seconds)}
+    if trace_dir is not None:
+        payload["trace_dir"] = trace_dir
+    if chrome_trace is not None:
+        payload["chrome_trace"] = chrome_trace
+    return request(
+        socket_path, payload,
+        timeout=None if timeout is None else timeout + float(seconds),
+    )
+
+
 def wait_for_socket(
     socket_path: str | None, timeout: float = 60.0, interval: float = 0.1
 ) -> bool:

@@ -37,6 +37,17 @@ killing the daemon, execution errors are classified
 retriable-vs-permanent in the terminal response, and
 ``--watchdog-timeout`` arms the per-lane watchdog over the execution
 lane (a wedged job journals ``watchdog_stall`` with the lane name).
+
+Live telemetry plane (``observability.exporter``): ``--metrics-port``
+serves a Prometheus ``/metrics`` endpoint sampled at scrape time (queue
+depth total and per client, in-flight gauge, job counters/latency
+histograms, per-lane busy seconds, compile/plan-cache counters, the
+resident backend's dispatch-latency histogram and device peak-memory
+watermark), ``--slo method=seconds`` arms per-job latency objectives
+(journaled on ``job_done``, burn counters on ``/metrics``), the
+``profile`` op captures an on-demand ``jax.profiler`` device trace on
+the RUNNING warm daemon, and ``--metrics-out`` flushes a final textfile
+snapshot at drain — a drained daemon leaves its numbers behind.
 """
 
 from __future__ import annotations
@@ -48,7 +59,6 @@ import threading
 import time
 
 from specpride_tpu.observability import (
-    MetricsRegistry,
     RunStats,
     device_summary,
     logger,
@@ -99,6 +109,10 @@ class ServeDaemon:
         warmup_jobs: int = 0,
         watchdog_timeout: float = 0.0,
         journal_path: str | None = None,
+        metrics_port: int | None = None,
+        metrics_host: str = "127.0.0.1",
+        metrics_out: str | None = None,
+        slo: dict | None = None,
     ):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.compile_cache = compile_cache
@@ -112,11 +126,22 @@ class ServeDaemon:
         self.journal_path = journal_path
         self.journal = None
         self.backend = None
+        self.metrics_port = metrics_port
+        self.metrics_host = metrics_host
+        self.metrics_out = metrics_out
+        self.slo = dict(slo or {})
+        self.telemetry = None  # ServeTelemetry, built at boot
+        self.exporter = None  # MetricsExporter when --metrics-port given
+        self._profile_lock = threading.Lock()  # one capture at a time
         self.watchdog = Watchdog(watchdog_timeout)
         self.warmed_kernels = 0
         self.jobs_done = 0
         self.jobs_failed = 0
         self.jobs_rejected = 0
+        # jobs_rejected increments on CONCURRENT reader threads (and on
+        # drain) — unlike done/failed, which only the worker touches —
+        # so its read-modify-write needs a lock or bursts undercount
+        self._rejected_lock = threading.Lock()
         self._job_ids = iter(range(1, 1 << 62)).__next__
         self._listener: socket.socket | None = None
         self._stop = threading.Event()
@@ -162,6 +187,35 @@ class ServeDaemon:
             layout=self.layout, force_device=self.force_device,
             routing=routing,
         )
+        # the live telemetry plane: always built (it feeds the drain-time
+        # --metrics-out snapshot too), HTTP-exposed only with
+        # --metrics-port.  The resident backend's registry rides along so
+        # dispatch-latency histograms and the device memory watermark are
+        # scrapeable live — which is WHY that registry stays resident
+        # across jobs (run_end attribution diffs it per job instead).
+        from specpride_tpu.observability.exporter import (
+            MetricsExporter,
+            ServeTelemetry,
+        )
+
+        # probe for a LIVE incumbent BEFORE the exporter binds and the
+        # AOT warmup runs: losing the socket race after minutes of XLA
+        # compiles would waste the whole boot (the bind below re-checks
+        # — the race window stays closed, this is just the fast exit)
+        if os.path.exists(self.socket_path) and self._socket_alive():
+            raise SystemExit(
+                f"another daemon is serving on {self.socket_path} "
+                "(pass a different --socket, or stop it first)"
+            )
+        self.telemetry = ServeTelemetry(
+            slo=self.slo, extra_registries=(self.backend.metrics,),
+        )
+        self.telemetry.sampler = self._sample_live
+        if self.metrics_port is not None:
+            self.exporter = MetricsExporter(
+                self.telemetry.exposition, host=self.metrics_host,
+                port=self.metrics_port,
+            ).start()
         self._boot_warmup(state)
         sock_dir = os.path.dirname(self.socket_path)
         if sock_dir:
@@ -188,13 +242,43 @@ class ServeDaemon:
             max_queue=self.queue.capacity,
             warmed_kernels=self.warmed_kernels,
             boot_s=round(boot_s, 4),
+            **({"metrics_port": self.exporter.port}
+               if self.exporter is not None else {}),
+            **({"slo": self.slo} if self.slo else {}),
         )
         logger.info(
             "serving on %s (boot %.2fs, %d kernel variants warmed, "
             "queue depth %d)", self.socket_path, boot_s,
             self.warmed_kernels, self.queue.capacity,
         )
+        if self.exporter is not None:
+            logger.info("live metrics on %s", self.exporter.url)
         return self
+
+    def _sample_live(self, telemetry) -> None:
+        """Scrape-time gauge refresh — every ``/metrics`` GET (and the
+        drain-time textfile flush) sees CURRENT queue/in-flight state,
+        not the state at the last job boundary."""
+        telemetry.queue_depth.set(len(self.queue))
+        # per-client depths are an ephemeral label set: clear-and-set so
+        # departed clients don't linger as stale series forever
+        telemetry.queue_depth_client.clear()
+        for client, n in self.queue.depths().items():
+            telemetry.queue_depth_client.set(n, client=str(client))
+        # in-flight zeroes (not clears): once a (command, method) pair
+        # has run, its series stays visible at 0 — scrapers see the drop
+        telemetry.inflight.zero_all()
+        job = self._inflight
+        telemetry.inflight_total.set(0 if job is None else 1)
+        if job is not None:
+            telemetry.inflight.set(
+                1, command=job.command,
+                method=str(getattr(job.args, "method", None) or "-"),
+                backend=getattr(job.args, "backend", "tpu"),
+            )
+        telemetry.uptime.set(
+            round(time.perf_counter() - self._t_boot, 3)
+        )
 
     def _socket_alive(self) -> bool:
         probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -321,6 +405,12 @@ class ServeDaemon:
                 )
             elif op == "status":
                 protocol.write_msg(fh, ok=True, **self.status())
+            elif op == "profile":
+                # runs on THIS reader thread (each connection has its
+                # own), so a capture window never blocks admission or
+                # the execution lane — profiling a daemon under load is
+                # the whole point
+                self._profile(msg, fh)
             elif op == "submit":
                 keep_open = self._admit(msg, conn, fh)
             else:
@@ -341,7 +431,14 @@ class ServeDaemon:
         job_id = self._job_ids()
 
         def reject(reason: str, retriable: bool) -> bool:
-            self.jobs_rejected += 1
+            with self._rejected_lock:
+                self.jobs_rejected += 1
+            # bounded label cardinality: free-text parser messages all
+            # count as "invalid"; the retriable categories keep their name
+            self.telemetry.job_rejected(
+                reason if reason in ("draining", "queue_full")
+                else "invalid"
+            )
             self.journal.emit(
                 "job_rejected", job_id=job_id, reason=reason,
                 retriable=retriable,
@@ -406,6 +503,160 @@ class ServeDaemon:
             job.ack.set()  # even on a dead client the worker must not wait
         return True
 
+    # -- on-demand device profiling -------------------------------------
+
+    def _profile(self, msg: dict, fh) -> None:
+        """``specpride profile``: one bounded ``jax.profiler`` capture
+        window on the RUNNING warm daemon — no restart, no cold
+        recompile on the next job (start/stop trace does not touch the
+        jit caches).  Also slices the daemon journal's events that
+        landed inside the window into ``<trace_dir>/journal_window.jsonl``
+        so the device trace and the serving timeline line up.  One
+        capture at a time (jax has a single global profiler session);
+        a concurrent request is rejected retriable."""
+        seconds = msg.get("seconds", 3.0)
+        if not isinstance(seconds, (int, float)) or not (
+            0 < seconds <= protocol.PROFILE_MAX_SECONDS
+        ):
+            protocol.write_msg(
+                fh, ok=False, status="rejected",
+                reason=f"seconds must be in (0, "
+                f"{protocol.PROFILE_MAX_SECONDS}]", retriable=False,
+            )
+            return
+        trace_dir = msg.get("trace_dir")
+        chrome_trace = msg.get("chrome_trace")
+        for name, val in (("trace_dir", trace_dir),
+                          ("chrome_trace", chrome_trace)):
+            if val is not None and not isinstance(val, str):
+                protocol.write_msg(
+                    fh, ok=False, status="rejected",
+                    reason=f"{name} must be a string path", retriable=False,
+                )
+                return
+        if not self._profile_lock.acquire(blocking=False):
+            protocol.write_msg(
+                fh, ok=False, status="rejected",
+                reason="a profile capture is already running",
+                retriable=True,
+            )
+            return
+        started = False
+        try:
+            import glob as _glob
+            import shutil
+            import tempfile
+
+            import jax
+
+            if trace_dir is None:
+                trace_dir = tempfile.mkdtemp(prefix="specpride_profile_")
+            else:
+                os.makedirs(trace_dir, exist_ok=True)
+            mono0 = time.perf_counter()
+            self.journal.emit(
+                "profile_start", seconds=seconds, trace_dir=trace_dir,
+            )
+            try:
+                # perfetto trace only when the caller wants the
+                # chrome-loadable artifact (it costs an extra export)
+                jax.profiler.start_trace(
+                    trace_dir, create_perfetto_trace=bool(chrome_trace)
+                )
+            except TypeError:  # older jax without the kwarg
+                jax.profiler.start_trace(trace_dir)
+            started = True
+            deadline = mono0 + float(seconds)
+            while not self._stop.is_set():
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                time.sleep(min(0.2, remaining))
+            jax.profiler.stop_trace()
+            started = False
+            mono1 = time.perf_counter()
+            artifacts = sorted(
+                p for p in _glob.glob(
+                    os.path.join(trace_dir, "**"), recursive=True
+                )
+                if os.path.isfile(p)
+            )
+            perfetto = next(
+                (p for p in artifacts
+                 if os.path.basename(p).startswith("perfetto_trace")),
+                None,
+            )
+            if chrome_trace and perfetto:
+                shutil.copyfile(perfetto, chrome_trace)
+            window = self._journal_window(trace_dir, mono0, mono1)
+            self.journal.emit(
+                "profile_done", seconds=round(mono1 - mono0, 4),
+                trace_dir=trace_dir, n_artifacts=len(artifacts),
+            )
+            logger.info(
+                "profile: %.2fs window, %d artifact(s) -> %s",
+                mono1 - mono0, len(artifacts), trace_dir,
+            )
+            protocol.write_msg(
+                fh, ok=True, status="profiled",
+                seconds=round(mono1 - mono0, 4), trace_dir=trace_dir,
+                artifacts=[os.path.relpath(p, trace_dir)
+                           for p in artifacts],
+                chrome_trace=(
+                    chrome_trace if chrome_trace and perfetto else None
+                ),
+                **window,
+            )
+        except Exception as e:  # noqa: BLE001 - reported to the client
+            if started:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
+            logger.warning("profile capture failed: %s", e)
+            try:
+                protocol.write_msg(
+                    fh, ok=False, status="error",
+                    error=f"{type(e).__name__}: {e}", retriable=False,
+                )
+            except OSError:
+                pass
+        finally:
+            self._profile_lock.release()
+
+    def _journal_window(
+        self, trace_dir: str, mono0: float, mono1: float
+    ) -> dict:
+        """The daemon-journal events whose ``mono`` landed inside the
+        capture window, written beside the device trace plus summarized
+        inline — so "what was the daemon doing during this profile?"
+        needs no manual timestamp math.  Empty dict without a journal."""
+        path = getattr(self.journal, "path", None)
+        if not path:
+            return {"window_events": {}}
+        counts: dict[str, int] = {}
+        out_path = os.path.join(trace_dir, "journal_window.jsonl")
+        try:
+            with open(path, encoding="utf-8") as src, \
+                    open(out_path, "w", encoding="utf-8") as dst:
+                for line in src:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # the torn in-progress tail
+                    mono = rec.get("mono")
+                    if isinstance(mono, (int, float)) and \
+                            mono0 <= mono <= mono1:
+                        dst.write(line)
+                        ev = rec.get("event", "?")
+                        counts[ev] = counts.get(ev, 0) + 1
+        except OSError as e:
+            logger.warning("journal window slice failed: %s", e)
+            return {"window_events": {}}
+        return {"journal_window": out_path, "window_events": counts}
+
     # -- execution lane -------------------------------------------------
 
     def _worker_loop(self) -> None:
@@ -445,12 +696,22 @@ class ServeDaemon:
                 self.jobs_done += 1
             else:
                 self.jobs_failed += 1
+            # fold the finished job into the live metric plane; the SLO
+            # evaluation (objective, measured latency, ok/breach) rides
+            # the journal's job_done so `stats --slo` and /metrics agree
+            slo_fields = self.telemetry.job_done(
+                command=job.command,
+                method=getattr(job.args, "method", None),
+                status=status, wall_s=wall, queue_wait_s=wait_s,
+                summary=summary if isinstance(summary, dict) else None,
+            )
             self.journal.emit(
                 "job_done", job_id=job.job_id, status=status,
                 wall_s=round(wall, 4), queue_wait_s=round(wait_s, 4),
                 command=job.command,
                 method=getattr(job.args, "method", None),
                 fresh_compiles=cc.get("misses", 0),
+                **slo_fields,
                 **({"error": err} if err else {}),
             )
             job.ack.wait(timeout=10.0)  # admission line strictly first
@@ -487,13 +748,15 @@ class ServeDaemon:
         backend = None
         if getattr(job.args, "backend", "tpu") == "tpu":
             backend = self.backend
-            # per-job telemetry state on the shared backend: metrics and
-            # run stats are per-run by contract; the journal hook and
-            # pack accounting are (re)set by _open_run_journal, and the
+            # per-job telemetry state on the shared backend: run stats
+            # are per-run by contract; the journal hook and pack
+            # accounting are (re)set by _open_run_journal, and the
             # routing-note memo clears so EVERY job's journal carries
             # the routing events that applied to it.  Warm state
-            # (_seen_shapes, jit caches) deliberately survives.
-            backend.metrics = MetricsRegistry()
+            # (_seen_shapes, jit caches) deliberately survives — and so
+            # does the METRICS registry: /metrics serves it live, so its
+            # counters must stay Prometheus-monotone across jobs (each
+            # job's run_end diffs a device_counters_snapshot instead).
             backend.stats = RunStats()
             backend.pack_accounting = False
             backend._routing_noted.clear()
@@ -524,7 +787,10 @@ class ServeDaemon:
                 pass
         rejected = self.queue.drain()
         for job in rejected:
-            self.jobs_rejected += 1
+            with self._rejected_lock:
+                self.jobs_rejected += 1
+            if self.telemetry is not None:
+                self.telemetry.job_rejected("draining")
             self.journal.emit(
                 "job_rejected", job_id=job.job_id, reason="draining",
                 retriable=True,
@@ -541,7 +807,34 @@ class ServeDaemon:
         self._gate.set()  # a held test gate must not deadlock the drain
         if self._worker.is_alive():
             self._worker.join()
+        # wait out an in-flight profile capture (its window breaks on
+        # _stop within one sleep quantum, but stop_trace's export + the
+        # journal-window scan take real time): its profile_done must
+        # land BEFORE run_end, never after journal close.  Bounded — a
+        # wedged profiler must not hang the drain forever.
+        if self._profile_lock.acquire(timeout=60):
+            self._profile_lock.release()
+        else:
+            logger.warning(
+                "drain: a profile capture did not finish within 60s; "
+                "its journal events may be dropped"
+            )
         self.watchdog.stop()
+        # final telemetry: the exporter stops AFTER the worker joined so
+        # the last snapshot carries every job, and --metrics-out flushes
+        # the same exposition a scraper would have read — a drained
+        # daemon leaves its numbers behind, not just its journal
+        if self.exporter is not None:
+            self.exporter.stop()
+        if self.metrics_out and self.telemetry is not None:
+            try:
+                self.telemetry.write_textfile(self.metrics_out)
+                logger.info("final metrics -> %s", self.metrics_out)
+            except OSError as e:
+                logger.warning(
+                    "final metrics flush to %s failed: %s",
+                    self.metrics_out, e,
+                )
         uptime = time.perf_counter() - self._t_boot
         self.journal.emit(
             "serve_drain", n_rejected=len(rejected),
@@ -579,6 +872,12 @@ class ServeDaemon:
             "jobs_rejected": self.jobs_rejected,
             "warmed_kernels": self.warmed_kernels,
             "uptime_s": round(time.perf_counter() - self._t_boot, 2),
+            **(
+                {"metrics_port": self.exporter.port,
+                 "metrics_url": self.exporter.url}
+                if self.exporter is not None else {}
+            ),
+            **({"slo": self.slo} if self.slo else {}),
         }
 
     @staticmethod

@@ -11,6 +11,21 @@ Client -> server (one request per connection)::
     {"op": "submit", "argv": ["consensus", IN, OUT, "--method", ...]}
     {"op": "ping"}
     {"op": "status"}
+    {"op": "profile", "seconds": 3.0, "trace_dir": DIR,
+     "chrome_trace": FILE}
+
+``profile`` (``specpride profile``) captures a bounded ``jax.profiler``
+device trace on the RUNNING warm daemon — no restart, no cold
+recompile on the next job — plus the slice of the daemon journal that
+landed inside the window (``<trace_dir>/journal_window.jsonl``).  The
+reply names the artifacts::
+
+    {"ok": true, "status": "profiled", "seconds": 3.0,
+     "trace_dir": DIR, "artifacts": [...], "chrome_trace": FILE|null,
+     "journal_window": PATH, "window_events": {"job_done": 2, ...}}
+
+One capture runs at a time (jax has a single profiler session); a
+concurrent request is rejected with ``retriable: true``.
 
 Server -> client, for ``submit``: an admission line first, then —
 unless the job was rejected — exactly one terminal line when the job
@@ -52,8 +67,12 @@ PROTOCOL_VERSION = 1
 # from (and are safe under) the resident warm backend
 SERVABLE_COMMANDS = ("consensus", "select")
 
-# flags the DAEMON owns (boot-time backend/cache construction): a job
-# carrying one is rejected, never silently ignored
+# flags the DAEMON owns (boot-time backend/cache construction, and the
+# process-wide telemetry surface): a job carrying one is rejected,
+# never silently ignored.  --metrics-out is daemon-owned because the
+# resident backend registry is shared across jobs — a per-job textfile
+# dumped from it would report the daemon's cumulative traffic as the
+# job's (scrape /metrics, or read the drain snapshot, instead)
 DAEMON_ONLY_FLAGS = (
     "--compile-cache",
     "--routing-table",
@@ -63,11 +82,17 @@ DAEMON_ONLY_FLAGS = (
     "--coordinator",
     "--num-processes",
     "--process-id",
+    "--metrics-out",
 )
 
 # `specpride submit` exit code for a retriable non-success (BSD
 # EX_TEMPFAIL — the sysexits convention for "try again later")
 EX_TEMPFAIL = 75
+
+# ceiling on one `specpride profile` capture window: a profiler session
+# pins a reader thread and buffers device events in memory — "bounded"
+# is part of the verb's contract
+PROFILE_MAX_SECONDS = 300.0
 
 
 def default_socket_path() -> str:
@@ -116,7 +141,7 @@ def forbidden_flags(argv: list[str]) -> list[str]:
 # like --layou, which the token scan above cannot see)
 _DAEMON_OWNED_DESTS = (
     "compile_cache", "routing_table", "layout", "force_device",
-    "mesh", "coordinator", "num_processes", "process_id",
+    "mesh", "coordinator", "num_processes", "process_id", "metrics_out",
 )
 
 _daemon_owned_defaults: dict | None = None

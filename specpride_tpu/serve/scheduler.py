@@ -40,6 +40,12 @@ class AdmissionQueue:
         with self._cond:
             return self._total
 
+    def depths(self) -> dict:
+        """Queued-job count per client — the live exporter's scrape-time
+        view of queue pressure (who is waiting, and how much)."""
+        with self._cond:
+            return {client: len(q) for client, q in self._queues.items()}
+
     def offer(self, client, job) -> bool:
         """Enqueue ``job`` for ``client``; ``False`` when the queue is at
         capacity or closed (the caller rejects with a retriable

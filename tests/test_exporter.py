@@ -1,0 +1,562 @@
+"""Live telemetry plane: the in-process Prometheus ``/metrics``
+endpoint under concurrent submissions (scrape-during-job gauges, post-
+drain totals vs the journal), the strict exposition checker, SLO burn
+accounting + ``stats --slo``, on-demand ``specpride profile`` against a
+warm daemon, and the registry's thread-safety/snapshot-diff primitives
+the plane is built on."""
+
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from specpride_tpu.io.mgf import write_mgf
+from specpride_tpu.observability.exporter import (
+    MetricsExporter,
+    ServeTelemetry,
+    parse_exposition,
+    parse_slo_spec,
+    slo_objective,
+    validate_exposition,
+)
+from specpride_tpu.observability.journal import read_events
+from specpride_tpu.observability.registry import (
+    MetricsRegistry,
+    device_counters_snapshot,
+    device_summary,
+)
+from specpride_tpu.observability.stats_cli import run_stats
+from specpride_tpu.serve import client as sc
+from specpride_tpu.serve.daemon import ServeDaemon
+
+from conftest import make_cluster
+
+
+def _start(daemon: ServeDaemon) -> threading.Thread:
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    assert sc.wait_for_socket(daemon.socket_path, timeout=120), \
+        "daemon never answered ping"
+    return t
+
+
+def _stop(daemon: ServeDaemon, thread: threading.Thread) -> None:
+    daemon.drain()
+    thread.join(timeout=60)
+    assert not thread.is_alive(), "daemon thread did not exit after drain"
+
+
+def _scrape(daemon: ServeDaemon) -> tuple[dict, str]:
+    """GET /metrics; returns (samples, raw text) after a STRICT parse."""
+    text = urllib.request.urlopen(
+        daemon.exporter.url, timeout=10
+    ).read().decode("utf-8")
+    samples, problems = parse_exposition(text)
+    assert not problems, problems
+    return samples, text
+
+
+def _get(samples: dict, name: str, **labels):
+    return samples.get((name, tuple(sorted(labels.items()))))
+
+
+@pytest.fixture(scope="module")
+def workload(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("exporter_wl")
+    rng = np.random.default_rng(7)
+    # a DIFFERENT pack shape than test_serve's workload (4x30 vs 3x25):
+    # the bucket-plan cache is process-wide and digest-keyed on pack
+    # structure, and test_serve asserts its first job misses that cache
+    clusters = [
+        make_cluster(rng, f"cluster-{i}", n_members=4, n_peaks=30)
+        for i in range(10)
+    ]
+    src = tmp / "clustered.mgf"
+    write_mgf([s for c in clusters for s in c.members], src)
+    return str(src)
+
+
+class TestSloSpec:
+    def test_parse_and_precedence(self):
+        slo = parse_slo_spec("bin-mean=2.5, medoid=1, *=10")
+        assert slo == {"bin-mean": 2.5, "medoid": 1.0, "*": 10.0}
+        assert slo_objective(slo, "bin-mean") == 2.5
+        assert slo_objective(slo, "gap-average") == 10.0  # catch-all
+        assert slo_objective({"bin-mean": 2.0}, "medoid") is None
+        assert parse_slo_spec(None) == {}
+        assert parse_slo_spec("") == {}
+
+    @pytest.mark.parametrize("bad", [
+        "bin-mean", "=2", "bin-mean=fast", "bin-mean=0", "bin-mean=-1",
+    ])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+
+class TestExpositionChecker:
+    GOOD = (
+        "# HELP jobs_total served jobs\n"
+        "# TYPE jobs_total counter\n"
+        'jobs_total{method="bin-mean"} 3\n'
+        "# TYPE depth gauge\n"
+        "depth 0\n"
+        "# TYPE lat histogram\n"
+        'lat_bucket{le="0.1"} 1\n'
+        'lat_bucket{le="1"} 2\n'
+        'lat_bucket{le="+Inf"} 2\n'
+        "lat_sum 0.7\n"
+        "lat_count 2\n"
+    )
+
+    def test_conforming_document(self):
+        assert validate_exposition(self.GOOD) == []
+        samples, _ = parse_exposition(self.GOOD)
+        assert samples[("jobs_total", (("method", "bin-mean"),))] == 3.0
+        assert samples[("lat_count", ())] == 2.0
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda t: t.rstrip("\n"), "newline"),
+        (lambda t: t + "bad line here and more\n", "unparseable"),
+        (lambda t: t + "jobs_total{method=\"bin-mean\"} 4\n",
+         "duplicate series"),
+        (lambda t: t + "# TYPE jobs_total counter\n", "duplicate TYPE"),
+        (lambda t: t + "x nanops\n", "bad value"),
+        (lambda t: t.replace('le="1"} 2', 'le="1"} 0'),
+         "not cumulative"),
+        (lambda t: t.replace('lat_bucket{le="+Inf"} 2\n', ""),
+         "+Inf"),
+        (lambda t: t.replace("lat_count 2", "lat_count 3"),
+         "+Inf bucket != _count"),
+        (lambda t: t.replace("lat_count 2\n", ""), "missing _count"),
+        (lambda t: t + 'jobs_total{method=bin} 1\n', "malformed label"),
+    ])
+    def test_catches_violations(self, mutate, needle):
+        problems = validate_exposition(mutate(self.GOOD))
+        assert problems and any(needle in p for p in problems), problems
+
+
+class TestRegistryConcurrency:
+    def test_render_while_mutating(self):
+        """A scraper rendering WHILE worker threads inc counters and
+        observe histograms must never crash or read torn state; final
+        totals are exact."""
+        r = MetricsRegistry()
+        n_threads, n_iter = 4, 2000
+        stop = threading.Event()
+        errors: list = []
+
+        def _mutate(tid):
+            try:
+                c = r.counter("t_total", "x", labels=("tid",))
+                h = r.histogram("t_seconds", "x", labels=("tid",))
+                for i in range(n_iter):
+                    c.inc(1, tid=str(tid))
+                    h.observe(0.01 * (i % 7), tid=str(tid))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        def _render():
+            try:
+                while not stop.is_set():
+                    problems = validate_exposition(r.to_prometheus_text())
+                    assert not problems, problems
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=_mutate, args=(t,))
+            for t in range(n_threads)
+        ]
+        scraper = threading.Thread(target=_render)
+        scraper.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        stop.set()
+        scraper.join(timeout=60)
+        assert not errors, errors
+        assert r.sum_counter("t_total") == n_threads * n_iter
+        samples, problems = parse_exposition(r.to_prometheus_text())
+        assert not problems
+        for tid in range(n_threads):
+            assert _get(samples, "t_seconds_count", tid=str(tid)) == n_iter
+
+    def test_device_summary_snapshot_diff(self):
+        """Per-job attribution on a resident registry: the delta view
+        reports only post-snapshot traffic, the absolute view stays
+        cumulative (Prometheus-monotone)."""
+        r = MetricsRegistry()
+        r.counter(
+            "specpride_dispatches_total", "d", labels=("kernel",)
+        ).inc(5, kernel="k")
+        r.counter("specpride_bytes_h2d_total", "b").inc(100)
+        snap = device_counters_snapshot(r)
+        r.counter(
+            "specpride_dispatches_total", "d", labels=("kernel",)
+        ).inc(2, kernel="k")
+        r.counter("specpride_bytes_h2d_total", "b").inc(30)
+        delta = device_summary(r, since=snap)
+        assert delta["dispatches"] == 2 and delta["bytes_h2d"] == 30
+        total = device_summary(r)
+        assert total["dispatches"] == 7 and total["bytes_h2d"] == 130
+        assert device_counters_snapshot(None) == {}
+
+
+class TestServeTelemetryUnit:
+    def test_job_done_slo_and_lanes(self):
+        t = ServeTelemetry(slo={"bin-mean": 1.0, "*": 5.0})
+        fields = t.job_done(
+            command="consensus", method="bin-mean", status="done",
+            wall_s=0.4, queue_wait_s=0.1,
+            summary={
+                "phases_s": {"compute": 0.3},
+                "pipeline": {
+                    "pack_busy_s": [0.1, 0.2], "write_busy_s": 0.05,
+                    "async_write": True,
+                },
+            },
+        )
+        assert fields == {
+            "slo_objective_s": 1.0, "slo_latency_s": 0.5, "slo_ok": True,
+        }
+        breach = t.job_done(
+            command="consensus", method="bin-mean", status="done",
+            wall_s=2.0, queue_wait_s=0.0,
+        )
+        assert breach["slo_ok"] is False
+        t.job_done(
+            command="select", method="medoid", status="error",
+            wall_s=0.1, queue_wait_s=0.0,
+        )  # covered by the catch-all
+        assert t.jobs_done.value(command="consensus", method="bin-mean") == 2
+        assert t.jobs_failed.value(command="select", method="medoid") == 1
+        assert t.slo_breaches.value(method="bin-mean") == 1
+        assert t.slo_jobs.value(method="bin-mean") == 2
+        assert t.slo_jobs.value(method="medoid") == 1
+        assert t.lane_busy.value(lane="pack") == pytest.approx(0.3)
+        assert t.lane_busy.value(lane="write") == pytest.approx(0.05)
+        assert t.lane_busy.value(lane="dispatch") == pytest.approx(0.3)
+        problems = validate_exposition(t.exposition())
+        assert not problems, problems
+
+    def test_no_slo_configured_returns_no_fields(self):
+        t = ServeTelemetry()
+        assert t.job_done(
+            command="consensus", method="bin-mean", status="done",
+            wall_s=9.9, queue_wait_s=0.0,
+        ) == {}
+
+    def test_exporter_http_roundtrip_and_404(self):
+        exp = MetricsExporter(lambda: "# TYPE up gauge\nup 1\n").start()
+        try:
+            body = urllib.request.urlopen(
+                exp.url, timeout=10
+            ).read().decode()
+            assert body == "# TYPE up gauge\nup 1\n"
+            health = urllib.request.urlopen(
+                exp.url.replace("/metrics", "/healthz"), timeout=10
+            ).read()
+            assert health == b"ok\n"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    exp.url.replace("/metrics", "/nope"), timeout=10
+                )
+        finally:
+            exp.stop()
+
+
+class TestLiveExporter:
+    def test_scrape_during_job_then_totals_match_journal(
+        self, tmp_path_factory, workload
+    ):
+        """The acceptance bar: a scrape DURING an in-flight job shows
+        live queue-depth/in-flight gauges; after drain the counter and
+        histogram totals equal the journal-derived serving summary, and
+        the --metrics-out drain snapshot carries the same exposition."""
+        tmp = tmp_path_factory.mktemp("exporter_live")
+        d = ServeDaemon(
+            str(tmp / "s.sock"),
+            compile_cache=str(tmp / "cache"),
+            journal_path=str(tmp / "serve.jsonl"),
+            metrics_port=0,
+            metrics_out=str(tmp / "final.prom"),
+            slo={"*": 300.0},
+        )
+        d._gate.clear()  # hold the worker so the scrape sees it in flight
+        t = _start(d)
+        terms = {}
+
+        def _submit(tag, client):
+            terms[tag] = sc.submit_wait(d.socket_path, [
+                "consensus", workload, str(tmp / f"{tag}.mgf"),
+                "--method", "bin-mean",
+            ], client=client)
+
+        t1 = threading.Thread(target=_submit, args=("first", "tenant-a"))
+        t1.start()
+        deadline = time.time() + 30
+        while d._inflight is None and time.time() < deadline:
+            time.sleep(0.01)
+        assert d._inflight is not None
+        t2 = threading.Thread(target=_submit, args=("second", "tenant-b"))
+        t2.start()
+        while len(d.queue) < 1 and time.time() < deadline:
+            time.sleep(0.01)
+
+        # live mid-load scrape: one job gated in flight, one queued
+        samples, text = _scrape(d)
+        assert _get(
+            samples, "specpride_serve_inflight_jobs",
+            command="consensus", method="bin-mean", backend="tpu",
+        ) == 1
+        assert _get(samples, "specpride_serve_queue_depth") == 1
+        assert _get(
+            samples, "specpride_serve_queue_depth_client",
+            client="tenant-b",
+        ) == 1
+        assert _get(samples, "specpride_serve_uptime_seconds") > 0
+        # nothing finished yet
+        assert _get(
+            samples, "specpride_serve_jobs_done_total",
+            command="consensus", method="bin-mean",
+        ) is None
+
+        d._gate.set()
+        t1.join(timeout=120)
+        t2.join(timeout=120)
+        assert terms["first"]["status"] == "done"
+        assert terms["second"]["status"] == "done"
+
+        samples, _ = _scrape(d)
+        assert _get(
+            samples, "specpride_serve_jobs_done_total",
+            command="consensus", method="bin-mean",
+        ) == 2
+        assert _get(
+            samples, "specpride_serve_job_wall_seconds_count",
+            method="bin-mean",
+        ) == 2
+        assert _get(
+            samples, "specpride_serve_job_queue_wait_seconds_count",
+            method="bin-mean",
+        ) == 2
+        # the in-flight series drops to 0 but stays visible
+        assert _get(
+            samples, "specpride_serve_inflight_jobs",
+            command="consensus", method="bin-mean", backend="tpu",
+        ) == 0
+        assert _get(samples, "specpride_serve_queue_depth") == 0
+        url = d.exporter.url
+
+        _stop(d, t)
+        # the endpoint is down after drain...
+        with pytest.raises(OSError):
+            urllib.request.urlopen(url, timeout=2)
+        # ...but the drain snapshot carries the final exposition
+        final_text = (tmp / "final.prom").read_text()
+        final, problems = parse_exposition(final_text)
+        assert not problems, problems
+        events, violations = read_events(str(tmp / "serve.jsonl"))
+        assert not violations, violations
+        jobs_done = [
+            e for e in events
+            if e["event"] == "job_done" and e["status"] == "done"
+        ]
+        assert _get(
+            final, "specpride_serve_jobs_done_total",
+            command="consensus", method="bin-mean",
+        ) == len(jobs_done) == 2
+        assert _get(
+            final, "specpride_serve_job_wall_seconds_count",
+            method="bin-mean",
+        ) == len(jobs_done)
+        # histogram sums agree with the journal's walls (within rounding)
+        assert _get(
+            final, "specpride_serve_job_wall_seconds_sum",
+            method="bin-mean",
+        ) == pytest.approx(
+            sum(e["wall_s"] for e in jobs_done), abs=0.05
+        )
+        # SLO: both jobs under the generous catch-all objective
+        assert _get(
+            final, "specpride_serve_slo_jobs_total", method="bin-mean"
+        ) == 2
+        assert _get(
+            final, "specpride_serve_slo_breaches_total",
+            method="bin-mean",
+        ) is None  # never incremented — no breaches
+
+    def test_rejections_counted_by_category(
+        self, tmp_path_factory, workload
+    ):
+        tmp = tmp_path_factory.mktemp("exporter_rej")
+        d = ServeDaemon(
+            str(tmp / "s.sock"), compile_cache=str(tmp / "cache"),
+            metrics_port=0,
+        )
+        t = _start(d)
+        try:
+            term = sc.submit_wait(
+                d.socket_path, ["evaluate", "x", "y"]
+            )
+            assert term["status"] == "rejected"
+            # --metrics-out is daemon-owned now: a per-job textfile off
+            # the SHARED resident registry would report the daemon's
+            # cumulative traffic as the job's
+            term = sc.submit_wait(d.socket_path, [
+                "consensus", workload, str(tmp / "o.mgf"),
+                "--metrics-out", str(tmp / "o.prom"),
+            ])
+            assert term["status"] == "rejected" and not term["retriable"]
+            assert "--metrics-out" in term["reason"]
+            samples, _ = _scrape(d)
+            assert _get(
+                samples, "specpride_serve_jobs_rejected_total",
+                reason="invalid",
+            ) == 2
+        finally:
+            _stop(d, t)
+
+
+class TestProfileVerb:
+    def test_profile_against_warm_daemon(self, tmp_path_factory, workload):
+        """`specpride profile` on a live daemon: yields device-trace
+        artifacts without a restart, slices the journal window, and the
+        NEXT job still journals zero fresh compiles (the capture must
+        not perturb the warm jit caches)."""
+        tmp = tmp_path_factory.mktemp("exporter_prof")
+        d = ServeDaemon(
+            str(tmp / "s.sock"), compile_cache=str(tmp / "cache"),
+            journal_path=str(tmp / "serve.jsonl"),
+            layout="bucketized", force_device=True,
+        )
+        t = _start(d)
+        try:
+            warm = sc.submit_wait(d.socket_path, [
+                "consensus", workload, str(tmp / "w.mgf"),
+                "--method", "gap-average",
+            ])
+            assert warm["status"] == "done", warm
+            rep = sc.profile(
+                d.socket_path, seconds=0.3,
+                trace_dir=str(tmp / "prof"),
+                chrome_trace=str(tmp / "prof.json.gz"),
+            )
+            assert rep.get("status") == "profiled", rep
+            assert rep["trace_dir"] == str(tmp / "prof")
+            assert rep["artifacts"], "no device-trace artifacts captured"
+            for rel in rep["artifacts"]:
+                assert (tmp / "prof" / rel).is_file()
+            # the journal window landed beside the trace and holds the
+            # capture's own profile_start
+            assert rep.get("journal_window")
+            window = [
+                json.loads(line)
+                for line in open(rep["journal_window"])
+            ]
+            assert any(e["event"] == "profile_start" for e in window)
+            assert rep["window_events"].get("profile_start") == 1
+            # warm after profiling: zero fresh compiles on the next job
+            after = sc.submit_wait(d.socket_path, [
+                "consensus", workload, str(tmp / "a.mgf"),
+                "--method", "gap-average",
+            ])
+            assert after["status"] == "done", after
+            assert after["compile_cache"]["misses"] == 0, after
+        finally:
+            _stop(d, t)
+        events, violations = read_events(d.journal_path)
+        assert not violations, violations
+        names = [e["event"] for e in events]
+        assert "profile_start" in names and "profile_done" in names
+
+    def test_profile_validation_and_mutual_exclusion(
+        self, tmp_path_factory
+    ):
+        tmp = tmp_path_factory.mktemp("exporter_prof_val")
+        d = ServeDaemon(
+            str(tmp / "s.sock"), compile_cache=str(tmp / "cache"),
+        )
+        t = _start(d)
+        try:
+            bad = sc.request(
+                d.socket_path, {"op": "profile", "seconds": -1}
+            )
+            assert bad["status"] == "rejected" and not bad["retriable"]
+            bad = sc.request(
+                d.socket_path, {"op": "profile", "seconds": 1e9}
+            )
+            assert bad["status"] == "rejected" and not bad["retriable"]
+            bad = sc.request(
+                d.socket_path,
+                {"op": "profile", "seconds": 1, "trace_dir": 7},
+            )
+            assert bad["status"] == "rejected" and not bad["retriable"]
+            # one capture at a time: a held session rejects retriable
+            assert d._profile_lock.acquire(blocking=False)
+            try:
+                busy = sc.profile(d.socket_path, seconds=0.1)
+                assert busy["status"] == "rejected", busy
+                assert busy["retriable"] is True
+            finally:
+                d._profile_lock.release()
+        finally:
+            _stop(d, t)
+
+
+class TestSloStats:
+    def test_breach_counters_and_stats_slo_rendering(
+        self, tmp_path_factory, workload
+    ):
+        """An impossible objective burns on every job; `stats --slo`
+        renders the per-method table from the journal, and the serving
+        line carries the breach total."""
+        tmp = tmp_path_factory.mktemp("exporter_slo")
+        d = ServeDaemon(
+            str(tmp / "s.sock"), compile_cache=str(tmp / "cache"),
+            journal_path=str(tmp / "serve.jsonl"),
+            metrics_port=0,
+            slo={"bin-mean": 1e-6, "gap-average": 300.0},
+        )
+        t = _start(d)
+        try:
+            for method in ("bin-mean", "gap-average"):
+                term = sc.submit_wait(d.socket_path, [
+                    "consensus", workload, str(tmp / f"{method}.mgf"),
+                    "--method", method,
+                ])
+                assert term["status"] == "done", term
+            samples, _ = _scrape(d)
+            assert _get(
+                samples, "specpride_serve_slo_breaches_total",
+                method="bin-mean",
+            ) == 1
+            assert _get(
+                samples, "specpride_serve_slo_objective_seconds",
+                method="gap-average",
+            ) == 300.0
+        finally:
+            _stop(d, t)
+        events, violations = read_events(d.journal_path)
+        assert not violations, violations
+        jd = {
+            e["method"]: e for e in events if e["event"] == "job_done"
+        }
+        assert jd["bin-mean"]["slo_ok"] is False
+        assert jd["bin-mean"]["slo_objective_s"] == 1e-6
+        assert jd["gap-average"]["slo_ok"] is True
+        buf = io.StringIO()
+        assert run_stats([d.journal_path], out=buf, slo=True) == 0
+        rendered = buf.getvalue()
+        assert "slo_breaches=1" in rendered
+        assert "slo: method=bin-mean" in rendered and "burn=100.0%" in \
+            rendered
+        assert "slo: method=gap-average" in rendered and "burn=0.0%" in \
+            rendered
