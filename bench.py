@@ -368,6 +368,123 @@ def bench_fault_overhead(clusters, workdir: str, repeats: int = 5) -> dict:
     return out
 
 
+def bench_elastic(clusters, workdir: str, repeats: int = 3) -> dict:
+    """Elastic-mode overhead on a HEALTHY 2-rank run vs the static
+    block partition (PR9 acceptance: within host noise).
+
+    Both arms run the same 2-process fleet over the same input with the
+    same chunking (``--checkpoint-every 256``): *static* shards once via
+    ``--coordinator`` (jax.distributed over loopback, the
+    ``_shard_for_process`` path), *elastic* claims 512-cluster ranges
+    from the filesystem coordinator (leases + heartbeats + commit
+    markers — the whole fault-tolerance tax, paid with zero faults).
+    Wall is the slower rank's exit, min over ``repeats`` (the
+    fault_overhead estimator); the merged elastic output must be
+    byte-identical to the merged static output."""
+    import os
+    import shutil
+    import socket
+    import subprocess
+    import sys as _sys
+
+    src = _sweep_source(clusters, workdir)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+
+    def fleet(tag: str, mode: str, i: int) -> float:
+        out = os.path.join(workdir, f"{tag}_{i}.mgf")
+        # --mesh on BOTH arms: --coordinator implies the mesh kernel
+        # path, so the elastic arm must run the same kernels or the
+        # byte-parity check (and the timing) would compare different
+        # compute, not different coordination
+        common = [
+            _sys.executable, "-m", "specpride_tpu", "consensus", src, out,
+            "--method", "bin-mean", "--checkpoint-every", "256", "--mesh",
+        ]
+        if mode == "static":
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            argvs = [
+                common + [
+                    "--coordinator", f"localhost:{port}",
+                    "--num-processes", "2", "--process-id", str(r),
+                    "--checkpoint", f"{out}.ck.json",
+                ]
+                for r in range(2)
+            ]
+        else:
+            coord = os.path.join(workdir, f"{tag}_{i}.coord")
+            shutil.rmtree(coord, ignore_errors=True)
+            argvs = [
+                common + [
+                    "--elastic", coord, "--process-id", str(r),
+                    "--elastic-range", "512",
+                ]
+                for r in range(2)
+            ]
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen(
+                argv, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+            )
+            for argv in argvs
+        ]
+        for p in procs:
+            _, err = p.communicate(timeout=600)
+            assert p.returncode == 0, err.decode()[-2000:]
+        wall = time.perf_counter() - t0
+        merge = [
+            _sys.executable, "-m", "specpride_tpu", "merge-parts", out,
+        ]
+        merge += (
+            ["--num-processes", "2"] if mode == "static"
+            else ["--elastic", os.path.join(workdir, f"{tag}_{i}.coord")]
+        )
+        subprocess.run(merge, env=env, check=True,
+                       stdout=subprocess.DEVNULL)
+        return wall
+
+    # one unmeasured warmup pair per arm: first-fleet page-cache /
+    # compile-cache fill must not land on whichever arm runs first
+    fleet("el_warm_static", "static", 0)
+    fleet("el_warm_elastic", "elastic", 0)
+    walls: dict[str, list[float]] = {"static": [], "elastic": []}
+    for i in range(1, repeats + 1):
+        for mode in ("static", "elastic"):
+            walls[mode].append(fleet(f"el_{mode}", mode, i))
+    with open(os.path.join(workdir, f"el_static_{repeats}.mgf"), "rb") as fh:
+        static_bytes = fh.read()
+    with open(
+        os.path.join(workdir, f"el_elastic_{repeats}.mgf"), "rb"
+    ) as fh:
+        elastic_bytes = fh.read()
+    assert static_bytes == elastic_bytes, \
+        "elastic merge diverged from the static merge"
+    static = min(walls["static"])
+    elastic = min(walls["elastic"])
+    out = {
+        "repeats": repeats,
+        "ranks": 2,
+        "static_wall_s": round(static, 3),
+        "elastic_wall_s": round(elastic, 3),
+        "overhead_frac": (
+            round(elastic / static - 1.0, 4) if static > 0 else None
+        ),
+        "static_wall_all_s": [round(w, 3) for w in walls["static"]],
+        "elastic_wall_all_s": [round(w, 3) for w in walls["elastic"]],
+        "byte_identical": True,
+    }
+    eprint(
+        f"[elastic] static {static:.3f}s elastic {elastic:.3f}s "
+        f"-> overhead {out['overhead_frac']:+.2%}"
+    )
+    return out
+
+
 def bench_prefetch_sweep(
     clusters, workdir: str, prefetches=(0, 1, 2, 4)
 ) -> list[dict]:
@@ -1168,7 +1285,7 @@ def main() -> None:
         help="with --report: comma list of report sections to run "
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
         "prefetch_sweep,worker_sweep,fault_overhead,warm_start,serving,"
-        "telemetry,pallas",
+        "telemetry,elastic,pallas",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -1192,7 +1309,8 @@ def main() -> None:
     # never produce a silently empty report)
     all_sections = (
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
-        "worker_sweep,fault_overhead,warm_start,serving,telemetry,pallas"
+        "worker_sweep,fault_overhead,warm_start,serving,telemetry,"
+        "elastic,pallas"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -1339,6 +1457,8 @@ def main() -> None:
                     report["telemetry"] = bench_telemetry(
                         clusters, workdir
                     )
+                if "elastic" in secs:
+                    report["elastic"] = bench_elastic(clusters, workdir)
             if "pallas" in secs:
                 ab = pallas_ab(clusters, report_path=args.report)
                 if ab is not None:

@@ -217,6 +217,84 @@ env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     stats "$rb_tmp/chaos_pw4_on.jsonl" | grep -q "robustness:"
 rm -rf "$rb_tmp"
 
+echo "== robustness: elastic chaos pass (2 ranks, one SIGKILLed mid-run) =="
+# the elastic scale-out acceptance bar: with 2 elastic ranks and one
+# SIGKILLed mid-run (the rank_kill fault kind), the surviving rank must
+# (a) observe the lease expiry and reassign the dead rank's uncommitted
+# chunks (resuming — not redoing — its committed prefix via the sha256
+# manifest), (b) exit 0, and (c) produce a manifest-verified merged
+# output + QC report byte-identical to the single-host serial golden;
+# every lease_expire must pair with a chunk_reassign in the journal audit
+el_tmp=$(mktemp -d)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus tests/data/golden_clustered.mgf "$el_tmp/serial.mgf" \
+    --method bin-mean --backend tpu --qc-report "$el_tmp/serial_qc.json"
+# victim rank 1 (scan offset 1, ranges of 2 over 3 clusters): commits
+# range 1 whole and ONE chunk of range 0, then rank_kill fires at write
+# visit 2 — SIGKILL with the range-0 lease still held
+el_elastic() { # $1 = rank; rest = extra env as KEY=VAL words
+    _rank="$1"; shift
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" "$@" python -m specpride_tpu \
+        consensus tests/data/golden_clustered.mgf "$el_tmp/out.mgf" \
+        --method bin-mean --backend tpu \
+        --elastic "$el_tmp/coord" --process-id "$_rank" \
+        --elastic-range 2 --checkpoint-every 1 --elastic-ttl 1 \
+        --qc-report "$el_tmp/qc.json" --journal "$el_tmp/j.jsonl"
+}
+EL_RC=0
+el_elastic 1 SPECPRIDE_FAULTS="write:rank_kill:1:2" || EL_RC=$?
+test "$EL_RC" -ne 0  # SIGKILL: the victim must NOT exit cleanly
+test -f "$el_tmp/coord/done/range_00001.json"
+test ! -f "$el_tmp/coord/done/range_00000.json"
+# survivor rank 0: reassigns, completes, exits 0
+el_elastic 0
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    merge-parts "$el_tmp/out.mgf" --elastic "$el_tmp/coord" \
+    --qc-report "$el_tmp/qc.json"
+cmp "$el_tmp/serial.mgf" "$el_tmp/out.mgf"
+cmp "$el_tmp/serial_qc.json" "$el_tmp/qc.json"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$el_tmp" <<'EOF'
+import json, os, sys
+from specpride_tpu.parallel.elastic import audit_elastic
+from specpride_tpu.robustness.faults import audit_fault_recovery
+tmp = sys.argv[1]
+victim = [json.loads(l)
+          for l in open(os.path.join(tmp, "j.jsonl.part00001"))]
+survivor = [json.loads(l)
+            for l in open(os.path.join(tmp, "j.jsonl.part00000"))]
+kills = [e for e in victim
+         if e["event"] == "fault" and e["kind"] == "rank_kill"]
+assert kills, "the rank_kill fault never fired (is the plan armed?)"
+expires = [e for e in survivor if e["event"] == "lease_expire"]
+reassigns = [e for e in survivor if e["event"] == "chunk_reassign"]
+assert expires and reassigns, (expires, reassigns)
+assert reassigns[0]["from_rank"] == 1 and reassigns[0]["to_rank"] == 0
+merged = victim + survivor
+assert not audit_elastic(merged), "unpaired lease expiries"
+assert not audit_fault_recovery(merged), "unrecovered rank_kill"
+# the survivor RESUMED the dead rank's partial range (manifest-trusted
+# committed prefix), never redid it from scratch
+resumes = [e for e in survivor
+           if e["event"] == "resume" and e.get("n_done", 0) > 0]
+assert resumes, "survivor restarted the partial range from scratch"
+end = [e for e in survivor if e["event"] == "run_end"][-1]
+assert end["elastic"]["reassignments"] == 1, end["elastic"]
+print("elastic chaos OK: rank 1 SIGKILLed, rank 0 reassigned + resumed "
+      "its chunks, merged output + QC byte-identical to serial")
+EOF
+# `specpride stats` renders the multi-host rank view off the .part shards
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$el_tmp/j.jsonl" | grep -q "ranks: 2 seen"
+# merge-parts hardening: a missing middle shard refuses loudly
+rm "$el_tmp/out.mgf.part00000"
+MP_RC=0
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    merge-parts "$el_tmp/out.mgf" --elastic "$el_tmp/coord" \
+    2>"$el_tmp/mp.err" || MP_RC=$?
+test "$MP_RC" -ne 0
+grep -q "missing \[0\]" "$el_tmp/mp.err"
+rm -rf "$el_tmp"
+
 echo "== warm start: compile-cache + AOT warmup + zero fresh compiles =="
 # each method runs twice against ONE fresh --compile-cache dir: the cold
 # run pays (and journals) its XLA compiles and seeds the shape manifest;

@@ -205,6 +205,36 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
         help="shape manifest path (default: <compile-cache dir>/"
         "shape_manifest.json)",
     )
+    p.add_argument(
+        "--elastic", metavar="DIR",
+        help="elastic multi-host mode: instead of the static per-rank "
+        "block partition, ranks dynamically claim chunk RANGES from a "
+        "work queue in this shared directory (leases + heartbeats; no "
+        "network dependency beyond the filesystem).  Each committed "
+        "range is one <output>.part<range> shard with a sha256 "
+        "manifest; a rank that dies mid-range has its uncommitted "
+        "chunks reassigned to a survivor, and the merged output stays "
+        "byte-identical to a single-host serial run (merge with "
+        "`specpride merge-parts OUTPUT --elastic DIR`).  Rank identity "
+        "comes from --process-id, else auto-assigned.  See "
+        "docs/robustness.md",
+    )
+    p.add_argument(
+        "--elastic-range", type=int, default=0, metavar="N",
+        help="clusters per claimable chunk range (default 0 = twice "
+        "--checkpoint-every, so a reassigned range resumes from its "
+        "committed chunks instead of redoing everything)",
+    )
+    p.add_argument(
+        "--elastic-ttl", type=float, default=10.0, metavar="S",
+        help="lease time-to-live: a rank that stops heartbeating for "
+        "longer than S (+50%% clock-skew grace) loses its ranges to a "
+        "survivor (default 10)",
+    )
+    p.add_argument(
+        "--elastic-heartbeat", type=float, default=0.0, metavar="S",
+        help="heartbeat/lease-renewal interval (default 0 = TTL/4)",
+    )
 
 
 def _add_observability(p: argparse.ArgumentParser) -> None:
@@ -233,6 +263,19 @@ def _add_observability(p: argparse.ArgumentParser) -> None:
         "multi-host runs write one <FILE>.part<rank> per rank (for a "
         "single merged timeline run `specpride trace` over the "
         "--journal shards)",
+    )
+    p.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="(with --elastic) serve a live Prometheus /metrics "
+        "endpoint: per-rank heartbeat ages "
+        "(specpride_rank_heartbeat_age_seconds), ranges committed, "
+        "lease-expiry/reassignment counters — a dying rank is visible "
+        "on /metrics before the run fails (0 = ephemeral port; "
+        "loopback unless --metrics-host widens it)",
+    )
+    p.add_argument(
+        "--metrics-host", default="127.0.0.1", metavar="HOST",
+        help="bind address for --metrics-port (default 127.0.0.1)",
     )
 
 
@@ -310,6 +353,12 @@ def _shard_for_process(clusters: list, args) -> tuple[list, str]:
     comes from ``jax.process_index()`` (NOT ``--process-id``, which may be
     absent when jax auto-detects ranks), so manifests never collide on a
     shared filesystem."""
+    if getattr(args, "elastic", None):
+        # elastic mode shards DYNAMICALLY: ranges are claimed from the
+        # coordinator queue, outputs are per-range, and the per-rank
+        # telemetry renames happen in _run_elastic once the rank id is
+        # known (it may be auto-assigned, not --process-id)
+        return clusters, args.output
     if not getattr(args, "coordinator", None):
         return clusters, args.output
     import jax
@@ -903,6 +952,13 @@ def _commit_chunk(item: _CommitItem, args, journal, stats: RunStats,
     committed prefix that lands in the manifest."""
     import time as _time
 
+    fence = getattr(args, "_elastic_fence", None)
+    if fence is not None:
+        # elastic mode: prove this rank STILL holds the range's lease
+        # before any bytes land.  A rank that stalled past its TTL gets
+        # LeaseExpiredError (permanent — no retry) and abandons the
+        # range instead of racing the rank that took it over.
+        fence()
     if item.qc_rows:
         qc.extend(item.qc_rows)
     pre_bytes = (
@@ -1186,6 +1242,7 @@ def _dispatch_chunk(
 def _checkpointed_run(
     backend, method, clusters, args, stats: RunStats, scores=None,
     qc: list | None = None, journal=None, quarantine: Quarantine | None = None,
+    harness: Harness | None = None,
 ):
     """Chunked execution with a resume manifest (survey §5).
 
@@ -1204,7 +1261,13 @@ def _checkpointed_run(
     Output is chunk-invariant (every method is per-cluster), so pipelined
     and serial runs produce byte-identical files."""
     journal = journal if journal is not None else NullJournal()
-    harness = Harness.from_args(args, journal)
+    # an elastic run passes ONE caller-owned harness across all its
+    # ranges so fault-plan visit counters and retry accounting span the
+    # whole rank lifetime (a per-range plan would reset AFTER offsets at
+    # every range boundary); one-shot runs build and own theirs here
+    owns_harness = harness is None
+    if owns_harness:
+        harness = Harness.from_args(args, journal)
     try:
         return _checkpointed_run_impl(
             backend, method, clusters, args, stats, scores, qc, journal,
@@ -1215,12 +1278,15 @@ def _checkpointed_run(
         # when the run aborts mid-loop; close() disarms the global fault
         # plan and stops the watchdog so nothing leaks into the next
         # in-process invocation (tests, bench) whatever exit path ran
+        # (shared harnesses re-summarize cumulatively per range and
+        # close with their owner)
         rb = harness.summary(
             quarantined=quarantine.count if quarantine is not None else 0
         )
         if rb:
             stats.robustness = rb
-        harness.close()
+        if owns_harness:
+            harness.close()
 
 
 def _checkpointed_run_impl(
@@ -2051,6 +2117,12 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         **({"robustness": stats.robustness} if getattr(
             stats, "robustness", None
         ) else {}),
+        # elastic multi-host summary (absent on static runs): this
+        # rank's ranges run/committed and the expiries/reassignments it
+        # observed — the per-rank side of the stats rank view
+        **({"elastic": stats.elastic} if getattr(
+            stats, "elastic", None
+        ) else {}),
         # persistent-compile-cache accounting for THIS run: fresh XLA
         # compiles (misses) vs cache loads (hits) and seconds saved —
         # a warmed rerun reports misses == 0 (absent on oracle runs)
@@ -2077,6 +2149,219 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         export_run_metrics(registry, stats, device)
         registry.write_textfile(args.metrics_out)
         logger.info("metrics -> %s", args.metrics_out)
+
+
+def _elastic_range_paths(args, k: int):
+    """The per-range output/QC paths range ``k`` commits to.  Part files
+    are numbered by RANGE, not rank — ranges are contiguous cluster
+    blocks in plan order, so concatenating parts in range order
+    reproduces the single-host serial bytes no matter which rank ran
+    what."""
+    out = f"{args.output}.part{k:05d}"
+    qc = (
+        f"{args.qc_report}.part{k:05d}"
+        if getattr(args, "qc_report", None) else None
+    )
+    return out, qc
+
+
+def _run_elastic_range(
+    args, coord, claim, clusters, backend, scores, stats, journal,
+    harness: Harness,
+) -> bool:
+    """Run ONE claimed chunk range through the existing checkpointed
+    executor and commit it.
+
+    The range gets its own output part, QC shard, and (coordinator-
+    owned) schema-2 resume manifest; ``_checkpointed_run`` therefore
+    brings the whole PR5 integrity machinery to a takeover for free — a
+    dead rank's committed chunks are trusted via the manifest's sha256,
+    a torn tail is truncated at the record boundary, and only the
+    uncommitted remainder is recomputed, so the committed part is
+    byte-identical to what any single rank would have produced."""
+    from specpride_tpu.parallel.elastic import sha256_file
+    from specpride_tpu.robustness.errors import LeaseExpiredError
+
+    k = claim.range.range_id
+    sub = clusters[claim.range.start : claim.range.stop]
+    args_k = argparse.Namespace(**vars(args))
+    args_k.output, args_k.qc_report = _elastic_range_paths(args, k)
+    args_k.checkpoint = coord.checkpoint_path(k)
+    args_k.append = False
+    args_k._elastic_fence = lambda: coord.check_lease(k)
+    qc: list | None = [] if args_k.qc_report else None
+    try:
+        resumed, failed, qc_failed = _checkpointed_run(
+            backend, args.method, sub, args_k, stats, scores, qc=qc,
+            journal=journal,
+            quarantine=getattr(args, "_quarantine", None),
+            harness=harness,
+        )
+        if qc is not None:
+            _write_qc_report(
+                args_k, backend, sub, qc, stats, resumed, failed,
+                qc_failed,
+            )
+    except LeaseExpiredError as e:
+        # another rank holds this range now (we stalled past the TTL):
+        # abandon — our partial state is exactly what ITS resume pass
+        # repairs — and go claim fresh work
+        logger.warning(
+            "rank %d abandoning range %d: %s", coord.rank, k, e,
+        )
+        coord.release(k)
+        return False
+    manifest = {}
+    try:
+        with open(args_k.checkpoint, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError):
+        manifest = {}
+    output_bytes = manifest.get("output_bytes")
+    sha = manifest.get("sha256")
+    if not isinstance(output_bytes, int) or not sha:
+        # an empty range writes no chunk (hence no manifest): the commit
+        # marker still needs verifiable bytes for merge-parts
+        output_bytes = os.path.getsize(args_k.output)
+        sha = sha256_file(args_k.output, output_bytes)
+    committed = coord.commit(k, {
+        "start": claim.range.start,
+        "stop": claim.range.stop,
+        "part": os.path.basename(args_k.output),
+        "output_bytes": output_bytes,
+        "sha256": sha,
+        "n_clusters": claim.range.n_clusters,
+    })
+    if not committed:
+        # the double-commit race: a zombie peer finished the same range
+        # first.  Both parts hold identical bytes (per-cluster methods +
+        # the fence), so losing the marker race is benign — exactly one
+        # commit counts.
+        logger.warning(
+            "rank %d: range %d was already committed by another rank",
+            coord.rank, k,
+        )
+    coord.release(k)
+    return True
+
+
+def _run_elastic(
+    args, command: str, clusters, backend, scores, stats: RunStats,
+    quarantine: Quarantine | None,
+) -> None:
+    """``--elastic DIR``: the dynamic replacement for the static
+    ``_shard_for_process`` block partition (ROADMAP item 4).
+
+    Every rank runs this same loop: claim a chunk range under a lease,
+    run it through ``_checkpointed_run``, commit the range exactly once,
+    repeat; when nothing is claimable, poll until EVERY range has a
+    commit marker — a rank out of fresh work lingers as a warm spare, so
+    a peer dying at any point is noticed (lease expiry) and its
+    uncommitted chunks are reassigned.  Add hosts, survive losing
+    them."""
+    from specpride_tpu.parallel.coordinator import Coordinator
+
+    if getattr(args, "append", False):
+        raise SystemExit(
+            "--append is not supported with --elastic (each range owns "
+            "its part file; merge with `specpride merge-parts`)"
+        )
+    if getattr(args, "checkpoint", None):
+        # silently ignoring the user's path would strand any script that
+        # resumes/verifies against it
+        raise SystemExit(
+            "--checkpoint is coordinator-owned with --elastic (per-range "
+            "manifests live under <DIR>/ck/ — reassignment depends on "
+            "them); drop the flag"
+        )
+    root = args.elastic
+    os.makedirs(root, exist_ok=True)
+    rank = getattr(args, "process_id", None)
+    if rank is None:
+        rank = Coordinator.assign_rank(root)
+    rank = int(rank)
+    # per-rank telemetry shards, exactly like static multi-host runs
+    # (outputs/QC/checkpoints are per-RANGE instead — see
+    # _elastic_range_paths)
+    for attr in ("journal", "metrics_out", "chrome_trace"):
+        if getattr(args, attr, None):
+            setattr(args, attr, f"{getattr(args, attr)}.part{rank:05d}")
+    if quarantine is not None:
+        quarantine.rename(f"{quarantine.path}.part{rank:05d}")
+    range_size = int(getattr(args, "elastic_range", 0) or 0)
+    if range_size <= 0:
+        range_size = 2 * max(int(getattr(args, "checkpoint_every", 512)), 1)
+    journal = _open_run_journal(args, backend, command, len(clusters))
+    if quarantine is not None:
+        quarantine.bind(journal)
+    _run_warmup(args, backend, journal)
+    coord = Coordinator(
+        root, rank, len(clusters), range_size,
+        ttl=float(getattr(args, "elastic_ttl", 10.0) or 10.0),
+        heartbeat_interval=float(
+            getattr(args, "elastic_heartbeat", 0.0) or 0.0
+        ),
+        journal=journal,
+    )
+    logger.info(
+        "elastic rank %d: %d ranges of <=%d clusters under %s "
+        "(ttl %.1fs)", rank, len(coord.ranges), range_size, root,
+        coord.ttl,
+    )
+    exporter = None
+    if getattr(args, "metrics_port", None) is not None:
+        from specpride_tpu.observability.exporter import (
+            ElasticTelemetry,
+            MetricsExporter,
+        )
+
+        telemetry = ElasticTelemetry(
+            coord,
+            extra_registries=tuple(
+                r for r in (getattr(backend, "metrics", None),)
+                if r is not None
+            ),
+        )
+        exporter = MetricsExporter(
+            telemetry.exposition,
+            host=getattr(args, "metrics_host", "127.0.0.1"),
+            port=args.metrics_port,
+        ).start()
+        logger.info("elastic liveness metrics -> %s", exporter.url)
+    # ONE harness for the whole rank lifetime: fault-plan visit counters
+    # (chaos CI's rank_kill AFTER offsets) and retry accounting must
+    # span ranges, not reset at every range boundary
+    harness = Harness.from_args(args, journal)
+    try:
+        while True:
+            claim = coord.claim_next()
+            if claim is None:
+                if coord.all_committed():
+                    break
+                # every open range is leased by a (presumed) live peer:
+                # linger as a warm spare so a peer's death is noticed
+                coord.wait_for_work()
+                continue
+            _run_elastic_range(
+                args, coord, claim, clusters, backend, scores, stats,
+                journal, harness,
+            )
+    finally:
+        harness.close()
+        if exporter is not None:
+            exporter.stop()
+        coord.stop()
+    _save_shape_manifest(args, backend)
+    stats.elastic = {
+        "rank": rank,
+        "n_ranges": len(coord.ranges),
+        "range_size": range_size,
+        "ranges_run": coord.ranges_run,
+        "ranges_committed": coord.done_count(),
+        "lease_expires_observed": coord.lease_expires_observed,
+        "reassignments": coord.reassignments,
+    }
+    _finish_run(args, backend, stats, journal)
 
 
 def _run_pipeline_command(args, command: str, backend=None) -> dict:
@@ -2127,6 +2412,23 @@ def _run_pipeline_command(args, command: str, backend=None) -> dict:
             if command == "select" and args.method == "best" else None
         )
         clusters, args.output = _shard_for_process(clusters, args)
+        if getattr(args, "metrics_port", None) is not None and not (
+            getattr(args, "elastic", None)
+        ):
+            logger.warning(
+                "--metrics-port only serves the elastic rank-liveness "
+                "exporter; ignoring it without --elastic (end-of-run "
+                "metrics: --metrics-out)"
+            )
+        if getattr(args, "elastic", None):
+            # dynamic chunk-range distribution with rank-fault tolerance
+            # replaces the single checkpointed run below; _run_elastic
+            # owns its (per-rank) journal and run_end
+            _run_elastic(
+                args, command, clusters, backend, scores, stats,
+                quarantine,
+            )
+            return stats.summary()
         journal = _open_run_journal(args, backend, command, len(clusters))
         if quarantine is not None:
             quarantine.bind(journal)  # flush blocks found during parse
@@ -2379,14 +2681,27 @@ def cmd_trace(args) -> int:
 
 
 def cmd_merge_parts(args) -> int:
-    """Concatenate multi-host ``<output>.part<id>`` shards (block-sharded,
-    so part order == cluster order) into the final file.  Refuses on a
-    gap in the rank sequence — a missing part means a rank never finished
-    and a silent merge would drop a contiguous block of clusters."""
+    """Concatenate multi-host ``<output>.part<id>`` shards (block-sharded
+    — static rank blocks or elastic chunk ranges, part order == cluster
+    order either way) into the final file.
+
+    Refuses, naming the rank, on:
+
+    * a **gap or duplicate** in the id sequence (expected count from
+      ``--elastic``'s plan, else ``--num-processes``, else the highest
+      id seen — so a missing MIDDLE shard never merges silently even
+      with no flags; only a missing TAIL needs the count pinned);
+    * a shard that fails its schema-2 manifest check — ``--elastic DIR``
+      verifies every part's size + sha256 against its range commit
+      marker, ``--checkpoint BASE`` against ``<BASE>.part<id>`` resume
+      manifests from a static run.
+
+    ``--qc-report FILE`` additionally merges the per-shard QC reports
+    into FILE, byte-identical to a single-host serial run's report."""
     import glob
     import shutil
 
-    parts = sorted(glob.glob(f"{args.output}.part*"))
+    parts = sorted(glob.glob(glob.escape(args.output) + ".part*"))
     if not parts:
         print(f"no part files match {args.output}.part*", file=sys.stderr)
         return 1
@@ -2397,20 +2712,90 @@ def cmd_merge_parts(args) -> int:
             print(f"unrecognized part name {p}", file=sys.stderr)
             return 1
         ranks.append(int(suffix))
-    expected = args.num_processes or len(parts)
+    plan = None
+    if getattr(args, "elastic", None):
+        from specpride_tpu.parallel.coordinator import Coordinator
+
+        plan = Coordinator.read_plan(args.elastic)
+        if plan is None:
+            print(
+                f"--elastic {args.elastic}: no readable plan.json — is "
+                "this the coordinator directory the ranks ran against?",
+                file=sys.stderr,
+            )
+            return 1
+    expected = (
+        plan["n_ranges"] if plan is not None
+        else args.num_processes or (max(ranks) + 1 if ranks else 0)
+    )
     missing = sorted(set(range(expected)) - set(ranks))
-    if missing or len(ranks) != len(set(ranks)):
+    extra = sorted(set(ranks) - set(range(expected)))
+    if missing or extra or len(ranks) != len(set(ranks)):
         print(
-            f"incomplete part set for {args.output}: have ranks {ranks}, "
-            f"missing {missing} — refusing to merge a gapped sequence "
-            "(pass --num-processes to pin the expected count)",
+            f"incomplete part set for {args.output}: have ids {ranks}, "
+            f"missing {missing}"
+            + (f", unexpected {extra}" if extra else "")
+            + " — refusing to merge a gapped sequence (a missing id "
+            "means a rank/range never committed; pass --num-processes "
+            "or --elastic to pin the expected count)",
             file=sys.stderr,
         )
         return 1
+    ordered = [p for _, p in sorted(zip(ranks, parts))]
+    # manifest verification BEFORE any byte moves: a corrupt or torn
+    # shard must fail the merge loudly, never reach the merged output
+    if plan is not None or getattr(args, "checkpoint", None):
+        from specpride_tpu.parallel.elastic import verify_part_manifest
+
+        for rank, part in sorted(zip(ranks, parts)):
+            if plan is not None:
+                mpath = os.path.join(
+                    args.elastic, "done", f"range_{rank:05d}.json"
+                )
+                kind = "commit marker"
+            else:
+                mpath = f"{args.checkpoint}.part{part.rsplit('.part', 1)[1]}"
+                kind = "checkpoint manifest"
+            try:
+                with open(mpath, encoding="utf-8") as fh:
+                    manifest = json.load(fh)
+            except (OSError, ValueError) as e:
+                print(
+                    f"rank/range {rank}: unreadable {kind} {mpath} ({e}) "
+                    "— refusing to merge an unverifiable shard",
+                    file=sys.stderr,
+                )
+                return 1
+            problem = verify_part_manifest(part, manifest)
+            if problem is not None:
+                print(
+                    f"rank/range {rank}: {part} fails its {kind}: "
+                    f"{problem} — refusing to merge",
+                    file=sys.stderr,
+                )
+                return 1
+    if getattr(args, "qc_report", None):
+        from specpride_tpu.parallel.elastic import merge_qc_reports
+
+        shards = []
+        for rank, part in sorted(zip(ranks, parts)):
+            qpath = f"{args.qc_report}.part{part.rsplit('.part', 1)[1]}"
+            if not os.path.exists(qpath):
+                print(
+                    f"rank/range {rank}: no QC shard {qpath} — refusing "
+                    "a partial QC merge", file=sys.stderr,
+                )
+                return 1
+            shards.append(qpath)
+        n_rows = merge_qc_reports(shards, args.qc_report)
+        logger.info(
+            "merged %d QC shards (%d clusters) -> %s",
+            len(shards), n_rows, args.qc_report,
+        )
     with open(args.output, "wb") as out:
         # order by parsed rank, not lexically: hand-renamed mixed-width
         # names (part2 vs part00010) would otherwise merge out of order
-        for _, p in sorted(zip(ranks, parts)):
+        for p in ordered:
             with open(p, "rb") as fh:
                 shutil.copyfileobj(fh, out)  # streams: parts can be huge
     if args.remove_parts:
@@ -2639,6 +3024,23 @@ def build_parser() -> argparse.ArgumentParser:
                     "<output>.part00000, <output>.part00001, ...)")
     pm.add_argument("--num-processes", type=int,
                     help="expected part count (refuse to merge fewer)")
+    pm.add_argument(
+        "--elastic", metavar="DIR",
+        help="verify against an elastic run's coordinator directory: "
+        "the plan pins the expected range count and every part's size "
+        "+ sha256 is checked against its range commit marker before "
+        "any bytes move",
+    )
+    pm.add_argument(
+        "--checkpoint", metavar="BASE",
+        help="verify each part against its <BASE>.part<id> schema-2 "
+        "resume manifest (size + sha256) from a static multi-host run",
+    )
+    pm.add_argument(
+        "--qc-report", metavar="FILE",
+        help="also merge the per-shard <FILE>.part<id> QC reports into "
+        "FILE (byte-identical to a single-host serial run's report)",
+    )
     pm.add_argument("--remove-parts", action="store_true",
                     help="delete the part files after a successful merge")
     pm.set_defaults(fn=cmd_merge_parts)

@@ -317,6 +317,89 @@ class ServeTelemetry:
         os.replace(tmp, path)
 
 
+# -- elastic rank liveness ----------------------------------------------
+
+
+class ElasticTelemetry:
+    """Live per-rank liveness for an elastic multi-host run
+    (``--elastic`` + ``--metrics-port``): the fleet view sampled per
+    scrape from the coordinator's shared directory, so a dying rank is
+    visible on ``/metrics`` (its ``specpride_rank_heartbeat_age_seconds``
+    climbs past the lease TTL) BEFORE any work is lost, and every
+    reassignment this rank performed is a counter an alert can burn on.
+
+    ``extra_registries`` ride along in the exposition (the CLI passes
+    the backend's device registry, so the rank's own dispatch traffic is
+    scrapeable too)."""
+
+    def __init__(self, coordinator, extra_registries: tuple = ()):
+        self.coord = coordinator
+        self.extra_registries = tuple(extra_registries)
+        self._render_lock = threading.Lock()
+        self._counters_last = {"expires": 0.0, "reassigns": 0.0}
+        r = self.registry = MetricsRegistry()
+        self.hb_age = r.gauge(
+            "specpride_rank_heartbeat_age_seconds",
+            "seconds since each rank's last heartbeat (sampled from the "
+            "coordinator directory at scrape time; an age past the "
+            "lease TTL means the rank is presumed dead)",
+            labels=("rank",),
+        )
+        self.ranges_total = r.gauge(
+            "specpride_elastic_ranges",
+            "chunk ranges in this run's work plan",
+        )
+        self.ranges_committed = r.gauge(
+            "specpride_elastic_ranges_committed",
+            "chunk ranges with a commit marker (run completes at "
+            "committed == total)",
+        )
+        self.rank_gauge = r.gauge(
+            "specpride_elastic_rank",
+            "this process's rank id (constant; a join key for alerts)",
+        )
+        self.lease_expires = r.counter(
+            "specpride_elastic_lease_expires_total",
+            "expired peer leases THIS rank observed",
+        )
+        self.reassigns = r.counter(
+            "specpride_elastic_reassignments_total",
+            "dead ranks' chunk ranges THIS rank reclaimed",
+        )
+
+    def exposition(self) -> str:
+        with self._render_lock:
+            coord = self.coord
+            # per-rank heartbeat ages: clear-and-set so a departed
+            # rank's final (huge) age doesn't linger as a stale series
+            # forever — its disappearance IS the signal once its ranges
+            # are reassigned
+            self.hb_age.clear()
+            for rank, age in coord.rank_heartbeat_ages().items():
+                self.hb_age.set(round(age, 3), rank=str(rank))
+            self.ranges_total.set(len(coord.ranges))
+            self.ranges_committed.set(coord.done_count())
+            self.rank_gauge.set(coord.rank)
+            for counter, attr, key in (
+                (self.lease_expires, "lease_expires_observed", "expires"),
+                (self.reassigns, "reassignments", "reassigns"),
+            ):
+                total = float(getattr(coord, attr, 0))
+                last = self._counters_last[key]
+                if total > last:
+                    counter.inc(total - last)
+                self._counters_last[key] = max(total, last)
+            # counters must exist from the first scrape (a 0-valued
+            # series beats an absent one for rate() queries)
+            self.lease_expires.inc(0)
+            self.reassigns.inc(0)
+            parts = [self.registry.to_prometheus_text()]
+            parts.extend(
+                r.to_prometheus_text() for r in self.extra_registries
+            )
+            return "".join(parts)
+
+
 # -- the HTTP endpoint --------------------------------------------------
 
 

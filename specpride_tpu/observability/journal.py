@@ -67,6 +67,20 @@ EVENT_FIELDS: dict[str, frozenset] = {
     "quarantine": frozenset({"path", "reason"}),
     # a lane section exceeded --watchdog-timeout
     "watchdog_stall": frozenset({"lane", "elapsed_s"}),
+    # elastic multi-host (specpride_tpu.parallel.coordinator): one rank
+    # liveness beat — renews held leases and rewrites the rank's
+    # heartbeat file; `holding` lists the range ids leased right now
+    "heartbeat": frozenset({"rank"}),
+    # a rank claimed a chunk range under a lease (takeover=True when the
+    # range carries a dead rank's partial state to resume)
+    "lease_claim": frozenset({"rank", "range"}),
+    # an observer found a lease expired past its TTL + grace: `rank` is
+    # the DEAD holder, `observed_by` the survivor about to reassign —
+    # every lease_expire must pair with a chunk_reassign (audited by
+    # `parallel.elastic.audit_elastic` and the chaos CI pass)
+    "lease_expire": frozenset({"rank", "range"}),
+    # the surviving rank reclaimed the dead rank's uncommitted chunks
+    "chunk_reassign": frozenset({"range", "from_rank", "to_rank"}),
     # warm-start subsystem (specpride_tpu.warmstart): how the persistent
     # compilation cache resolved for this run (dir, or the reason it
     # stayed off) — post-mortems must be able to tell cached from cold

@@ -83,6 +83,12 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
         if end and end.get("robustness"):
             rb["run_end"] = end["robustness"]
         run["robustness"] = rb
+    # elastic multi-host: the rank's own run_end summary (ranges run /
+    # committed, expiries + reassignments it observed); the cross-rank
+    # fleet view renders separately from the merged journals
+    el = (end or {}).get("elastic")
+    if el:
+        run["elastic"] = el
     # warm-start subsystem: AOT warmup outcomes + persistent-compile-
     # cache accounting (absent on runs that predate the subsystem or
     # never touched a device backend)
@@ -290,6 +296,33 @@ def _render_slo(run: dict, out) -> None:
         )
 
 
+def _render_rank_view(view: dict, out) -> None:
+    """The multi-host rank view (``parallel.elastic.summarize_ranks``):
+    one line per rank from the merged ``.part<rank>`` journals, plus the
+    lease-expiry/reassignment pairing audit."""
+    audit = view.get("unpaired_lease_expiries", 0)
+    state = "UNPAIRED" if audit else "unpaired"
+    print(
+        f"ranks: {len(view.get('ranks', {}))} seen, "
+        f"{view.get('reassignments', 0)} reassignment(s), "
+        f"{audit} {state} lease expiries", file=out,
+    )
+    for rank, r in view.get("ranks", {}).items():
+        age = r.get("last_heartbeat_age_s")
+        bits = [
+            f"last_heartbeat_age_s={age if age is not None else '-'}",
+            f"chunks={r.get('chunks_committed', 0)}",
+            f"ranges={r.get('ranges_claimed', 0)}",
+        ]
+        if r.get("takeovers"):
+            bits.append(f"takeovers={r['takeovers']}")
+        if r.get("leases_expired"):
+            bits.append(f"leases_expired={r['leases_expired']}")
+        if r.get("reassigned_away"):
+            bits.append(f"reassigned_away={r['reassigned_away']}")
+        print(f"  rank {rank}: {' '.join(bits)}", file=out)
+
+
 def _render_run(run: dict, out, slo: bool = False) -> None:
     head = (
         f"{run['journal']}: {run.get('command', '?')}"
@@ -387,6 +420,15 @@ def _render_run(run: dict, out, slo: bool = False) -> None:
         if "cache_dir" in ws:
             bits.append(f"cache={ws['cache_dir']}")
         print(f"  warmstart: {' '.join(bits)}", file=out)
+    el = run.get("elastic")
+    if el:
+        print(
+            f"  elastic: rank={el.get('rank')} "
+            f"ranges_run={el.get('ranges_run')}/"
+            f"{el.get('n_ranges')} "
+            f"committed={el.get('ranges_committed')} "
+            f"reassignments={el.get('reassignments', 0)}", file=out,
+        )
     rb = run.get("robustness")
     if rb:
         bits = " ".join(
@@ -495,6 +537,13 @@ def follow_stats(
                 )
                 _render_run(_summarize_run(path, segments[-1]), out,
                             slo=slo)
+                from specpride_tpu.parallel.elastic import (
+                    summarize_ranks,
+                )
+
+                view = summarize_ranks([segments[-1]])
+                if view is not None:
+                    _render_rank_view(view, out)
                 if top_spans:
                     render_top_spans(
                         aggregate_spans([events]), top_spans, out
@@ -543,6 +592,13 @@ def run_stats(
 
     for run in runs:
         _render_run(run, out, slo=slo)
+    # cross-rank fleet view: elastic liveness/reassignment rollup over
+    # ALL the journals read (the per-rank .part shards merge here)
+    from specpride_tpu.parallel.elastic import summarize_ranks
+
+    rank_view = summarize_ranks(events_per_file)
+    if rank_view is not None:
+        _render_rank_view(rank_view, out)
     span_rows = aggregate_spans(events_per_file) if top_spans else []
     if top_spans:
         render_top_spans(span_rows, top_spans, out)
@@ -567,6 +623,8 @@ def run_stats(
         )
     if json_out:
         agg = {"v": 1, "runs": runs, "totals": totals}
+        if rank_view is not None:
+            agg["elastic"] = rank_view
         if top_spans:
             agg["top_spans"] = span_rows[:top_spans]
         with open(json_out, "w", encoding="utf-8") as fh:

@@ -7,20 +7,29 @@ kernel input sharded along its leading (cluster) axis, XLA SPMD-partitions
 the vmapped programs with zero cross-device communication in the hot loop,
 and the only collectives are the output all-gather and a final metrics
 all-reduce (survey §2 / BASELINE.json config 5).
+
+Two submodules sit beside the mesh: :mod:`.coordinator` (the
+filesystem-backed elastic work queue — leases, heartbeats, exactly-once
+range commits) and :mod:`.elastic` (journal audits, the stats rank view,
+manifest-verified merging).  Both are jax-free, so the mesh exports below
+resolve LAZILY — ``specpride stats`` / ``merge-parts`` on a login node
+must not pay (or require) a jax import to read journals.
 """
 
-from specpride_tpu.parallel.mesh import (
-    CLUSTER_AXIS,
-    cluster_mesh,
-    cluster_sharding,
-    initialize_distributed,
-    shard_batch_arrays,
-)
-
-__all__ = [
+_MESH_EXPORTS = (
     "CLUSTER_AXIS",
     "cluster_mesh",
     "cluster_sharding",
     "initialize_distributed",
     "shard_batch_arrays",
-]
+)
+
+__all__ = list(_MESH_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _MESH_EXPORTS:
+        from specpride_tpu.parallel import mesh
+
+        return getattr(mesh, name)
+    raise AttributeError(name)

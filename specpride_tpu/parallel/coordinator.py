@@ -1,0 +1,559 @@
+"""Filesystem-backed work coordinator for elastic multi-host runs.
+
+The static ``_shard_for_process`` partition assumes a fixed healthy rank
+set: each rank owns one contiguous block of clusters for the whole run,
+and a rank that dies loses its block.  This module replaces the one-shot
+partition with **dynamic distribution of chunk ranges** over a shared
+directory — no network service beyond the filesystem every rank already
+mounts:
+
+* ``plan.json`` — the deterministic work plan: ``n_clusters`` split into
+  fixed cluster-index **ranges** of ``range_size``.  Every rank derives
+  the identical plan from its own input parse; the first rank persists
+  it atomically and later ranks verify theirs matches, so a fleet run
+  against divergent inputs fails loudly instead of merging garbage.
+* ``leases/range_<k>.json`` — at most one rank works a range at a time.
+  A claim is an ``O_EXCL`` create (atomic on POSIX and NFSv3+); the
+  holder renews by bumping the file's MTIME (``os.utime`` — atomic, so
+  a renewal can never overwrite a lease a stealer just re-created).  A
+  lease whose mtime is older than the holder's TTL (plus a grace margin
+  against clock skew) may be **stolen**: the observer renames it to a
+  tombstone — only one racer's rename succeeds — re-claims the range,
+  and only then journals ``lease_expire`` + ``chunk_reassign`` (losing
+  the re-claim race emits nothing: the winner's events cover it).
+* ``done/range_<k>.json`` — the commit marker: ``os.link`` from a
+  private temp file, so two ranks racing the same range commit exactly
+  once (link fails with ``EEXIST`` for the loser).  The marker carries
+  the range part file's ``output_bytes`` + ``sha256`` from the schema-2
+  checkpoint manifest, which is what ``merge-parts --elastic`` verifies
+  before concatenating.
+* ``hb/rank_<r>.json`` — per-rank heartbeat files (atomic replace), the
+  live view the metrics exporter samples; each beat is also journaled
+  as a ``heartbeat`` event so post-mortems can reconstruct liveness
+  from the ``.part<rank>`` journals alone.
+* ``ranks/`` — ``O_EXCL`` rank auto-assignment when ``--process-id`` is
+  not given: ranks need stable identities for journals/heartbeats, not
+  a fixed count.
+
+Fencing: the holder's lease carries a per-claim ``nonce``.  Before each
+chunk commit the executor calls :meth:`Coordinator.check_lease`; a
+missing lease or a foreign nonce raises
+:class:`~specpride_tpu.robustness.errors.LeaseExpiredError` (permanent —
+never retried), so a rank that stalled past its TTL abandons the range
+instead of racing the rank that took it over.  The window between the
+check and the append is the residual risk; the commit-marker link and
+the merge-time sha256 verification catch anything that slips through,
+loudly.
+
+This module is deliberately jax-free: the coordinator runs identically
+on a login node, a CI box, or a TPU host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import json
+import os
+import threading
+import time
+import uuid
+
+from specpride_tpu.observability.stats import logger
+from specpride_tpu.robustness.errors import LeaseExpiredError
+
+PLAN_SCHEMA = 1
+DONE_SCHEMA = 1
+
+# default lease time-to-live and the grace margin an observer adds on
+# top before declaring a lease dead (absorbs clock skew between hosts
+# sharing the directory over NFS)
+DEFAULT_TTL_S = 10.0
+DEFAULT_GRACE_FRAC = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkRange:
+    """One unit of claimable work: a contiguous block of cluster
+    indices.  Ranges are fixed by the plan — deterministic chunk-range
+    addressing — so every rank, and every post-mortem, resolves range
+    ``k`` to the same clusters and the same ``.part<k>`` output."""
+
+    range_id: int
+    start: int
+    stop: int
+
+    @property
+    def n_clusters(self) -> int:
+        return self.stop - self.start
+
+
+@dataclasses.dataclass
+class Claim:
+    """A held lease on one range."""
+
+    range: ChunkRange
+    nonce: str
+    takeover: bool = False
+    from_rank: int | None = None
+    lost: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+
+
+def plan_ranges(n_clusters: int, range_size: int) -> list[ChunkRange]:
+    """The deterministic plan: ``n_clusters`` in blocks of
+    ``range_size``.  An empty input still plans ONE empty range so the
+    claimer writes an empty part and ``merge-parts`` finds something."""
+    size = max(int(range_size), 1)
+    if n_clusters <= 0:
+        return [ChunkRange(0, 0, 0)]
+    return [
+        ChunkRange(k, start, min(start + size, n_clusters))
+        for k, start in enumerate(range(0, n_clusters, size))
+    ]
+
+
+def _write_atomic(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> dict | None:
+    """Best-effort read of a small coordinator file.  Torn/concurrent
+    states read as None — callers treat that as "contested, look again"
+    rather than crashing a surviving rank on a dying rank's debris."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+class Coordinator:
+    """One rank's handle on the shared elastic work queue.
+
+    Construction registers the plan (or verifies it against the one a
+    peer already wrote) and starts the heartbeat thread; callers MUST
+    pair with :meth:`stop` (the CLI does so in a ``finally``)."""
+
+    def __init__(
+        self,
+        root: str,
+        rank: int,
+        n_clusters: int,
+        range_size: int,
+        ttl: float = DEFAULT_TTL_S,
+        heartbeat_interval: float = 0.0,
+        journal=None,
+    ):
+        self.root = os.path.abspath(root)
+        self.rank = int(rank)
+        self.ttl = max(float(ttl), 0.1)
+        self.grace = self.ttl * DEFAULT_GRACE_FRAC
+        self.heartbeat_interval = (
+            float(heartbeat_interval)
+            if heartbeat_interval and heartbeat_interval > 0
+            else max(self.ttl / 4.0, 0.05)
+        )
+        self.journal = journal
+        self.ranges = plan_ranges(n_clusters, range_size)
+        self.n_clusters = int(n_clusters)
+        self.range_size = max(int(range_size), 1)
+        # observed-recovery counters the liveness exporter mirrors
+        self.lease_expires_observed = 0
+        self.reassignments = 0
+        self.ranges_run = 0
+        self._lock = threading.Lock()
+        self._held: dict[int, Claim] = {}
+        self._stop = threading.Event()
+        for sub in ("leases", "done", "hb", "ranks", "ck"):
+            os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+        self._register_plan()
+        # one immediate beat before the loop: every rank's journal holds
+        # at least one heartbeat (the stats rank view keys off it) and
+        # the exporter's age gauge starts near zero, even on runs that
+        # finish inside the first interval
+        self._beat()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"specpride-heartbeat-r{self.rank}", daemon=True,
+        )
+        self._hb_thread.start()
+
+    # -- plan -----------------------------------------------------------
+
+    def _plan_payload(self) -> dict:
+        return {
+            "schema": PLAN_SCHEMA,
+            "n_clusters": self.n_clusters,
+            "range_size": self.range_size,
+            "n_ranges": len(self.ranges),
+        }
+
+    def _register_plan(self) -> None:
+        path = os.path.join(self.root, "plan.json")
+        payload = self._plan_payload()
+        tmp = f"{path}.tmp.{self.rank}.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh)
+            fh.write("\n")
+        try:
+            os.link(tmp, path)  # atomic create-if-absent
+        except FileExistsError:
+            pass
+        finally:
+            os.unlink(tmp)
+        existing = _read_json(path)
+        if existing is None:
+            raise SystemExit(
+                f"elastic plan {path} is unreadable — another rank wrote "
+                "a torn plan or the directory is not a shared filesystem"
+            )
+        for key in ("n_clusters", "range_size"):
+            if existing.get(key) != payload[key]:
+                raise SystemExit(
+                    f"elastic plan mismatch in {path}: this rank derived "
+                    f"{key}={payload[key]} but the registered plan says "
+                    f"{existing.get(key)} — are all ranks running the "
+                    "same input and --elastic-range?"
+                )
+
+    @classmethod
+    def read_plan(cls, root: str) -> dict | None:
+        """The registered plan, for ``merge-parts --elastic`` and the
+        stats/exporter consumers (None when absent/unreadable)."""
+        return _read_json(os.path.join(root, "plan.json"))
+
+    # -- rank identity --------------------------------------------------
+
+    @staticmethod
+    def assign_rank(root: str, limit: int = 4096) -> int:
+        """Auto-assign the lowest free rank id via ``O_EXCL`` marker
+        files — used when ``--process-id`` is not given.  Ranks are
+        identities, not a partition: any number may join or rejoin."""
+        ranks_dir = os.path.join(root, "ranks")
+        os.makedirs(ranks_dir, exist_ok=True)
+        for r in range(limit):
+            path = os.path.join(ranks_dir, f"rank_{r:05d}")
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                continue
+            with os.fdopen(fd, "w") as fh:
+                fh.write(f"{os.getpid()}\n")
+            return r
+        raise SystemExit(f"no free rank id under {ranks_dir}")
+
+    # -- paths ----------------------------------------------------------
+
+    def lease_path(self, k: int) -> str:
+        return os.path.join(self.root, "leases", f"range_{k:05d}.json")
+
+    def done_path(self, k: int) -> str:
+        return os.path.join(self.root, "done", f"range_{k:05d}.json")
+
+    def checkpoint_path(self, k: int) -> str:
+        """The per-range resume manifest — coordinator-owned so elastic
+        runs are ALWAYS checkpointed (reassignment needs the manifest to
+        know which chunks the dead rank committed)."""
+        return os.path.join(self.root, "ck", f"range_{k:05d}.json")
+
+    def heartbeat_path(self, rank: int | None = None) -> str:
+        r = self.rank if rank is None else rank
+        return os.path.join(self.root, "hb", f"rank_{r:05d}.json")
+
+    # -- leases ---------------------------------------------------------
+
+    def _is_done(self, k: int) -> bool:
+        return os.path.exists(self.done_path(k))
+
+    def _create_lease(self, k: int, nonce: str) -> bool:
+        # liveness rides the file MTIME, not a stored expiry: renewal is
+        # then an atomic os.utime that can never overwrite (shadow) a
+        # lease a stealer just re-created the way a read-then-replace
+        # rewrite could.  `ttl` is stored so observers judge expiry by
+        # the HOLDER's declared cadence, not their own flag.
+        payload = {
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "nonce": nonce,
+            "claimed": time.time(),
+            "ttl": self.ttl,
+        }
+        try:
+            fd = os.open(
+                self.lease_path(k), os.O_CREAT | os.O_EXCL | os.O_WRONLY
+            )
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as fh:
+            json.dump(payload, fh)
+            fh.write("\n")
+        return True
+
+    def _lease_expired(self, k: int, lease: dict) -> tuple[bool, float]:
+        """(expired?, seconds past deadline) judged from the lease
+        file's mtime — the renewal heartbeat — plus the holder's TTL and
+        the clock-skew grace."""
+        try:
+            mtime = os.stat(self.lease_path(k)).st_mtime
+        except OSError:
+            return False, 0.0  # mid-steal — look again next scan
+        ttl = lease.get("ttl")
+        if not isinstance(ttl, (int, float)) or ttl <= 0:
+            ttl = self.ttl
+        over = time.time() - (mtime + ttl + self.grace)
+        return over > 0, max(over, 0.0)
+
+    def _remaining_clusters(self, rng: ChunkRange) -> int:
+        """Clusters of ``rng`` NOT yet committed in its checkpoint
+        manifest — the chunk_reassign payload's honest remainder."""
+        manifest = _read_json(self.checkpoint_path(rng.range_id))
+        if not manifest:
+            return rng.n_clusters
+        done = manifest.get("done")
+        n_done = len(done) if isinstance(done, list) else 0
+        return max(rng.n_clusters - n_done, 0)
+
+    def _try_claim(self, rng: ChunkRange) -> Claim | None:
+        k = rng.range_id
+        nonce = uuid.uuid4().hex
+        if self._create_lease(k, nonce):
+            claim = Claim(rng, nonce)
+            manifest = _read_json(self.checkpoint_path(k))
+            if manifest:
+                # a prior holder died after its lease was cleaned up (or
+                # released without committing): partial state exists, so
+                # this fresh-looking claim is still a takeover
+                claim.takeover = True
+            self._note_claim(claim)
+            return claim
+        lease = _read_json(self.lease_path(k))
+        if lease is None:
+            return None  # torn or mid-steal — look again next scan
+        # (a dead previous incarnation of THIS rank id is handled like
+        # any other dead rank: its lease simply ages out below)
+        expired, over_s = self._lease_expired(k, lease)
+        if not expired:
+            return None  # live holder
+        # expired: steal atomically — only one racer's rename succeeds
+        tomb = (
+            f"{self.lease_path(k)}.dead.{self.rank}.{uuid.uuid4().hex[:8]}"
+        )
+        try:
+            os.rename(self.lease_path(k), tomb)
+        except FileNotFoundError:
+            return None  # lost the steal race
+        dead_rank = lease.get("rank", -1)
+        if not self._create_lease(k, nonce):
+            # another claimer slipped into the gap between our tombstone
+            # rename and our create: ITS lease_claim covers the range,
+            # so emit NOTHING here — a lease_expire with no paired
+            # chunk_reassign would fail the audit over zero lost work
+            return None
+        self.lease_expires_observed += 1
+        self.reassignments += 1
+        if self.journal is not None:
+            self.journal.emit(
+                "lease_expire", rank=dead_rank, range=k,
+                observed_by=self.rank, expired_for_s=round(over_s, 3),
+            )
+        logger.warning(
+            "rank %d: lease on range %d held by rank %s expired; "
+            "reassigning", self.rank, k, dead_rank,
+        )
+        claim = Claim(rng, nonce, takeover=True, from_rank=dead_rank)
+        if self.journal is not None:
+            self.journal.emit(
+                "chunk_reassign", range=k, from_rank=dead_rank,
+                to_rank=self.rank,
+                n_clusters_remaining=self._remaining_clusters(rng),
+            )
+        self._note_claim(claim)
+        return claim
+
+    def _note_claim(self, claim: Claim) -> None:
+        k = claim.range.range_id
+        with self._lock:
+            self._held[k] = claim
+        self.ranges_run += 1
+        if self.journal is not None:
+            self.journal.emit(
+                "lease_claim", rank=self.rank, range=k,
+                takeover=claim.takeover,
+                **(
+                    {"from_rank": claim.from_rank}
+                    if claim.from_rank is not None else {}
+                ),
+            )
+
+    def _holds(self, k: int) -> bool:
+        with self._lock:
+            return k in self._held
+
+    def claim_next(self) -> Claim | None:
+        """Claim the next available range, scanning from this rank's own
+        offset (ranks start at different ranges, so a healthy fleet
+        claims disjoint work without ever contending).  None = nothing
+        claimable right now (all done, or every open range is leased by
+        a live rank — poll again)."""
+        n = len(self.ranges)
+        for i in range(n):
+            rng = self.ranges[(self.rank + i) % n]
+            if self._is_done(rng.range_id):
+                continue
+            claim = self._try_claim(rng)
+            if claim is not None:
+                return claim
+        return None
+
+    def all_committed(self) -> bool:
+        return all(self._is_done(r.range_id) for r in self.ranges)
+
+    def done_count(self) -> int:
+        return sum(self._is_done(r.range_id) for r in self.ranges)
+
+    def check_lease(self, k: int) -> None:
+        """The per-commit fence: raise
+        :class:`LeaseExpiredError` when this rank no longer holds range
+        ``k`` — the lease file is gone (stolen) or carries a foreign
+        nonce (stolen and re-claimed)."""
+        with self._lock:
+            claim = self._held.get(k)
+        if claim is None or claim.lost.is_set():
+            raise LeaseExpiredError(
+                f"rank {self.rank} lost its lease on range {k}"
+            )
+        lease = _read_json(self.lease_path(k))
+        if lease is None or lease.get("nonce") != claim.nonce:
+            claim.lost.set()
+            raise LeaseExpiredError(
+                f"rank {self.rank} lost its lease on range {k} "
+                f"(held by rank {lease.get('rank') if lease else '?'} now)"
+            )
+
+    def release(self, k: int) -> None:
+        """Drop a held lease (after commit, or on abandon)."""
+        with self._lock:
+            claim = self._held.pop(k, None)
+        if claim is None or claim.lost.is_set():
+            return
+        lease = _read_json(self.lease_path(k))
+        if lease is not None and lease.get("nonce") == claim.nonce:
+            try:
+                os.unlink(self.lease_path(k))
+            except OSError:
+                pass
+
+    # -- commit ---------------------------------------------------------
+
+    def commit(self, k: int, payload: dict) -> bool:
+        """Exactly-once range commit: ``os.link`` the marker into place.
+        Returns False when another rank already committed ``k`` (the
+        double-commit race — both produced byte-identical parts, only
+        the first marker counts)."""
+        body = {
+            "schema": DONE_SCHEMA, "range": k, "rank": self.rank,
+            "committed": time.time(), **payload,
+        }
+        tmp = os.path.join(
+            self.root, "done",
+            f".commit.{k:05d}.{self.rank}.{uuid.uuid4().hex[:8]}",
+        )
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(body, fh)
+            fh.write("\n")
+        try:
+            os.link(tmp, self.done_path(k))
+        except OSError as e:
+            os.unlink(tmp)
+            if e.errno == errno.EEXIST:
+                return False
+            raise
+        os.unlink(tmp)
+        return True
+
+    # -- heartbeats -----------------------------------------------------
+
+    def _beat(self) -> None:
+        with self._lock:
+            held = sorted(self._held)
+            claims = [self._held[k] for k in held]
+        now = time.time()
+        for claim in claims:
+            # renewal = bump the lease file's MTIME (os.utime, atomic).
+            # Never a content rewrite: a read-verify-replace could land
+            # AFTER a stealer's fresh lease and shadow it with our
+            # stale nonce.  If we lost the race between the nonce read
+            # and the utime, the touch lands on the stealer's
+            # just-created (already-fresh) lease — harmless — and our
+            # next fence/renewal sees the foreign nonce and marks lost.
+            k = claim.range.range_id
+            lease = _read_json(self.lease_path(k))
+            if lease is None or lease.get("nonce") != claim.nonce:
+                claim.lost.set()
+                continue
+            try:
+                os.utime(self.lease_path(k))
+            except OSError:
+                claim.lost.set()
+        _write_atomic(
+            self.heartbeat_path(),
+            {
+                "rank": self.rank, "pid": os.getpid(), "ts": now,
+                "holding": held, "ranges_done": self.done_count(),
+                "reassignments": self.reassignments,
+            },
+        )
+        if self.journal is not None:
+            self.journal.emit("heartbeat", rank=self.rank, holding=held)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except OSError as e:  # a full/flaky share must not kill the
+                logger.warning(  # rank — the lease just ages toward steal
+                    "rank %d heartbeat failed: %s", self.rank, e,
+                )
+
+    def rank_heartbeat_ages(self) -> dict[int, float]:
+        """rank -> seconds since its last heartbeat file write — the
+        live fleet view the metrics exporter samples per scrape."""
+        out: dict[int, float] = {}
+        hb_dir = os.path.join(self.root, "hb")
+        now = time.time()
+        try:
+            names = os.listdir(hb_dir)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if not name.startswith("rank_"):
+                continue
+            data = _read_json(os.path.join(hb_dir, name))
+            if data is None or not isinstance(data.get("ts"), (int, float)):
+                continue
+            out[int(data.get("rank", name[5:10]))] = max(
+                now - data["ts"], 0.0
+            )
+        return out
+
+    def wait_for_work(self, timeout: float | None = None) -> None:
+        """Park between claim scans; wakes early on stop()."""
+        self._stop.wait(
+            timeout if timeout is not None
+            else min(self.heartbeat_interval, 0.5)
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._hb_thread.join(timeout=10)
+        with self._lock:
+            held = list(self._held)
+        for k in held:
+            self.release(k)

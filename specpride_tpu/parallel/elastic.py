@@ -1,0 +1,213 @@
+"""Elastic multi-host helpers: journal audits, the rank liveness view,
+and manifest-verified merging.
+
+The runtime lives in :mod:`specpride_tpu.parallel.coordinator` (leases,
+heartbeats, commits) and the orchestration in ``cli._run_elastic``; this
+module holds the jax-free consumers shared by ``specpride stats``,
+``specpride merge-parts`` and the tests:
+
+* :func:`audit_elastic` — every journaled ``lease_expire`` must pair
+  with a ``chunk_reassign`` for the same range: an expiry nobody
+  reassigned is lost work, exactly what the chaos CI pass exists to
+  catch.
+* :func:`summarize_ranks` — the per-rank liveness/throughput rollup
+  (ranks seen, last-heartbeat age, chunks committed, ranges claimed,
+  reassignments in/out) ``specpride stats`` renders from the merged
+  ``.part<rank>`` journals.
+* :func:`verify_part_manifest` / :func:`merge_qc_reports` — the
+  ``merge-parts`` hardening: sha256-verify each shard against its
+  schema-2 manifest before concatenating, and rebuild the merged QC
+  report byte-identically to a single-host serial run's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+
+
+def audit_elastic(events: list[dict]) -> list[dict]:
+    """Unpaired ``lease_expire`` events: each must be followed by a
+    ``chunk_reassign`` for the same range (the stealing rank emits the
+    pair back to back, so pairing is per-range and order-aware).
+    Feed MERGED events from every rank's journal — the expiry and the
+    reassignment always live in the observer's journal, but a multi-file
+    audit must not depend on which file they came from."""
+    reassigned: dict[int, int] = {}
+    for e in events:
+        if e.get("event") == "chunk_reassign":
+            k = e.get("range")
+            if isinstance(k, int):
+                reassigned[k] = reassigned.get(k, 0) + 1
+    unmatched = []
+    for e in events:
+        if e.get("event") != "lease_expire":
+            continue
+        k = e.get("range")
+        if isinstance(k, int) and reassigned.get(k, 0) > 0:
+            reassigned[k] -= 1
+        else:
+            unmatched.append(e)
+    return unmatched
+
+
+def summarize_ranks(events_per_file: list[list[dict]]) -> dict | None:
+    """The multi-host rank view: one row per rank seen across the
+    journals, plus the expiry/reassignment pairing audit.  Returns None
+    when no elastic events exist (non-elastic journals render as
+    before)."""
+    ranks: dict[int, dict] = {}
+
+    def row(r) -> dict:
+        return ranks.setdefault(int(r), {
+            "heartbeats": 0, "last_heartbeat_ts": None,
+            "ranges_claimed": 0, "takeovers": 0, "chunks_committed": 0,
+            "leases_expired": 0, "reassigned_away": 0,
+        })
+
+    saw_elastic = False
+    max_ts = None
+    for events in events_per_file:
+        # chunk_done events carry no rank: attribute them to the rank
+        # whose elastic events share the file (one journal per rank)
+        file_rank = None
+        chunk_done = 0
+        for e in events:
+            ts = e.get("ts")
+            if isinstance(ts, (int, float)):
+                max_ts = ts if max_ts is None else max(max_ts, ts)
+            ev = e.get("event")
+            if ev == "heartbeat":
+                saw_elastic = True
+                r = row(e.get("rank", -1))
+                r["heartbeats"] += 1
+                if isinstance(ts, (int, float)):
+                    r["last_heartbeat_ts"] = (
+                        ts if r["last_heartbeat_ts"] is None
+                        else max(r["last_heartbeat_ts"], ts)
+                    )
+                file_rank = e.get("rank", file_rank)
+            elif ev == "lease_claim":
+                saw_elastic = True
+                r = row(e.get("rank", -1))
+                r["ranges_claimed"] += 1
+                if e.get("takeover"):
+                    r["takeovers"] += 1
+                file_rank = e.get("rank", file_rank)
+            elif ev == "lease_expire":
+                saw_elastic = True
+                row(e.get("rank", -1))["leases_expired"] += 1
+            elif ev == "chunk_reassign":
+                saw_elastic = True
+                row(e.get("from_rank", -1))["reassigned_away"] += 1
+            elif ev == "chunk_done":
+                chunk_done += 1
+        if file_rank is not None and chunk_done:
+            row(file_rank)["chunks_committed"] += chunk_done
+    if not saw_elastic:
+        return None
+    for r in ranks.values():
+        last = r.pop("last_heartbeat_ts")
+        r["last_heartbeat_age_s"] = (
+            round(max_ts - last, 3)
+            if last is not None and max_ts is not None else None
+        )
+    unpaired = audit_elastic(
+        [e for events in events_per_file for e in events]
+    )
+    return {
+        "ranks": {str(k): ranks[k] for k in sorted(ranks)},
+        "reassignments": sum(
+            r["reassigned_away"] for r in ranks.values()
+        ),
+        "unpaired_lease_expiries": len(unpaired),
+    }
+
+
+# -- manifest-verified merging ------------------------------------------
+
+
+def sha256_file(path: str, upto: int | None = None) -> str:
+    """sha256 of the first ``upto`` bytes (whole file when None) — the
+    same chunked prefix hash the commit protocol maintains, via the ONE
+    implementation in ``robustness.integrity`` (jax-free) so the two
+    can never diverge."""
+    from specpride_tpu.robustness.integrity import OutputIntegrity
+
+    if upto is None:
+        upto = os.path.getsize(path)
+    return OutputIntegrity().seed_file(path, upto)
+
+
+def verify_part_manifest(part: str, manifest: dict) -> str | None:
+    """Check one output shard against its schema-2 manifest.  Returns a
+    problem string (None = verified): size mismatch, sha256 mismatch, or
+    a manifest too old to carry a hash."""
+    want_bytes = manifest.get("output_bytes")
+    if not isinstance(want_bytes, int):
+        return "manifest records no output_bytes"
+    try:
+        size = os.path.getsize(part)
+    except OSError as e:
+        return f"unreadable part ({e})"
+    if size != want_bytes:
+        return (
+            f"part is {size} bytes but its manifest committed "
+            f"{want_bytes}"
+        )
+    want_sha = manifest.get("sha256")
+    if not want_sha:
+        return "manifest has no sha256 (pre-schema-2)"
+    got = sha256_file(part, want_bytes)
+    if got != want_sha:
+        return (
+            f"sha256 mismatch: manifest {want_sha[:12]}… vs part "
+            f"{got[:12]}…"
+        )
+    return None
+
+
+def merge_qc_reports(shards: list[str], out_path: str) -> int:
+    """Merge per-shard QC reports (rank order) into one report that is
+    byte-identical to the report a single-host serial run writes:
+    same key order, same ``statistics`` aggregation over the same row
+    sequence, same ``indent=1`` serialization.  Returns the merged
+    cluster-row count."""
+    rows: list[dict] = []
+    n_input = 0
+    method_failed: list[str] = []
+    qc_failed: list[str] = []
+    for path in shards:
+        with open(path, encoding="utf-8") as fh:
+            shard = json.load(fh)
+        summary = shard.get("summary", {})
+        rows.extend(shard.get("clusters", []))
+        n_input += int(summary.get("n_input_clusters", 0))
+        method_failed.extend(summary.get("method_failed_cluster_ids", []))
+        qc_failed.extend(summary.get("qc_failed_cluster_ids", []))
+    cosines = [row["avg_cosine"] for row in rows]
+    method_failed = sorted(set(method_failed))
+    qc_failed = sorted(set(qc_failed))
+    report = {
+        "summary": {
+            "n_clusters": len(rows),
+            "mean_cosine": statistics.fmean(cosines) if cosines else None,
+            "median_cosine": (
+                statistics.median(cosines) if cosines else None
+            ),
+            "n_input_clusters": n_input,
+            "n_method_failed": len(method_failed),
+            "n_qc_failed": len(qc_failed),
+            **(
+                {"method_failed_cluster_ids": method_failed}
+                if method_failed else {}
+            ),
+            **({"qc_failed_cluster_ids": qc_failed} if qc_failed else {}),
+        },
+        "clusters": rows,
+    }
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    return len(rows)

@@ -48,6 +48,15 @@ class LaneHangError(TimeoutError):
     so the enclosing retry re-runs it."""
 
 
+class LeaseExpiredError(RuntimeError):
+    """This rank lost its lease on an elastic chunk range — another rank
+    observed the lease expired (a stall past the TTL) and took the range
+    over.  **Permanent** by design: retrying the commit would race the
+    new holder on the same part file, so the loser must abandon the
+    range and claim fresh work instead.  (``is_transient`` stays False
+    because the message carries none of the transient/OOM markers.)"""
+
+
 def is_oom(exc: BaseException) -> bool:
     """Device allocation failure — the degradation (chunk-split) class."""
     return isinstance(exc, RuntimeError) and any(

@@ -55,7 +55,7 @@ FAULT_SITES = (
     "checkpoint_write",
 )
 
-FAULT_KINDS = ("io", "oom", "malformed", "hang")
+FAULT_KINDS = ("io", "oom", "malformed", "hang", "rank_kill")
 
 # a hang with no watchdog armed must still end: hard bound on the block
 MAX_HANG_S = 5.0
@@ -238,6 +238,16 @@ class FaultPlan:
 
     def _raise(self, site: str, spec: FaultSpec, visit: int) -> None:
         msg = f"injected {spec.kind} fault at {site} (visit {visit})"
+        if spec.kind == "rank_kill":
+            # chaos-CI rank death: SIGKILL this process at a site
+            # boundary — no handlers, no atexit, no flushes beyond the
+            # journal line emitted above (line-buffered, already on
+            # disk).  The recovery evidence lives in a SURVIVING rank's
+            # journal: its lease_expire + chunk_reassign pair.
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(MAX_HANG_S)  # unreachable: SIGKILL cannot be caught
         if spec.kind == "io":
             raise InjectedOSError(msg)
         if spec.kind == "oom":
@@ -312,16 +322,19 @@ def audit_fault_recovery(events: list[dict]) -> list[dict]:
 
     Recovery evidence, in pairing order: a ``retry`` at the fault
     site's wrapper (see :func:`recovery_sites_for`), a ``degrade``, a
-    ``quarantine``, a ``resume_repair``, or a ``skipped_clusters``
-    record (the ``--on-error skip`` outcome).  Each recovery event
-    backs at most one fault.  Returns the faults left unmatched — the
-    chaos CI pass asserts this list is empty."""
+    ``quarantine``, a ``resume_repair``, a ``chunk_reassign`` (a
+    surviving elastic rank reclaimed a killed rank's range — feed the
+    MERGED per-rank journals, the reassignment never lives in the dead
+    rank's own file), or a ``skipped_clusters`` record (the
+    ``--on-error skip`` outcome).  Each recovery event backs at most
+    one fault.  Returns the faults left unmatched — the chaos CI pass
+    asserts this list is empty."""
     faults = [e for e in events if e.get("event") == "fault"]
     recoveries = [
         e for e in events
         if e.get("event") in (
             "retry", "degrade", "quarantine", "resume_repair",
-            "skipped_clusters",
+            "skipped_clusters", "chunk_reassign",
         )
     ]
     used: set[int] = set()
@@ -332,7 +345,18 @@ def audit_fault_recovery(events: list[dict]) -> list[dict]:
         for i, r in enumerate(recoveries):
             if i in used:
                 continue
-            if r.get("mono", 0) < f.get("mono", 0):
+            if r["event"] == "chunk_reassign":
+                # a reassignment only evidences recovery from a rank
+                # DEATH: pairing it with other fault kinds would let a
+                # natural slow-rank reassignment mask a genuinely
+                # unrecovered io/oom fault.  No mono check either way —
+                # it lives in a DIFFERENT rank's journal (per-process
+                # mono is incomparable) and is inherently later than
+                # the death it recovers.
+                if f.get("kind") != "rank_kill":
+                    continue
+            elif r.get("mono", 0) < f.get("mono", 0):
+                # in-process recoveries must follow the fault
                 continue
             if r["event"] == "retry" and r.get("site") not in sites:
                 continue

@@ -83,6 +83,12 @@ DAEMON_ONLY_FLAGS = (
     "--num-processes",
     "--process-id",
     "--metrics-out",
+    # elastic multi-host coordination and its liveness exporter are
+    # fleet-process concerns: a served job is one tenant of ONE warm
+    # daemon, not a rank (an in-job coordinator would lease ranges and
+    # bind ports inside the daemon process)
+    "--elastic",
+    "--metrics-port",
 )
 
 # `specpride submit` exit code for a retriable non-success (BSD
@@ -142,6 +148,7 @@ def forbidden_flags(argv: list[str]) -> list[str]:
 _DAEMON_OWNED_DESTS = (
     "compile_cache", "routing_table", "layout", "force_device",
     "mesh", "coordinator", "num_processes", "process_id", "metrics_out",
+    "elastic", "metrics_port",
 )
 
 _daemon_owned_defaults: dict | None = None

@@ -1,0 +1,465 @@
+"""Elastic multi-host scale-out: coordinator leases/heartbeats,
+dead-rank reassignment with byte-identical merge, merge-parts
+hardening, the stats rank view, and the liveness exporter.
+
+The reassignment matrix runs a real victim rank in a subprocess armed
+with the ``rank_kill`` fault kind (SIGKILL at a write-site visit — the
+chaos-CI idiom), then an in-process survivor that must observe the
+lease expiry, reclaim only the uncommitted chunks, and reproduce the
+single-host serial bytes for all three methods under both a clean crash
+and a torn output tail."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.io.mgf import write_mgf
+from specpride_tpu.observability.journal import NullJournal, read_events
+from specpride_tpu.parallel.coordinator import Coordinator, plan_ranges
+from specpride_tpu.parallel.elastic import (
+    audit_elastic,
+    merge_qc_reports,
+    sha256_file,
+    summarize_ranks,
+    verify_part_manifest,
+)
+from specpride_tpu.robustness.errors import LeaseExpiredError
+from specpride_tpu.robustness.faults import audit_fault_recovery
+
+from conftest import make_cluster
+
+
+class RecordingJournal(NullJournal):
+    """Captures emitted events (schema-shaped) for assertions."""
+
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        rec = {"event": event, "ts": time.time(),
+               "mono": time.perf_counter(), **fields}
+        self.events.append(rec)
+        return rec
+
+
+# -- coordinator units ---------------------------------------------------
+
+
+def test_plan_ranges_blocks_and_empty_input():
+    ranges = plan_ranges(7, 3)
+    assert [(r.range_id, r.start, r.stop) for r in ranges] == [
+        (0, 0, 3), (1, 3, 6), (2, 6, 7),
+    ]
+    # empty input still plans one (empty) range so a claimer writes an
+    # empty part and merge-parts finds a complete set
+    empty = plan_ranges(0, 3)
+    assert [(r.start, r.stop) for r in empty] == [(0, 0)]
+
+
+def test_plan_mismatch_refuses(tmp_path):
+    a = Coordinator(str(tmp_path), 0, 10, 5, ttl=5.0)
+    a.stop()
+    with pytest.raises(SystemExit, match="plan mismatch"):
+        Coordinator(str(tmp_path), 1, 12, 5, ttl=5.0)
+
+
+def test_lease_claim_renew_and_expiry(tmp_path):
+    ja, jb = RecordingJournal(), RecordingJournal()
+    a = Coordinator(str(tmp_path), 0, 4, 4, ttl=0.4, journal=ja)
+    claim = a.claim_next()
+    assert claim is not None and not claim.takeover
+    assert claim.range.range_id == 0
+    assert [e["event"] for e in ja.events if e["event"] == "lease_claim"]
+    # renewal keeps the lease alive well past the raw TTL
+    b = Coordinator(str(tmp_path), 1, 4, 4, ttl=0.4, journal=jb)
+    time.sleep(1.0)
+    assert b.claim_next() is None  # rank 0 heartbeats, lease stays live
+    a.check_lease(0)  # still held
+    # kill rank 0's heartbeats WITHOUT releasing (a crash): the lease
+    # ages out and rank 1 steals it, journaling the expire/reassign pair
+    a._stop.set()
+    a._hb_thread.join()
+    time.sleep(0.4 * 1.5 + 0.3)
+    stolen = b.claim_next()
+    assert stolen is not None and stolen.takeover
+    assert stolen.from_rank == 0
+    assert stolen.range.range_id == 0
+    events = [e["event"] for e in jb.events]
+    assert "lease_expire" in events and "chunk_reassign" in events
+    assert not audit_elastic(jb.events)
+    # the loser's fence must now refuse commits
+    with pytest.raises(LeaseExpiredError):
+        a.check_lease(0)
+    b.stop()
+    a.stop()
+
+
+def test_double_commit_exactly_once(tmp_path):
+    a = Coordinator(str(tmp_path), 0, 4, 4, ttl=5.0)
+    b = Coordinator(str(tmp_path), 1, 4, 4, ttl=5.0)
+    payload = {"output_bytes": 3, "sha256": "abc"}
+    outcomes = [a.commit(0, payload), b.commit(0, payload)]
+    assert sorted(outcomes) == [False, True]
+    assert a.done_count() == 1
+    a.stop()
+    b.stop()
+
+
+def test_assign_rank_is_unique(tmp_path):
+    got = [Coordinator.assign_rank(str(tmp_path)) for _ in range(3)]
+    assert got == [0, 1, 2]
+
+
+def test_audit_elastic_pairs_by_range():
+    expire = {"event": "lease_expire", "rank": 1, "range": 3}
+    reassign = {"event": "chunk_reassign", "range": 3,
+                "from_rank": 1, "to_rank": 0}
+    assert audit_elastic([expire, reassign]) == []
+    assert audit_elastic([expire]) == [expire]
+    other = {"event": "chunk_reassign", "range": 4,
+             "from_rank": 1, "to_rank": 0}
+    assert audit_elastic([expire, other]) == [expire]
+
+
+def test_verify_part_manifest(tmp_path):
+    part = tmp_path / "out.part00000"
+    part.write_bytes(b"BEGIN IONS\nEND IONS\n")
+    good = {"output_bytes": part.stat().st_size,
+            "sha256": sha256_file(str(part))}
+    assert verify_part_manifest(str(part), good) is None
+    assert "output_bytes" in verify_part_manifest(str(part), {})
+    bad_size = dict(good, output_bytes=good["output_bytes"] + 1)
+    assert "bytes" in verify_part_manifest(str(part), bad_size)
+    bad_sha = dict(good, sha256="0" * 64)
+    assert "sha256 mismatch" in verify_part_manifest(str(part), bad_sha)
+
+
+# -- liveness exporter ---------------------------------------------------
+
+
+def test_elastic_telemetry_exposition(tmp_path):
+    from specpride_tpu.observability.exporter import (
+        ElasticTelemetry,
+        validate_exposition,
+    )
+
+    coord = Coordinator(str(tmp_path), 0, 8, 4, ttl=5.0)
+    coord.commit(0, {"output_bytes": 0, "sha256": "x"})
+    coord.lease_expires_observed = 2
+    coord.reassignments = 1
+    tel = ElasticTelemetry(coord)
+    text = tel.exposition()
+    assert validate_exposition(text) == []
+    assert 'specpride_rank_heartbeat_age_seconds{rank="0"}' in text
+    assert "specpride_elastic_ranges 2" in text
+    assert "specpride_elastic_ranges_committed 1" in text
+    assert "specpride_elastic_lease_expires_total 2" in text
+    assert "specpride_elastic_reassignments_total 1" in text
+    # counters mirror by delta: a second scrape must not double-count
+    text2 = tel.exposition()
+    assert "specpride_elastic_lease_expires_total 2" in text2
+    coord.stop()
+
+
+# -- CLI end-to-end ------------------------------------------------------
+
+
+def _write_input(tmp_path, rng, n=6):
+    clusters = [
+        make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=20)
+        for i in range(n)
+    ]
+    src = tmp_path / "clustered.mgf"
+    write_mgf([s for c in clusters for s in c.members], src)
+    return src
+
+
+def _serial_golden(tmp_path, src, method, command):
+    out = tmp_path / f"serial_{method}.mgf"
+    qc = tmp_path / f"serial_{method}_qc.json"
+    assert cli_main([
+        command, str(src), str(out), "--method", method,
+        "--qc-report", str(qc),
+    ]) == 0
+    return out.read_bytes(), qc.read_bytes()
+
+
+def _elastic_argv(src, out, coord, rank, method, command, journal):
+    return [
+        command, str(src), str(out), "--method", method,
+        "--elastic", str(coord), "--process-id", str(rank),
+        "--elastic-range", "2", "--checkpoint-every", "1",
+        "--elastic-ttl", "0.5",
+        "--qc-report", f"{out}.qc.json",
+        "--journal", str(journal),
+    ]
+
+
+def test_elastic_single_rank_byte_identical(tmp_path, rng):
+    """A healthy 1-rank elastic run merges to the serial bytes and QC
+    report, with manifest-verified merge-parts."""
+    src = _write_input(tmp_path, rng)
+    serial, serial_qc = _serial_golden(tmp_path, src, "bin-mean",
+                                       "consensus")
+    out = tmp_path / "out.mgf"
+    coord = tmp_path / "coord"
+    assert cli_main(_elastic_argv(
+        src, out, coord, 0, "bin-mean", "consensus",
+        tmp_path / "j.jsonl",
+    )) == 0
+    assert cli_main([
+        "merge-parts", str(out), "--elastic", str(coord),
+        "--qc-report", f"{out}.qc.json",
+    ]) == 0
+    assert out.read_bytes() == serial
+    assert (tmp_path / "out.mgf.qc.json").read_bytes() == serial_qc
+    # re-running over a finished coordinator is a no-op resume: every
+    # range already carries a commit marker
+    part0 = tmp_path / "out.mgf.part00000"
+    before = part0.read_bytes()
+    assert cli_main(_elastic_argv(
+        src, out, coord, 2, "bin-mean", "consensus",
+        tmp_path / "j2.jsonl",
+    )) == 0
+    assert part0.read_bytes() == before
+    events, violations = read_events(
+        str(tmp_path) + "/j2.jsonl.part00002"
+    )
+    assert not violations
+    end = [e for e in events if e["event"] == "run_end"][-1]
+    assert end["elastic"]["ranges_run"] == 0
+    assert end["elastic"]["ranges_committed"] == 3
+
+
+def _spawn_victim(src, out, coord, journal, method, command):
+    """Run the victim rank in a subprocess armed with a rank_kill fault:
+    SIGKILL at write-site visit 3 — after range A (2 chunks) and the
+    first chunk of range B are committed, so range B is left half done
+    under a live-looking lease."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+        SPECPRIDE_FAULTS="write:rank_kill:1:3",
+    )
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "specpride_tpu"] + _elastic_argv(
+            src, out, coord, 1, method, command, journal,
+        ),
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        timeout=180,
+    )
+    assert proc.returncode in (-9, 137), proc.stderr.decode()[-2000:]
+
+
+@pytest.mark.parametrize("method,command", [
+    ("bin-mean", "consensus"),
+    ("gap-average", "consensus"),
+    ("medoid", "select"),
+])
+@pytest.mark.parametrize("damage", ["clean", "torn"])
+def test_reassignment_after_rank_kill(tmp_path, rng, method, command,
+                                      damage):
+    """A SIGKILLed rank's uncommitted chunks are reassigned to a
+    survivor and the merged output + QC report stay byte-identical to
+    the single-host serial run — for a clean crash at a chunk boundary
+    and for a torn tail past the last committed chunk."""
+    src = _write_input(tmp_path, rng)
+    serial, serial_qc = _serial_golden(tmp_path, src, method, command)
+    out = tmp_path / "out.mgf"
+    coord = tmp_path / "coord"
+    _spawn_victim(src, out, coord, tmp_path / "j.jsonl", method, command)
+    # the victim (rank 1, scan offset 1) committed range 1 whole and
+    # exactly one chunk of range 2 before dying
+    assert os.path.exists(coord / "done" / "range_00001.json")
+    assert not os.path.exists(coord / "done" / "range_00002.json")
+    assert os.path.exists(coord / "leases" / "range_00002.json")
+    partial = f"{out}.part00002"
+    manifest = json.load(open(coord / "ck" / "range_00002.json"))
+    assert len(manifest["done"]) == 1
+    assert os.path.getsize(partial) == manifest["output_bytes"]
+    if damage == "torn":
+        # a torn append past the committed prefix (un-fsynced bytes a
+        # power cut shredded): the survivor's resume must truncate it
+        with open(partial, "ab") as fh:
+            fh.write(b"BEGIN IONS\nTITLE=torn-tail-garbage\n123 4")
+    assert cli_main(_elastic_argv(
+        src, out, coord, 0, method, command, tmp_path / "j.jsonl",
+    )) == 0
+    assert cli_main([
+        "merge-parts", str(out), "--elastic", str(coord),
+        "--qc-report", f"{out}.qc.json",
+    ]) == 0
+    assert out.read_bytes() == serial
+    assert (tmp_path / "out.mgf.qc.json").read_bytes() == serial_qc
+    # journal audit: the victim's rank_kill fault pairs with the
+    # survivor's chunk_reassign, and every lease_expire is paired
+    victim_events, _ = read_events(f"{tmp_path}/j.jsonl.part00001")
+    survivor_events, _ = read_events(f"{tmp_path}/j.jsonl.part00000")
+    kills = [e for e in victim_events if e["event"] == "fault"]
+    assert kills and kills[-1]["kind"] == "rank_kill"
+    assert [e for e in survivor_events if e["event"] == "lease_expire"]
+    reassigns = [
+        e for e in survivor_events if e["event"] == "chunk_reassign"
+    ]
+    assert reassigns and reassigns[0]["from_rank"] == 1
+    merged = victim_events + survivor_events
+    assert not audit_elastic(merged)
+    assert not audit_fault_recovery(merged)
+    # the survivor RESUMED range 2 (one chunk was trusted via the
+    # manifest), never redid it from scratch
+    resumes = [
+        e for e in survivor_events
+        if e["event"] == "resume" and e.get("n_done", 0) > 0
+    ]
+    assert resumes, "survivor restarted the partial range from scratch"
+    if damage == "torn":
+        repairs = [
+            e for e in survivor_events
+            if e["event"] == "resume_repair"
+            and e.get("action") == "truncate_tail"
+        ]
+        assert repairs, "torn tail was not truncated on takeover"
+
+
+def test_stats_rank_view_and_json(tmp_path, rng):
+    """`specpride stats` renders the multi-host rank view from the
+    merged .part<rank> journals and includes it in --json."""
+    src = _write_input(tmp_path, rng, n=4)
+    out = tmp_path / "out.mgf"
+    coord = tmp_path / "coord"
+    for rank in (0, 1):
+        assert cli_main(_elastic_argv(
+            src, out, coord, rank, "bin-mean", "consensus",
+            tmp_path / "j.jsonl",
+        )) == 0
+    from specpride_tpu.observability.stats_cli import run_stats
+
+    buf = io.StringIO()
+    agg_path = tmp_path / "agg.json"
+    assert run_stats(
+        [str(tmp_path / "j.jsonl")], json_out=str(agg_path), out=buf,
+    ) == 0
+    text = buf.getvalue()
+    assert "ranks: 2 seen" in text
+    assert "rank 0:" in text and "rank 1:" in text
+    assert "elastic: rank=0" in text
+    agg = json.load(open(agg_path))
+    assert set(agg["elastic"]["ranks"]) == {"0", "1"}
+    assert agg["elastic"]["unpaired_lease_expiries"] == 0
+    view = summarize_ranks([
+        read_events(f"{tmp_path}/j.jsonl.part0000{r}")[0]
+        for r in (0, 1)
+    ])
+    total_chunks = sum(
+        r["chunks_committed"] for r in view["ranks"].values()
+    )
+    assert total_chunks == 4  # every cluster committed exactly once
+
+
+# -- merge-parts hardening ----------------------------------------------
+
+
+def _fake_parts(tmp_path, n=3):
+    out = tmp_path / "m.mgf"
+    manifests = []
+    for i in range(n):
+        part = f"{out}.part{i:05d}"
+        body = f"BEGIN IONS\nTITLE=c{i};x\nEND IONS\n\n".encode()
+        with open(part, "wb") as fh:
+            fh.write(body)
+        ck = f"{tmp_path}/ck.json.part{i:05d}"
+        with open(ck, "w") as fh:
+            json.dump({
+                "schema": 2, "done": [f"c{i}"],
+                "output_bytes": len(body),
+                "sha256": sha256_file(part),
+            }, fh)
+        manifests.append(ck)
+    return out
+
+
+def test_merge_refuses_missing_middle_rank(tmp_path, capsys):
+    out = _fake_parts(tmp_path)
+    os.remove(f"{out}.part00001")
+    assert cli_main(["merge-parts", str(out)]) == 1
+    assert "missing [1]" in capsys.readouterr().err
+
+
+def test_merge_refuses_missing_trailing_rank_with_count(tmp_path, capsys):
+    out = _fake_parts(tmp_path)
+    os.remove(f"{out}.part00002")
+    # without a pinned count the trailing loss is invisible by
+    # construction; --num-processes (or --elastic) pins it
+    assert cli_main([
+        "merge-parts", str(out), "--num-processes", "3",
+    ]) == 1
+    assert "missing [2]" in capsys.readouterr().err
+
+
+def test_merge_verifies_checkpoint_manifests(tmp_path, capsys):
+    out = _fake_parts(tmp_path)
+    ck = f"{tmp_path}/ck.json"
+    assert cli_main(["merge-parts", str(out), "--checkpoint", ck]) == 0
+    # corrupt one byte inside a committed shard: the sha256 check must
+    # refuse the merge and name the shard
+    with open(f"{out}.part00001", "r+b") as fh:
+        fh.seek(3)
+        fh.write(b"X")
+    assert cli_main(["merge-parts", str(out), "--checkpoint", ck]) == 1
+    err = capsys.readouterr().err
+    assert "rank/range 1" in err and "sha256 mismatch" in err
+
+
+def test_merge_elastic_refuses_corrupt_part(tmp_path, rng):
+    src = _write_input(tmp_path, rng, n=4)
+    out = tmp_path / "out.mgf"
+    coord = tmp_path / "coord"
+    assert cli_main(_elastic_argv(
+        src, out, coord, 0, "bin-mean", "consensus",
+        tmp_path / "j.jsonl",
+    )) == 0
+    with open(f"{out}.part00000", "r+b") as fh:
+        fh.seek(5)
+        fh.write(b"Z")
+    assert cli_main([
+        "merge-parts", str(out), "--elastic", str(coord),
+    ]) == 1
+
+
+def test_merge_qc_reports_matches_serial_shape(tmp_path):
+    shards = []
+    for i, cos in enumerate(([0.5, 0.75], [1.0])):
+        rows = [
+            {"cluster_id": f"c{i}{j}", "n_members": 2, "avg_cosine": v}
+            for j, v in enumerate(cos)
+        ]
+        path = tmp_path / f"qc.part0000{i}"
+        with open(path, "w") as fh:
+            json.dump({
+                "summary": {
+                    "n_clusters": len(rows),
+                    "n_input_clusters": len(rows) + 1,
+                    "n_method_failed": 0, "n_qc_failed": 0,
+                },
+                "clusters": rows,
+            }, fh)
+        shards.append(str(path))
+    merged = tmp_path / "qc.json"
+    assert merge_qc_reports(shards, str(merged)) == 3
+    got = json.load(open(merged))
+    assert got["summary"]["n_clusters"] == 3
+    assert got["summary"]["n_input_clusters"] == 5
+    assert got["summary"]["mean_cosine"] == pytest.approx(0.75)
+    assert [r["cluster_id"] for r in got["clusters"]] == [
+        "c00", "c01", "c10",
+    ]
