@@ -884,6 +884,195 @@ def bench_serving(
     }
 
 
+def bench_serving_concurrency(
+    clusters, workdir: str, n_serving_clusters: int = 192,
+    workers_list=(1, 2, 4), clients_list=(2, 8), load_total_jobs: int = 16,
+) -> dict:
+    """Concurrent execution lanes (``serve --workers N``) — the
+    BENCH_r14 acceptance numbers: closed-loop daemon jobs/sec at
+    workers x clients, every cell's served bytes compared against the
+    one-shot CLI's, and the speedup each pool size buys over the
+    single-lane daemon on THIS host.
+
+    One persistent compile cache spans all three daemon boots, so the
+    workers=1 arm's warmup pays the compiles once and every measured
+    job runs warm (each cell's terminal messages are asserted to report
+    zero fresh compiles — the per-worker warm bar).  Layouts are pinned
+    exactly like the BENCH_r11 serving section (bucketized +
+    --force-device) so the single-lane row is comparable to the r11/r12
+    single-worker baselines recorded alongside."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+    import threading
+
+    from specpride_tpu.io.mgf import write_mgf
+    from specpride_tpu.serve import client as sc
+
+    sub = clusters[: min(n_serving_clusters, len(clusters))]
+    src = os.path.join(workdir, "conc_clustered.mgf")
+    write_mgf([s for c in sub for s in c.members], src)
+    cache = os.path.join(workdir, "conc_cache")  # shared across boots
+    # the one-shot CLI golden bytes every served cell must reproduce
+    golden_path = os.path.join(workdir, "conc_cli.mgf")
+    p = subprocess.run(
+        [sys.executable, "-m", "specpride_tpu", "consensus", src,
+         golden_path, "--method", "bin-mean",
+         "--layout", "bucketized", "--force-device",
+         "--compile-cache", cache],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    assert p.returncode == 0, p.stderr.decode(errors="replace")[-2000:]
+    with open(golden_path, "rb") as fh:
+        golden = fh.read()
+
+    rows = []
+    for n_workers in workers_list:
+        sock = os.path.join(workdir, f"conc_{n_workers}.sock")
+        journal = os.path.join(workdir, f"conc_{n_workers}.jsonl")
+        t_boot0 = time.perf_counter()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "specpride_tpu", "serve",
+             "--socket", sock, "--compile-cache", cache,
+             "--layout", "bucketized", "--force-device",
+             "--journal", journal, "--max-queue", "64",
+             "--workers", str(n_workers)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            assert sc.wait_for_socket(sock, timeout=300), \
+                f"--workers {n_workers} daemon never booted"
+            boot_s = time.perf_counter() - t_boot0
+            # warm every lane before measuring: 2x workers jobs
+            # (sequential submits, concurrent lanes) through the shared
+            # cache; the measured jobs below must then be fully warm
+            for w in range(max(2, 2 * n_workers)):
+                term = sc.submit_wait(
+                    sock,
+                    ["consensus", src,
+                     os.path.join(workdir, f"warm_{n_workers}_{w}.mgf"),
+                     "--method", "bin-mean"],
+                    timeout=600, client=f"warmup-{w}",
+                )
+                assert term["status"] == "done", term
+            row = {"workers": n_workers, "boot_s": round(boot_s, 3),
+                   "load": []}
+            for n_clients in clients_list:
+                jobs_per_client = max(1, load_total_jobs // n_clients)
+                total = jobs_per_client * n_clients
+                errors: list = []
+                fresh: list = []
+
+                def _client(cid, jobs_per_client=jobs_per_client,
+                            n_clients=n_clients, n_workers=n_workers):
+                    try:
+                        for j in range(jobs_per_client):
+                            out = os.path.join(
+                                workdir,
+                                f"conc_{n_workers}_{n_clients}_{cid}_{j}"
+                                ".mgf",
+                            )
+                            term = sc.submit_wait(
+                                sock,
+                                ["consensus", src, out, "--method",
+                                 "bin-mean"],
+                                timeout=600,
+                                client=f"loadgen-{n_clients}-{cid}",
+                            )
+                            if term.get("status") != "done":
+                                errors.append(term)
+                            else:
+                                fresh.append(
+                                    term["compile_cache"].get("misses", 0)
+                                )
+                    except Exception as e:  # noqa: BLE001 - surfaced below
+                        errors.append(repr(e))
+
+                t0 = time.perf_counter()
+                threads = [
+                    threading.Thread(target=_client, args=(c,))
+                    for c in range(n_clients)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                assert not errors, errors[:3]
+                # per-worker warm bar: every measured job compiled
+                # NOTHING fresh (lanes share the warm platform/cache)
+                assert all(f == 0 for f in fresh), fresh
+                # byte parity in EVERY cell: each served output must
+                # equal the one-shot CLI bytes
+                n_checked = 0
+                for cid in range(n_clients):
+                    for j in range(jobs_per_client):
+                        path = os.path.join(
+                            workdir,
+                            f"conc_{n_workers}_{n_clients}_{cid}_{j}.mgf",
+                        )
+                        with open(path, "rb") as fh:
+                            assert fh.read() == golden, path
+                        n_checked += 1
+                jobs_per_sec = total / wall
+                row["load"].append({
+                    "clients": n_clients,
+                    "jobs": total,
+                    "wall_s": round(wall, 3),
+                    "jobs_per_sec": round(jobs_per_sec, 3),
+                    "byte_parity_jobs": n_checked,
+                })
+                eprint(
+                    f"[serving_concurrency] workers={n_workers} "
+                    f"clients={n_clients}: {total} jobs in {wall:.2f}s "
+                    f"= {jobs_per_sec:.3f} jobs/sec (all byte-identical, "
+                    f"0 fresh compiles)"
+                )
+            proc.send_signal(_signal.SIGTERM)
+            rc = proc.wait(timeout=300)
+            assert rc == 0, f"--workers {n_workers} drain exited {rc}"
+            rows.append(row)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    # speedups vs the single-lane row, per client count, and vs the
+    # recorded PR 7/8 single-worker baselines (r11 serving load on the
+    # same 192-cluster recipe; r12's telemetry-armed closed loop)
+    r11 = {2: 1.406, 8: 1.434}
+    r12_armed = 2.991
+    base = {
+        cell["clients"]: cell["jobs_per_sec"]
+        for cell in rows[0]["load"]
+    }
+    for row in rows:
+        for cell in row["load"]:
+            cell["speedup_vs_workers1"] = round(
+                cell["jobs_per_sec"] / base[cell["clients"]], 3
+            )
+            if cell["clients"] in r11:
+                cell["speedup_vs_bench_r11"] = round(
+                    cell["jobs_per_sec"] / r11[cell["clients"]], 3
+                )
+            cell["speedup_vs_bench_r12_armed"] = round(
+                cell["jobs_per_sec"] / r12_armed, 3
+            )
+    return {
+        "n_serving_clusters": len(sub),
+        "load_total_jobs": load_total_jobs,
+        "rows": rows,
+        # the PR 7/8 single-worker context: BENCH_r11's serving load
+        # (same workload size/layout recipe, jobs/sec 1.406 @ 2 clients
+        # / 1.434 @ 8) and BENCH_r12's telemetry-armed closed loop
+        # (2.991 jobs/sec on a smaller 128-cluster workload)
+        "baselines": {
+            "bench_r11_load_jobs_per_sec": {"2": 1.406, "8": 1.434},
+            "bench_r12_telemetry_armed_jobs_per_sec": 2.991,
+        },
+    }
+
+
 def bench_telemetry(
     clusters, workdir: str, n_serving_clusters: int = 128,
     repeats: int = 5, jobs_per_batch: int = 6, extra_scrapes: int = 100,
@@ -1285,7 +1474,7 @@ def main() -> None:
         help="with --report: comma list of report sections to run "
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
         "prefetch_sweep,worker_sweep,fault_overhead,warm_start,serving,"
-        "telemetry,elastic,pallas",
+        "serving_concurrency,telemetry,elastic,pallas",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -1309,8 +1498,8 @@ def main() -> None:
     # never produce a silently empty report)
     all_sections = (
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
-        "worker_sweep,fault_overhead,warm_start,serving,telemetry,"
-        "elastic,pallas"
+        "worker_sweep,fault_overhead,warm_start,serving,"
+        "serving_concurrency,telemetry,elastic,pallas"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -1453,6 +1642,9 @@ def main() -> None:
                     )
                 if "serving" in secs:
                     report["serving"] = bench_serving(clusters, workdir)
+                if "serving_concurrency" in secs:
+                    report["serving_concurrency"] = \
+                        bench_serving_concurrency(clusters, workdir)
                 if "telemetry" in secs:
                     report["telemetry"] = bench_telemetry(
                         clusters, workdir

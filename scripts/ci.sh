@@ -521,6 +521,91 @@ print("serve OK: 7 served jobs byte-identical to CLI, warm jobs 0 fresh "
 EOF
 rm -rf "$sv_tmp"
 
+echo "== serve: worker pool (--workers 2 --quota, two-tenant concurrent batch) =="
+# boot a 2-lane daemon with per-tenant quotas (unequal weights), run a
+# CONCURRENT batch from two tenants, then two more jobs right before
+# SIGTERM, and assert: every served output is byte-identical to the
+# one-shot CLI's, the interleaved daemon journal has no torn/invalid
+# lines and attributes every job to a worker lane (both lanes served),
+# and the drain commits all in-flight jobs from BOTH workers before
+# exiting 0
+wp_tmp=$(mktemp -d)
+WP_IN=tests/data/golden_clustered.mgf
+WPSOCK="$wp_tmp/serve.sock"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    serve --socket "$WPSOCK" --compile-cache "$wp_tmp/cache" \
+    --journal "$wp_tmp/serve.jsonl" --workers 2 --max-queue 32 \
+    --quota "tenantA=3:8,tenantB=1:8" &
+WP_PID=$!
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$WPSOCK" <<'EOF'
+import sys
+from specpride_tpu.serve.client import wait_for_socket
+assert wait_for_socket(sys.argv[1], timeout=180), "pool daemon never came up"
+EOF
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$WP_IN" "$wp_tmp/cli.mgf" --method bin-mean
+wp_submit() { # $1 = tenant; $2 = tag
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        submit --socket "$WPSOCK" --client "$1" -- \
+        consensus "$WP_IN" "$wp_tmp/$2.mgf" --method bin-mean \
+        > "$wp_tmp/$2.json"
+}
+# the concurrent two-tenant batch: 3 tenantA jobs vs 2 tenantB jobs
+wp_submit tenantA a1 & WP_P1=$!
+wp_submit tenantA a2 & WP_P2=$!
+wp_submit tenantA a3 & WP_P3=$!
+wp_submit tenantB b1 & WP_P4=$!
+wp_submit tenantB b2 & WP_P5=$!
+wait $WP_P1 && wait $WP_P2 && wait $WP_P3 && wait $WP_P4 && wait $WP_P5
+for J in a1 a2 a3 b1 b2; do
+    cmp "$wp_tmp/cli.mgf" "$wp_tmp/$J.mgf"
+done
+# two in-flight jobs, then SIGTERM: the drain must commit BOTH lanes'
+# work before exit 0 (jobs either finished or were retriably rejected
+# at admission — never torn output)
+wp_submit tenantA d1 & WP_D1=$!
+wp_submit tenantB d2 & WP_D2=$!
+sleep 0.7
+kill -TERM $WP_PID
+WP_RC=0; wait $WP_PID || WP_RC=$?
+test "$WP_RC" -eq 0
+WP_D1_RC=0; wait $WP_D1 || WP_D1_RC=$?
+WP_D2_RC=0; wait $WP_D2 || WP_D2_RC=$?
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - \
+    "$wp_tmp" "$WP_D1_RC" "$WP_D2_RC" <<'EOF'
+import json, os, sys
+tmp, d1_rc, d2_rc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from specpride_tpu.observability.journal import read_events
+# interleaved concurrent-lane journal: every line whole and schema-valid
+events, violations = read_events(os.path.join(tmp, "serve.jsonl"))
+assert not violations, violations
+names = [e["event"] for e in events]
+assert "serve_drain" in names and names[-1] == "run_end", names[-6:]
+serve_ev = next(e for e in events if e["event"] == "serve_start")
+assert serve_ev["workers"] == 2, serve_ev
+assert serve_ev.get("quota"), "quotas must be journaled at boot"
+done = [e for e in events if e["event"] == "job_done"]
+assert all(e["status"] == "done" for e in done), done
+# every job is attributed to a lane, and BOTH lanes served the batch
+workers = sorted({e["worker"] for e in done})
+assert workers == [0, 1], f"expected both lanes to serve, got {workers}"
+golden = open(os.path.join(tmp, "cli.mgf"), "rb").read()
+# the drain-time pair: exit 0 => the job ran to commit (byte parity);
+# exit 75 => rejected retriable at admission (daemon was draining)
+for tag, rc in (("d1", d1_rc), ("d2", d2_rc)):
+    if rc == 0:
+        got = open(os.path.join(tmp, f"{tag}.mgf"), "rb").read()
+        assert got == golden, f"{tag}: drained output diverged"
+    else:
+        assert rc == 75, f"{tag}: expected done(0) or retriable(75), got {rc}"
+n_done = len(done)
+n_rej = sum(1 for e in events if e["event"] == "job_rejected")
+assert n_done + n_rej >= 6, (n_done, n_rej)
+print(f"worker pool OK: {n_done} jobs byte-identical across 2 lanes "
+      f"({n_rej} drain/quota rejections), clean SIGTERM drain")
+EOF
+rm -rf "$wp_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

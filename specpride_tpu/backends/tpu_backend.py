@@ -275,6 +275,12 @@ class TpuBackend:
     # bench-derived override file (warmstart.routing).  None = load the
     # default table (SPECPRIDE_ROUTING env override honored).
     routing: object = None
+    # serving worker pool: the jax Device this backend's lane is pinned
+    # to (None = process default).  The pin itself is applied by the
+    # daemon via serve.placement.device_scope around job execution
+    # (jax.default_device is thread-scoped); the backend reads this only
+    # to attribute device-memory telemetry to the right device.
+    device: object = None
     # (method, path) routing decisions already journaled/logged — a
     # chunked run must not spam one event per chunk
     _routing_noted: set = dataclasses.field(
@@ -381,9 +387,12 @@ class TpuBackend:
         """Device memory high-water gauge (best effort: CPU/older PJRT
         backends expose no memory_stats)."""
         try:
-            import jax
+            if self.device is not None:
+                stats = self.device.memory_stats()
+            else:
+                import jax
 
-            stats = jax.local_devices()[0].memory_stats()
+                stats = jax.local_devices()[0].memory_stats()
         except Exception:
             return
         if not stats:

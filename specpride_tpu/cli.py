@@ -24,6 +24,7 @@ import contextlib
 import json
 import os
 import sys
+import threading
 
 from specpride_tpu.config import (
     BestSpectrumConfig,
@@ -662,6 +663,29 @@ def _pack_chunk(
     return item, _time.perf_counter() - t0
 
 
+def _capture_lane_context() -> tuple:
+    """Snapshot the RUN-scoped thread context (tracer + plan-cache
+    scope) on the thread that is about to spawn lane threads.  One-shot
+    runs install both process-globally, so the capture is a no-op pair;
+    on a serving worker lane both are thread-scoped and the lane threads
+    must adopt them explicitly or the run's spans and plan-cache traffic
+    fall out of its journal attribution."""
+    from specpride_tpu.data.packed import current_plan_scope
+
+    return tracing.current(), current_plan_scope()
+
+
+def _adopt_lane_context(ctx: tuple) -> None:
+    """First statement of every lane thread: install the creating
+    thread's run context (see ``_capture_lane_context``).  The thread is
+    per-run and dies with it, so nothing needs restoring."""
+    from specpride_tpu.data.packed import set_plan_scope
+
+    tracer, plan_scope = ctx
+    tracing.set_thread_current(tracer)
+    set_plan_scope(plan_scope)
+
+
 def _default_pack_workers() -> int:
     """Default ``--pack-workers``: min(4, cores/4), floored at 1.  A
     quarter of the host saturates the dispatch lane on every profile
@@ -708,6 +732,11 @@ def _pipelined_chunks(
 
     q: queue.Queue = queue.Queue(maxsize=max(prefetch, 1))
     stop = threading.Event()
+    # lane-thread context: the packer inherits the RUN's tracer and
+    # plan-cache scope from this (dispatch) thread — on a serving worker
+    # lane both are thread-scoped, so without the hand-off the packer's
+    # spans and plan traffic would fall out of the job's attribution
+    run_ctx = _capture_lane_context()
     config = _method_config(method, args)
     cos_config = (
         _cosine_config(args) if want_qc and method == "bin-mean" else None
@@ -734,6 +763,7 @@ def _pipelined_chunks(
                     return False
 
     def _packer() -> None:
+        _adopt_lane_context(run_ctx)
         try:
             for chunk_index, idxs in worklist:
                 if stop.is_set():
@@ -813,6 +843,7 @@ def _pooled_chunks(
     prepare = getattr(backend, "prepare_chunk", None)
     n_workers = max(1, min(n_workers, len(worklist)))
     depth = max(prefetch, n_workers)
+    run_ctx = _capture_lane_context()  # see _pipelined_chunks
     admit = threading.Semaphore(depth)
     stop = threading.Event()
     cond = threading.Condition()
@@ -828,6 +859,7 @@ def _pooled_chunks(
         )
 
     def _worker(wid: int) -> None:
+        _adopt_lane_context(run_ctx)
         claimed: int | None = None  # claimed but not yet delivered
         try:
             while True:
@@ -1084,6 +1116,7 @@ class _Committer:
         self.busy_s = 0.0
         self.error: BaseException | None = None
         self._merged = False
+        self._run_ctx = _capture_lane_context()  # see _pipelined_chunks
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._thread = threading.Thread(
             target=self._run, name="specpride-committer", daemon=True
@@ -1098,6 +1131,7 @@ class _Committer:
     def _run(self) -> None:
         import time as _time
 
+        _adopt_lane_context(self._run_ctx)
         while True:
             item = self._q.get()
             if item is None:
@@ -1738,6 +1772,52 @@ def _checkpointed_run_impl(
 _STREAM_AUTO_BYTES = 256 * 1024 * 1024
 
 
+def _load_clusters_served(args, stats: RunStats, quarantine):
+    """Serving lanes only: consult the daemon's parsed-input residency
+    (``serve.ingest_cache``) before paying the parse — repeat jobs over
+    an unchanged input are THE serving scenario, and on hosts without
+    the native parser the Python parse is the largest GIL-bound slice
+    of a warm job (it caps what concurrent lanes can overlap).  Only
+    eager, quarantine-free parses are eligible; everything else (and
+    every one-shot CLI run) takes ``_load_clusters`` untouched."""
+    mode = (getattr(args, "stream_clusters", "off") or "off").lower()
+    eager = mode == "off" or (
+        mode == "auto"
+        and os.path.exists(args.input)
+        and os.path.getsize(args.input) < _STREAM_AUTO_BYTES
+    )
+    cacheable = (
+        getattr(args, "_serve_worker", None) is not None
+        and quarantine is None
+        and eager
+        and not args.input.endswith(".gz")
+    )
+    if cacheable:
+        from specpride_tpu.serve import ingest_cache
+
+        got = ingest_cache.get(args.input)
+        if got is not None:
+            clusters, n_spectra, n_peaks = got
+            stats.count("spectra_in", n_spectra)
+            stats.count("peaks_in", n_peaks)
+            stats.count("ingest_cache_hits", 1)
+            return clusters
+    clusters = _load_clusters(
+        args.input, stats, getattr(args, "stream_clusters", "off"),
+        quarantine=quarantine,
+    )
+    if cacheable and isinstance(clusters, list):
+        from specpride_tpu.serve import ingest_cache
+
+        stats.count("ingest_cache_misses", 1)
+        ingest_cache.put(
+            args.input, clusters,
+            n_spectra=stats.counters.get("spectra_in", 0),
+            n_peaks=stats.counters.get("peaks_in", 0),
+        )
+    return clusters
+
+
 def _load_clusters(path: str, stats: RunStats, stream: str = "off",
                    quarantine: Quarantine | None = None):
     """Clusters from a clustered MGF: eager list, or a bounded-memory
@@ -1940,6 +2020,13 @@ def _run_warmup(args, backend, journal) -> None:
     warm_entries(entries, journal=journal)
 
 
+# concurrent serving lanes finish jobs (and therefore merge shape
+# manifests) concurrently; merge_manifest is read-modify-replace, so
+# without mutual exclusion one lane's entries could vanish under a
+# last-writer-wins race
+_manifest_lock = threading.Lock()
+
+
 def _save_shape_manifest(args, backend) -> None:
     """Persist the (kernel, shape-class) set this run dispatched into
     the shape manifest, so the NEXT process can warm up before its first
@@ -1971,7 +2058,8 @@ def _save_shape_manifest(args, backend) -> None:
     if not entries:
         return
     try:
-        n = merge_manifest(path, entries)
+        with _manifest_lock:
+            n = merge_manifest(path, entries)
     except (OSError, ValueError) as e:
         logger.warning("could not update shape manifest %s (%s)", path, e)
         return
@@ -1988,10 +2076,25 @@ def _install_tracer_early(args) -> None:
     in memory until ``_open_run_journal`` attaches the journal and
     replays them.  Callers must pair this with ``_restore_tracer`` in a
     ``finally`` — an early exit (bad input, SystemExit) must not leak a
-    process-global tracer."""
+    process-global tracer.
+
+    Served jobs (``args._serve_worker`` set by the daemon's worker pool)
+    install THREAD-locally instead: concurrent lanes each trace their
+    own job, and a job's spans can never land in a neighbour's journal
+    (the lane threads a run spawns adopt the installing thread's
+    tracer)."""
     chrome = getattr(args, "chrome_trace", None)
     if getattr(args, "journal", None) or chrome:
-        args._prev_tracer = tracing.set_current(Tracer(keep=True))
+        args._prev_tracer = _set_run_tracer(args, Tracer(keep=True))
+
+
+def _set_run_tracer(args, tracer):
+    """Install a run's tracer in the right scope: thread-local on a
+    serving worker lane, process-global for one-shot runs."""
+    if getattr(args, "_serve_worker", None) is not None:
+        args._tracer_thread = True
+        return tracing.set_thread_current(tracer)
+    return tracing.set_current(tracer)
 
 
 def _restore_tracer(args) -> None:
@@ -2000,7 +2103,10 @@ def _restore_tracer(args) -> None:
     happy path; the command's ``finally`` catches every early exit."""
     prev = args.__dict__.pop("_prev_tracer", _TRACER_UNSET)
     if prev is not _TRACER_UNSET:
-        tracing.set_current(prev)
+        if getattr(args, "_tracer_thread", False):
+            tracing.set_thread_current(prev)
+        else:
+            tracing.set_current(prev)
 
 
 def _open_run_journal(args, backend, command: str, n_clusters: int):
@@ -2033,15 +2139,37 @@ def _open_run_journal(args, backend, command: str, n_clusters: int):
             "compile_cache", enabled=state.enabled, dir=state.dir,
             reason=state.reason, source=state.source,
         )
-        args._cc_snapshot = ws_cache.counters_snapshot()
+        served = getattr(args, "_serve_worker", None) is not None
+        if served:
+            # a serving worker lane: the process-wide counters would
+            # cross-attribute between jobs compiling on CONCURRENT
+            # lanes.  Every compile a job causes fires on its worker
+            # thread (dispatch, QC — the pack lanes never compile), so
+            # the thread-scoped counters are exactly this job's.
+            args._cc_thread_scope = True
+            args._cc_snapshot = ws_cache.thread_counters_snapshot()
+        else:
+            args._cc_snapshot = ws_cache.counters_snapshot()
         # per-run deltas for the OTHER process-wide singletons a
         # long-lived multi-job process (the serving daemon) accumulates
         # across jobs: the bucket-plan cache counters and the backend's
         # seen-shape set.  Snapshot here, diff in _finish_run — never a
         # reset, which would zero a concurrent consumer's accounting.
-        from specpride_tpu.data.packed import plan_cache_info
+        from specpride_tpu.data.packed import (
+            PlanCacheScope,
+            plan_cache_info,
+            set_plan_scope,
+        )
 
-        args._plan_snapshot = plan_cache_info()
+        if served:
+            # per-job plan-cache scope: packs run on this thread AND the
+            # job's pack-worker threads, which adopt the scope at thread
+            # start — so the job's run_end counts its own pack traffic,
+            # not a concurrent neighbour's
+            args._plan_scope = PlanCacheScope()
+            set_plan_scope(args._plan_scope)
+        else:
+            args._plan_snapshot = plan_cache_info()
         args._shapes_snapshot = set(backend._seen_shapes)
         # the backend's metrics registry is ALSO a process-wide singleton
         # in a serving daemon (kept resident so the live /metrics
@@ -2063,8 +2191,8 @@ def _open_run_journal(args, backend, command: str, n_clusters: int):
             # each keeps its original `mono`, so the timeline is exact)
             tracing.current().attach_journal(journal, keep=bool(chrome))
         else:
-            args._prev_tracer = tracing.set_current(
-                Tracer(journal=journal, keep=bool(chrome))
+            args._prev_tracer = _set_run_tracer(
+                args, Tracer(journal=journal, keep=bool(chrome))
             )
     return journal
 
@@ -2081,11 +2209,21 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
     if cc_snapshot is not None:
         from specpride_tpu.warmstart import cache as ws_cache
 
-        compile_cache = ws_cache.counters_delta(cc_snapshot)
+        compile_cache = (
+            ws_cache.thread_counters_delta(cc_snapshot)
+            if args.__dict__.pop("_cc_thread_scope", False)
+            else ws_cache.counters_delta(cc_snapshot)
+        )
     else:
         compile_cache = None
+    plan_scope = args.__dict__.pop("_plan_scope", None)
     plan_snapshot = args.__dict__.pop("_plan_snapshot", None)
-    if plan_snapshot is not None:
+    if plan_scope is not None:
+        from specpride_tpu.data.packed import set_plan_scope
+
+        plan_cache = plan_scope.delta()
+        set_plan_scope(None)  # the lane thread outlives the job
+    elif plan_snapshot is not None:
         from specpride_tpu.data.packed import plan_cache_delta
 
         plan_cache = plan_cache_delta(plan_snapshot)
@@ -2134,6 +2272,11 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         **({"plan_cache": plan_cache} if plan_cache is not None else {}),
         **({"shape_classes": shape_classes} if shape_classes is not None
            else {}),
+        # which serving worker lane ran this job (absent on one-shot
+        # runs): with concurrent lanes sharing one daemon, a job journal
+        # must stay attributable to the lane — and backend — that ran it
+        **({"worker": getattr(args, "_serve_worker")}
+           if getattr(args, "_serve_worker", None) is not None else {}),
     )
     tracer = tracing.current()
     _restore_tracer(args)  # only uninstalls what this run installed
@@ -2393,10 +2536,7 @@ def _run_pipeline_command(args, command: str, backend=None) -> dict:
         if _is_mzml(args.input):
             clusters = _clusters_from_mzml(args.input, args, stats)
         else:
-            clusters = _load_clusters(
-                args.input, stats, getattr(args, "stream_clusters", "off"),
-                quarantine=quarantine,
-            )
+            clusters = _load_clusters_served(args, stats, quarantine)
         if command == "consensus" and args.single:
             # whole file = one cluster; the reference titles the result
             # with the output filename (ref
@@ -2453,6 +2593,14 @@ def _run_pipeline_command(args, command: str, backend=None) -> dict:
         if quarantine is not None:
             quarantine.close()
         _restore_tracer(args)  # no-op after a clean _finish_run
+        if getattr(args, "_serve_worker", None) is not None:
+            # a served job that aborted before _finish_run must not
+            # leave its plan-cache scope on the worker thread, where the
+            # NEXT job's pack traffic would land in it (idempotent
+            # after a clean _finish_run)
+            from specpride_tpu.data.packed import set_plan_scope
+
+            set_plan_scope(None)
         if journal is not None:
             # a failed run must not leak the journal fd: the one-shot
             # CLI's process exit used to hide this, a serving daemon
@@ -2544,14 +2692,20 @@ def cmd_serve(args) -> int:
     (graceful drain).  See docs/serving.md."""
     from specpride_tpu.observability.exporter import parse_slo_spec
     from specpride_tpu.serve.daemon import ServeDaemon
+    from specpride_tpu.serve.scheduler import parse_quota_spec
 
     try:
         slo = parse_slo_spec(args.slo)
+        quotas = parse_quota_spec(args.quota)
     except ValueError as e:
         raise SystemExit(str(e))
+    if args.workers < 0:
+        raise SystemExit(f"--workers must be >= 0 (got {args.workers})")
     return ServeDaemon(
         args.socket,
         max_queue=args.max_queue,
+        workers=args.workers,
+        quotas=quotas,
         compile_cache=args.compile_cache,
         routing_table=args.routing_table,
         layout=args.layout,
@@ -2619,7 +2773,8 @@ def cmd_submit(args) -> int:
     last = None
     try:
         for msg in serve_client.submit(args.socket, job,
-                                       timeout=args.timeout):
+                                       timeout=args.timeout,
+                                       client=args.client):
             print(json.dumps(msg), flush=True)
             last = msg
     except (OSError, serve_client.ServeError) as e:
@@ -3091,6 +3246,25 @@ def build_parser() -> argparse.ArgumentParser:
         "(default 16)",
     )
     psv.add_argument(
+        "--workers", type=int, default=0, metavar="N",
+        help="concurrent execution lanes, each with its own resident "
+        "backend (pinned to a distinct local device on accelerator "
+        "hosts; shared platform on CPU-only hosts).  Jobs writing "
+        "distinct outputs run concurrently; same-output jobs are "
+        "serialized by the conflict guard.  Default 0 = min(#local jax "
+        "devices, 4); 1 = the single-lane daemon",
+    )
+    psv.add_argument(
+        "--quota", metavar="CLIENT=WEIGHT[:MAX_INFLIGHT],...",
+        help="per-tenant scheduling quotas, e.g. 'teamA=3:2,teamB=1,"
+        "*=1:1' ('*' = default for unnamed clients): WEIGHT biases the "
+        "weighted-fair scheduler (a weight-3 client gets 3 jobs per "
+        "weight-1 job under contention), MAX_INFLIGHT caps the "
+        "client's queued+executing jobs — beyond it submissions are "
+        "rejected retriable with the quota named (exit 75 via "
+        "`specpride submit`).  Default: every client weight 1, no cap",
+    )
+    psv.add_argument(
         "--compile-cache", metavar="DIR|off", default=None,
         help="persistent XLA compilation cache (same resolution as "
         "consensus/select; resolved ONCE at boot — jobs may not "
@@ -3212,6 +3386,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--timeout", type=float, default=30.0, metavar="S",
         help="connect + admission timeout in seconds; once accepted the "
         "job waits unbounded (default 30)",
+    )
+    psb.add_argument(
+        "--client", metavar="NAME", default=None,
+        help="scheduling identity for the daemon's weighted-fair queue "
+        "and --quota matching (default: a per-process id — one "
+        "submitting process = one tenant)",
     )
     psb.add_argument(
         "job", nargs=argparse.REMAINDER,

@@ -220,6 +220,50 @@ def clear_plan_cache() -> None:
         _PLAN_CACHE_COUNTS.update(hits=0, misses=0)
 
 
+# per-JOB plan-cache attribution for multi-lane serving: the process-
+# wide counters above stay the scrape/export truth, but a snapshot-diff
+# of them cross-attributes once jobs pack on CONCURRENT worker lanes.
+# A job installs a PlanCacheScope on every thread that packs for it
+# (the dispatch lane plus its pack workers — cli wires the adoption at
+# lane-thread start), and _plan_buckets bumps the calling thread's
+# scope alongside the globals, under the same lock.
+_SCOPE_TLS = threading.local()
+
+
+class PlanCacheScope:
+    """Per-job hit/miss counters; all mutation happens under
+    ``_PLAN_CACHE_LOCK`` so a job's several pack threads share one
+    scope safely."""
+
+    __slots__ = ("hits", "misses")
+
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+
+    def delta(self) -> dict:
+        """The run_end ``plan_cache`` payload (same shape as
+        :func:`plan_cache_delta`)."""
+        with _PLAN_CACHE_LOCK:
+            return {
+                "hits": self.hits, "misses": self.misses,
+                "size": len(_PLAN_CACHE),
+            }
+
+
+def set_plan_scope(scope: "PlanCacheScope | None") -> "PlanCacheScope | None":
+    """Install ``scope`` as the CURRENT thread's plan-cache attribution
+    target (None detaches); returns the previous scope so lane threads
+    can restore on exit."""
+    prev = getattr(_SCOPE_TLS, "scope", None)
+    _SCOPE_TLS.scope = scope
+    return prev
+
+
+def current_plan_scope() -> "PlanCacheScope | None":
+    return getattr(_SCOPE_TLS, "scope", None)
+
+
 def _plan_buckets(
     idx: ClusterIndex,
     eligible: np.ndarray,  # (C,) bool
@@ -241,13 +285,18 @@ def _plan_buckets(
     h.update(mkeys.tobytes())
     h.update(int(config.clusters_per_batch).to_bytes(8, "little"))
     key = h.digest()
+    scope = current_plan_scope()
     with _PLAN_CACHE_LOCK:
         cached = _PLAN_CACHE.get(key)
         if cached is not None:
             _PLAN_CACHE_COUNTS["hits"] += 1
+            if scope is not None:
+                scope.hits += 1
             _PLAN_CACHE.move_to_end(key)
             return cached
         _PLAN_CACHE_COUNTS["misses"] += 1
+        if scope is not None:
+            scope.misses += 1
     plans: list[_BucketPlan] = []
     for kkey in np.unique(kkeys):
         for mkey in np.unique(mkeys[kkeys == kkey]):

@@ -111,9 +111,16 @@ class ServeTelemetry:
         self,
         slo: dict[str, float] | None = None,
         extra_registries: tuple = (),
+        worker_registries: "dict[str, MetricsRegistry] | None" = None,
     ):
         self.slo = dict(slo or {})
         self.extra_registries = tuple(extra_registries)
+        # worker-pool daemons: each lane's resident backend registry
+        # carries the SAME metric names, so they render through
+        # registry.render_labeled — one TYPE header per metric, a
+        # worker="<id>" label per series (extra_registries would emit
+        # colliding duplicate series/TYPE lines)
+        self.worker_registries = dict(worker_registries or {})
         # the daemon installs a fn(telemetry) that refreshes live gauges
         # (queue depth, in-flight, uptime) right before each render
         self.sampler = None
@@ -177,6 +184,25 @@ class ServeTelemetry:
         self.uptime = r.gauge(
             "specpride_serve_uptime_seconds", "seconds since daemon boot"
         )
+        # worker pool (PR 10): lane count, per-lane occupancy sampled at
+        # scrape time (clear-and-set over the fixed worker set — idle
+        # lanes read 0), and per-lane busy seconds folded per job — the
+        # lane-utilization trio an operator sizes --workers from
+        self.workers = r.gauge(
+            "specpride_serve_workers",
+            "execution lanes in the worker pool",
+        )
+        self.inflight_worker = r.gauge(
+            "specpride_serve_inflight_worker",
+            "jobs executing on each worker lane right now (0 or 1)",
+            labels=("worker",),
+        )
+        self.worker_busy = r.counter(
+            "specpride_serve_worker_busy_seconds_total",
+            "execution wall seconds each worker lane spent on served "
+            "jobs",
+            labels=("worker",),
+        )
         self.slo_jobs = r.counter(
             "specpride_serve_slo_jobs_total",
             "served jobs evaluated against a latency objective",
@@ -204,6 +230,7 @@ class ServeTelemetry:
     def job_done(
         self, *, command: str, method: str | None, status: str,
         wall_s: float, queue_wait_s: float, summary: dict | None = None,
+        worker: int | None = None,
     ) -> dict:
         """Fold one finished job in; returns the SLO fields (empty when
         no objective covers the method) for the daemon to journal on its
@@ -215,6 +242,9 @@ class ServeTelemetry:
             self.jobs_failed.inc(1, command=command, method=m)
         self.job_wall.observe(wall_s, method=m)
         self.job_queue_wait.observe(queue_wait_s, method=m)
+        if worker is not None:
+            self.worker_busy.inc(max(float(wall_s), 0.0),
+                                 worker=str(worker))
         self._fold_lanes(summary or {})
         objective = slo_objective(self.slo, method)
         if objective is None:
@@ -264,10 +294,12 @@ class ServeTelemetry:
         mirror incs by delta since the last scrape — never a set, which
         Counter (correctly) refuses."""
         from specpride_tpu.data.packed import plan_cache_info
+        from specpride_tpu.serve import ingest_cache
         from specpride_tpu.warmstart import cache as ws_cache
 
         cc = ws_cache.counters_snapshot()
         pc = plan_cache_info()
+        ic = ingest_cache.info()
         totals = {
             "specpride_compile_cache_hits_total": (
                 cc["hits"], "persistent compile-cache hits"),
@@ -284,6 +316,12 @@ class ServeTelemetry:
                 pc["hits"], "bucket-plan cache hits"),
             "specpride_plan_cache_misses_total": (
                 pc["misses"], "bucket-plan cache misses"),
+            "specpride_serve_ingest_cache_hits_total": (
+                ic["hits"], "served jobs whose parsed input was "
+                "resident (parse skipped)"),
+            "specpride_serve_ingest_cache_misses_total": (
+                ic["misses"], "served eager parses that populated the "
+                "ingest cache"),
         }
         with self._lock:
             for name, (total, help_) in totals.items():
@@ -306,6 +344,14 @@ class ServeTelemetry:
             parts.extend(
                 r.to_prometheus_text() for r in self.extra_registries
             )
+            if self.worker_registries:
+                from specpride_tpu.observability.registry import (
+                    render_labeled,
+                )
+
+                parts.append(
+                    render_labeled(self.worker_registries, label="worker")
+                )
             return "".join(parts)
 
     def write_textfile(self, path: str) -> None:

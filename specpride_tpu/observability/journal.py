@@ -92,6 +92,10 @@ EVENT_FIELDS: dict[str, frozenset] = {
     # telemetry.  The daemon's own journal is one run (run_start
     # command="serve" ... run_end at drain) with these in between;
     # each JOB additionally writes its own --journal like any CLI run.
+    # Worker-pool daemons add a `worker` lane id to job_start/job_done
+    # (and to each job's own run_end) — additive fields, so single-lane
+    # and pre-pool journals keep validating; `specpride stats` groups
+    # the serving view by worker when the field is present.
     "serve_start": frozenset({"socket", "max_queue"}),
     "job_queued": frozenset({"job_id", "client"}),
     "job_start": frozenset({"job_id"}),

@@ -143,6 +143,94 @@ class Histogram(_Metric):
             st.n += 1
 
 
+def _sample_lines(m: _Metric, extra: tuple = ()) -> list[str]:
+    """Render one metric's sample lines (no HELP/TYPE) from a consistent
+    under-lock snapshot; histogram states copy so the cum-bucket math
+    reads a frozen view even while observes continue.  ``extra`` is a
+    tuple of ``(label, value)`` pairs prepended to every sample — the
+    per-worker registry merge labels each lane's series with it."""
+    with m._lock:
+        samples = {
+            key: (
+                (tuple(st.counts), st.total, st.n)
+                if isinstance(m, Histogram)
+                else st
+            )
+            for key, st in m.samples.items()
+        }
+    if not samples:
+        return []
+    prefix = ",".join(
+        f'{ln}="{_escape_label(lv)}"' for ln, lv in extra
+    )
+    lines: list[str] = []
+    name = m.name
+    for key in sorted(samples):
+        labelstr = ",".join(
+            filter(None, [prefix] + [
+                f'{ln}="{_escape_label(lv)}"'
+                for ln, lv in zip(m.label_names, key)
+            ])
+        )
+        if isinstance(m, Histogram):
+            counts, total, n = samples[key]
+            cum = 0
+            for le, c in zip(m.buckets, counts):
+                cum += c
+                blabel = ",".join(
+                    filter(None, [labelstr, f'le="{_fmt(le)}"'])
+                )
+                lines.append(f"{name}_bucket{{{blabel}}} {cum}")
+            blabel = ",".join(filter(None, [labelstr, 'le="+Inf"']))
+            lines.append(f"{name}_bucket{{{blabel}}} {n}")
+            base = f"{{{labelstr}}}" if labelstr else ""
+            lines.append(f"{name}_sum{base} {_fmt(total)}")
+            lines.append(f"{name}_count{base} {n}")
+        else:
+            base = f"{{{labelstr}}}" if labelstr else ""
+            lines.append(f"{name}{base} {_fmt(samples[key])}")
+    return lines
+
+
+def render_labeled(
+    registries: "dict[str, MetricsRegistry]", label: str = "worker"
+) -> str:
+    """One Prometheus exposition over SEVERAL registries carrying the
+    SAME metric names — the serving daemon's per-worker backend
+    registries.  Each metric renders ONE HELP/TYPE header (a duplicate
+    TYPE per registry would fail any strict scraper) and every sample
+    gains ``label="<registry key>"``, so per-lane dispatch counters,
+    latency histograms and memory watermarks stay distinguishable
+    without colliding.  Registries disagreeing on a metric's kind or
+    label set raise — that is schema drift, not a render concern."""
+    by_name: dict[str, list] = {}
+    for key in sorted(registries):
+        reg = registries[key]
+        for m in reg._sorted_metrics():
+            by_name.setdefault(m.name, []).append((key, m))
+    lines: list[str] = []
+    for name in sorted(by_name):
+        group = by_name[name]
+        kind, label_names = group[0][1].kind, group[0][1].label_names
+        for _, m in group[1:]:
+            if m.kind != kind or m.label_names != label_names:
+                raise ValueError(
+                    f"metric {name} disagrees across registries: "
+                    f"{m.kind}{m.label_names} vs {kind}{label_names}"
+                )
+        sample_lines: list[str] = []
+        for key, m in group:
+            sample_lines.extend(_sample_lines(m, extra=((label, key),)))
+        if not sample_lines:
+            continue
+        help_ = next((m.help for _, m in group if m.help), "")
+        if help_:
+            lines.append(f"# HELP {name} {_escape_help(help_)}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(sample_lines)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
@@ -186,48 +274,15 @@ class MetricsRegistry:
     def to_prometheus_text(self) -> str:
         lines: list[str] = []
         for m in self._sorted_metrics():
-            name = m.name
-            with m._lock:
-                # consistent per-metric snapshot under its lock:
-                # histogram states copy so cum-bucket math reads a
-                # frozen view even while observes continue
-                samples = {
-                    key: (
-                        (tuple(st.counts), st.total, st.n)
-                        if isinstance(m, Histogram)
-                        else st
-                    )
-                    for key, st in m.samples.items()
-                }
-            if not samples:
+            sample_lines = _sample_lines(m)
+            if not sample_lines:
                 # registered but never touched: a bare TYPE line with no
                 # samples is legal but pure noise — skip it
                 continue
             if m.help:
-                lines.append(f"# HELP {name} {_escape_help(m.help)}")
-            lines.append(f"# TYPE {name} {m.kind}")
-            for key in sorted(samples):
-                labelstr = ",".join(
-                    f'{ln}="{_escape_label(lv)}"'
-                    for ln, lv in zip(m.label_names, key)
-                )
-                if isinstance(m, Histogram):
-                    counts, total, n = samples[key]
-                    cum = 0
-                    for le, c in zip(m.buckets, counts):
-                        cum += c
-                        blabel = ",".join(
-                            filter(None, [labelstr, f'le="{_fmt(le)}"'])
-                        )
-                        lines.append(f"{name}_bucket{{{blabel}}} {cum}")
-                    blabel = ",".join(filter(None, [labelstr, 'le="+Inf"']))
-                    lines.append(f"{name}_bucket{{{blabel}}} {n}")
-                    base = f"{{{labelstr}}}" if labelstr else ""
-                    lines.append(f"{name}_sum{base} {_fmt(total)}")
-                    lines.append(f"{name}_count{base} {n}")
-                else:
-                    base = f"{{{labelstr}}}" if labelstr else ""
-                    lines.append(f"{name}{base} {_fmt(samples[key])}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(sample_lines)
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_textfile(self, path: str) -> None:

@@ -146,6 +146,26 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
         if serve_ev:
             sv["socket"] = serve_ev.get("socket")
             sv["warmed_kernels"] = serve_ev.get("warmed_kernels", 0)
+            if serve_ev.get("workers") is not None:
+                sv["n_workers"] = serve_ev.get("workers")
+        # worker-pool attribution: job_done events from a multi-lane
+        # daemon carry a `worker` field — group them so interleaved
+        # journals from concurrent lanes stay auditable per lane
+        workers: dict[str, dict] = {}
+        for e in jobs:
+            w = e.get("worker")
+            if w is None:
+                continue
+            row = workers.setdefault(
+                str(w), {"jobs": 0, "failed": 0, "busy_s": 0.0}
+            )
+            row["jobs"] += 1
+            if e.get("status") != "done":
+                row["failed"] += 1
+            if isinstance(e.get("wall_s"), (int, float)):
+                row["busy_s"] = round(row["busy_s"] + e["wall_s"], 4)
+        if workers:
+            sv["workers"] = workers
         walls = [e["wall_s"] for e in jobs]
         if walls:
             sv["mean_wall_s"] = round(sum(walls) / len(walls), 4)
@@ -271,7 +291,20 @@ def _render_serving(sv: dict, out) -> None:
         bits.append(f"warmed_kernels={sv['warmed_kernels']}")
     if "slo_breaches" in sv:
         bits.append(f"slo_breaches={sv['slo_breaches']}")
+    if "n_workers" in sv:
+        bits.append(f"workers={sv['n_workers']}")
     print(f"  serving: {' '.join(bits)}", file=out)
+    # per-lane rollup (multi-worker daemons): which lane ran what, and
+    # how busy it was — the journal-side view of the exporter's
+    # specpride_serve_worker_busy_seconds_total{worker}
+    workers = sv.get("workers") or {}
+    for w in sorted(workers, key=lambda k: (len(k), k)):
+        row = workers[w]
+        failed = f" failed={row['failed']}" if row.get("failed") else ""
+        print(
+            f"    worker {w}: jobs={row['jobs']}{failed} "
+            f"busy_s={row['busy_s']}", file=out,
+        )
 
 
 def _render_slo(run: dict, out) -> None:

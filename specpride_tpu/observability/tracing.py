@@ -222,27 +222,49 @@ def _lane_of_thread() -> int:
 
 
 # -- current-tracer plumbing ---------------------------------------------
+#
+# Two scopes: the PROCESS-global tracer (one-shot CLI runs, bench, and
+# every caller that predates multi-lane serving) and an optional
+# THREAD-local override.  A serving worker lane installs its job's
+# tracer thread-locally so concurrent jobs' spans land in their OWN
+# journals instead of whichever job installed the global last; the
+# job's lane threads (pack workers, committer) adopt the creating
+# thread's tracer at start (cli wires it), so the per-run behaviour is
+# identical to the one-shot CLI's.
 
 _NULL_TRACER = NullTracer()
 _current: Tracer | NullTracer = _NULL_TRACER
+_current_tls = threading.local()
 
 
 def current() -> Tracer | NullTracer:
-    return _current
+    override = getattr(_current_tls, "tracer", None)
+    return override if override is not None else _current
 
 
 def set_current(tracer: Tracer | None) -> Tracer | NullTracer:
-    """Install ``tracer`` (None restores the no-op tracer); returns the
-    previous one so callers can restore it."""
+    """Install ``tracer`` PROCESS-wide (None restores the no-op tracer);
+    returns the previous one so callers can restore it."""
     global _current
     prev = _current
     _current = tracer if tracer is not None else _NULL_TRACER
     return prev
 
 
+def set_thread_current(
+    tracer: Tracer | NullTracer | None,
+) -> Tracer | NullTracer | None:
+    """Install ``tracer`` as THIS thread's tracer override (None removes
+    the override, falling back to the process-global tracer); returns
+    the previous override for restore-on-exit."""
+    prev = getattr(_current_tls, "tracer", None)
+    _current_tls.tracer = tracer
+    return prev
+
+
 def span(name: str, **labels):
     """Open a span on the current tracer (no-op when tracing is off)."""
-    return _current.span(name, **labels)
+    return current().span(name, **labels)
 
 
 def traced(name: str, **static_labels):
@@ -251,7 +273,7 @@ def traced(name: str, **static_labels):
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            tracer = _current
+            tracer = current()
             if not tracer.enabled:
                 return fn(*args, **kwargs)
             with tracer.span(name, **static_labels):

@@ -11,17 +11,25 @@ warm-request latency:
 
 * ``protocol`` — the JSON-lines request/response wire format and the
   job-validation rules (which flags the daemon owns vs the job);
-* ``scheduler`` — the bounded admission queue with FIFO-fair
-  round-robin scheduling across concurrent clients;
+* ``scheduler`` — the bounded admission queue with weighted-fair
+  deficit scheduling, per-tenant ``--quota`` inflight caps, and the
+  output-path conflict guard (defaults degenerate to the original
+  FIFO-fair round-robin);
+* ``placement`` — device-aware lane placement for the worker pool
+  (``--workers N``: pinned per local device on accelerator hosts,
+  shared platform on CPU);
+* ``ingest_cache`` — parsed-input residency: repeat jobs over an
+  unchanged input skip the parse (keyed by path + size + mtime);
 * ``daemon`` — boot / accept / execute / drain lifecycle (SIGTERM
-  drains: in-flight jobs commit through the ordered write lane, queued
-  jobs are rejected with a retriable status);
+  drains: every lane's in-flight job commits through its ordered write
+  lane, queued jobs are rejected with a retriable status);
 * ``client`` — the thin ``specpride submit`` client.
 
 Jobs run through the exact CLI execution body
-(``cli._run_pipeline_command``) with the daemon's resident backend, so
-served output is byte-identical to the one-shot CLI's — the parity the
-test suite and CI enforce.
+(``cli._run_pipeline_command``) with a worker lane's resident backend,
+so served output is byte-identical to the one-shot CLI's — the parity
+the test suite and CI enforce, including concurrent and same-output
+submissions.
 """
 
 from specpride_tpu.serve.protocol import (  # noqa: F401

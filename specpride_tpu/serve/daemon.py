@@ -10,26 +10,34 @@ Lifecycle (documented in docs/serving.md):
   the unix socket and start accepting.
 * **serve** — each connection is one job: the reader thread validates
   the argv with the CLI's own parser and offers it to the bounded
-  FIFO-fair :class:`~specpride_tpu.serve.scheduler.AdmissionQueue`;
-  the single execution worker pops jobs and runs them through the exact
-  CLI execution body (``cli._run_pipeline_command``) with the resident
-  backend — the three-lane executor, per-job journal, per-job
-  ``run_end`` stats and the robustness harness all behave exactly as
-  one-shot runs, so served output is byte-identical to the CLI's.
+  weighted-fair :class:`~specpride_tpu.serve.scheduler.AdmissionQueue`
+  (``--quota client=weight[:max_inflight]``); the **worker pool**
+  (``--workers N``, default ``min(#local jax devices, 4)``; 1 = the
+  PR 7 single lane) pops jobs and runs them through the exact CLI
+  execution body (``cli._run_pipeline_command``) — the three-lane
+  executor, per-job journal, per-job ``run_end`` stats and the
+  robustness harness all behave exactly as one-shot runs, so served
+  output is byte-identical to the CLI's.  Each worker owns its own
+  resident ``TpuBackend`` placed by ``serve.placement`` (pinned to a
+  distinct local device on accelerator hosts; shared platform on
+  CPU-only hosts), so jobs writing distinct outputs execute
+  CONCURRENTLY; the scheduler's output-path conflict guard serializes
+  jobs that target the same file.
 * **drain** — SIGTERM (or SIGINT): stop accepting, reject every
-  *queued* job with a retriable status, let the *in-flight* job commit
-  through its ordered write lane, journal ``serve_drain`` +
+  *queued* job with a retriable status, let every worker's *in-flight*
+  job commit through its ordered write lane, journal ``serve_drain`` +
   ``run_end``, remove the socket, exit 0.
 
-Per-job resident-backend hygiene: jobs serialize on the execution lane,
-and between jobs the worker resets exactly the per-run backend state —
-metrics registry, run stats, journal hook, routing-note memo — while
-the warm state (jit caches, ``_seen_shapes``, plan cache, persistent
-compile cache) stays resident.  Per-job deltas of the process-wide
-singletons are snapshot-and-diffed by ``cli._open_run_journal`` /
-``_finish_run`` (never reset mid-run), so every job's ``run_end``
-reports its own compile/plan-cache traffic even deep into a long-lived
-process.
+Per-job resident-backend hygiene: jobs serialize PER WORKER, and
+between jobs each worker resets exactly the per-run state on ITS OWN
+backend — run stats, journal hook, routing-note memo — while the warm
+state (jit caches, ``_seen_shapes``, plan cache, persistent compile
+cache) stays resident.  Per-job deltas of the process-wide singletons
+are snapshot-and-diffed by ``cli._open_run_journal`` / ``_finish_run``
+per-worker-safely (thread-scoped compile-cache counters, a per-job
+plan-cache scope, the worker's own device registry — never a process
+total), so every job's ``run_end`` reports its own compile/plan-cache
+traffic even with other jobs in flight concurrently.
 
 Robustness: the request loop is guarded by the shared error taxonomy —
 transient socket errors on accept retry with a short backoff instead of
@@ -66,8 +74,8 @@ from specpride_tpu.observability import (
 )
 from specpride_tpu.robustness import errors as rb_errors
 from specpride_tpu.robustness.watchdog import Watchdog
-from specpride_tpu.serve import protocol
-from specpride_tpu.serve.scheduler import AdmissionQueue
+from specpride_tpu.serve import placement, protocol
+from specpride_tpu.serve.scheduler import AdmissionQueue, QuotaExceeded
 
 
 class Job:
@@ -94,12 +102,28 @@ class Job:
         self.ack = threading.Event()
 
 
+def _job_claimed_paths(job: "Job") -> list[str]:
+    """The filesystem paths a job WRITES — the conflict-guard tokens the
+    scheduler holds while the job executes.  Two jobs sharing any of
+    them (output, QC report, checkpoint manifest, journal) serialize;
+    everything else runs concurrently."""
+    paths = []
+    for attr in ("output", "qc_report", "checkpoint", "journal",
+                 "chrome_trace"):
+        p = getattr(job.args, attr, None)
+        if p:
+            paths.append(os.path.abspath(p))
+    return paths
+
+
 class ServeDaemon:
     def __init__(
         self,
         socket_path: str | None = None,
         *,
         max_queue: int = 16,
+        workers: int = 0,
+        quotas: dict | None = None,
         compile_cache: str | None = None,
         routing_table: str | None = None,
         layout: str = "auto",
@@ -122,10 +146,19 @@ class ServeDaemon:
         self.warmup = warmup
         self.warmup_manifest = warmup_manifest
         self.warmup_jobs = warmup_jobs
-        self.queue = AdmissionQueue(max_queue)
+        self.quotas = dict(quotas or {})
+        self.queue = AdmissionQueue(
+            max_queue, quotas=self.quotas,
+            conflict_key=_job_claimed_paths,
+        )
         self.journal_path = journal_path
         self.journal = None
-        self.backend = None
+        self.backend = None  # worker 0's backend (back-compat alias)
+        # execution lanes: 0 = auto (min(#local jax devices, 4)); the
+        # placement plan and per-worker backends are built at boot
+        self.workers_requested = int(workers)
+        self.slots: list = []
+        self.worker_backends: list = []
         self.metrics_port = metrics_port
         self.metrics_host = metrics_host
         self.metrics_out = metrics_out
@@ -138,28 +171,34 @@ class ServeDaemon:
         self.jobs_done = 0
         self.jobs_failed = 0
         self.jobs_rejected = 0
-        # jobs_rejected increments on CONCURRENT reader threads (and on
-        # drain) — unlike done/failed, which only the worker touches —
-        # so its read-modify-write needs a lock or bursts undercount
+        # done/failed increment on CONCURRENT worker threads now, and
+        # jobs_rejected on reader threads (and drain): every
+        # read-modify-write needs its lock or bursts undercount
         self._rejected_lock = threading.Lock()
+        self._counts_lock = threading.Lock()
         self._job_ids = iter(range(1, 1 << 62)).__next__
         self._listener: socket.socket | None = None
         self._stop = threading.Event()
         self._draining = False
         self._drain_lock = threading.Lock()
         self._t_boot = 0.0
-        self._worker = threading.Thread(
-            target=self._worker_loop, name="specpride-serve-worker",
-            daemon=True,
-        )
-        # test seam: the worker waits on this gate between popping a job
-        # and executing it, so drain-with-in-flight-work is testable
-        # deterministically (set by default — production never waits);
-        # _inflight is the popped-but-not-yet-replied job, observable by
-        # the same tests
+        self._worker_threads: list[threading.Thread] = []
+        # test seam: every worker waits on this gate between popping a
+        # job and executing it, so drain-with-in-flight-work (and
+        # concurrent-lane occupancy) is testable deterministically (set
+        # by default — production never waits); _inflight_by maps worker
+        # id -> its popped-but-not-yet-replied job, observable by the
+        # same tests (the _inflight property keeps the single-lane view)
         self._gate = threading.Event()
         self._gate.set()
-        self._inflight: Job | None = None
+        self._inflight_by: dict[int, Job] = {}
+
+    @property
+    def _inflight(self) -> Job | None:
+        """Any in-flight job (the PR 7 single-lane observable; tests and
+        the sampler that need the full per-worker map read
+        ``_inflight_by``)."""
+        return next(iter(self._inflight_by.values()), None)
 
     # -- boot -----------------------------------------------------------
 
@@ -183,10 +222,25 @@ class ServeDaemon:
         )
         self.watchdog.journal = self.journal
         routing = RoutingTable.load(self.routing_table)
-        self.backend = TpuBackend(
-            layout=self.layout, force_device=self.force_device,
-            routing=routing,
+        # the worker pool: one resident backend per execution lane,
+        # placed by serve.placement (distinct local devices on
+        # accelerator hosts; shared platform, independent per-lane
+        # state, on CPU-only hosts).  Worker 0's backend doubles as
+        # `self.backend` for the single-lane call sites.
+        n_workers = (
+            self.workers_requested
+            if self.workers_requested >= 1
+            else placement.default_workers()
         )
+        self.slots = placement.plan_placement(n_workers)
+        self.worker_backends = [
+            TpuBackend(
+                layout=self.layout, force_device=self.force_device,
+                routing=routing, device=slot.device,
+            )
+            for slot in self.slots
+        ]
+        self.backend = self.worker_backends[0]
         # the live telemetry plane: always built (it feeds the drain-time
         # --metrics-out snapshot too), HTTP-exposed only with
         # --metrics-port.  The resident backend's registry rides along so
@@ -207,9 +261,25 @@ class ServeDaemon:
                 f"another daemon is serving on {self.socket_path} "
                 "(pass a different --socket, or stop it first)"
             )
-        self.telemetry = ServeTelemetry(
-            slo=self.slo, extra_registries=(self.backend.metrics,),
-        )
+        if len(self.worker_backends) == 1:
+            # single lane: the resident registry rides the exposition
+            # unlabeled, exactly the PR 8 series names
+            self.telemetry = ServeTelemetry(
+                slo=self.slo, extra_registries=(self.backend.metrics,),
+            )
+        else:
+            # worker pool: each lane's registry carries the same metric
+            # names, so they ride the exposition under one TYPE line
+            # with a worker label per series (registry.render_labeled)
+            self.telemetry = ServeTelemetry(
+                slo=self.slo,
+                worker_registries={
+                    str(slot.worker): backend.metrics
+                    for slot, backend in zip(
+                        self.slots, self.worker_backends
+                    )
+                },
+            )
         self.telemetry.sampler = self._sample_live
         if self.metrics_port is not None:
             self.exporter = MetricsExporter(
@@ -242,14 +312,20 @@ class ServeDaemon:
             max_queue=self.queue.capacity,
             warmed_kernels=self.warmed_kernels,
             boot_s=round(boot_s, 4),
+            workers=len(self.slots),
+            placement=[slot.describe() for slot in self.slots],
             **({"metrics_port": self.exporter.port}
                if self.exporter is not None else {}),
             **({"slo": self.slo} if self.slo else {}),
+            **({"quota": {c: repr(q) for c, q in self.quotas.items()}}
+               if self.quotas else {}),
         )
         logger.info(
             "serving on %s (boot %.2fs, %d kernel variants warmed, "
-            "queue depth %d)", self.socket_path, boot_s,
-            self.warmed_kernels, self.queue.capacity,
+            "queue depth %d, %d worker lane(s): %s)", self.socket_path,
+            boot_s, self.warmed_kernels, self.queue.capacity,
+            len(self.slots),
+            " ".join(slot.describe() for slot in self.slots),
         )
         if self.exporter is not None:
             logger.info("live metrics on %s", self.exporter.url)
@@ -268,13 +344,28 @@ class ServeDaemon:
         # in-flight zeroes (not clears): once a (command, method) pair
         # has run, its series stays visible at 0 — scrapers see the drop
         telemetry.inflight.zero_all()
-        job = self._inflight
-        telemetry.inflight_total.set(0 if job is None else 1)
-        if job is not None:
+        inflight = dict(self._inflight_by)  # point-in-time lane view
+        telemetry.inflight_total.set(len(inflight))
+        counts: dict[tuple, int] = {}
+        for job in inflight.values():
+            key = (
+                job.command,
+                str(getattr(job.args, "method", None) or "-"),
+                getattr(job.args, "backend", "tpu"),
+            )
+            counts[key] = counts.get(key, 0) + 1
+        for (command, method, backend), n in counts.items():
             telemetry.inflight.set(
-                1, command=job.command,
-                method=str(getattr(job.args, "method", None) or "-"),
-                backend=getattr(job.args, "backend", "tpu"),
+                n, command=command, method=method, backend=backend,
+            )
+        # per-worker occupancy: clear-and-set over the FIXED worker set
+        # (idle lanes read 0, busy lanes 1 — the lane-utilization view)
+        telemetry.inflight_worker.clear()
+        telemetry.workers.set(len(self.slots))
+        for slot in self.slots:
+            telemetry.inflight_worker.set(
+                1 if slot.worker in inflight else 0,
+                worker=str(slot.worker),
             )
         telemetry.uptime.set(
             round(time.perf_counter() - self._t_boot, 3)
@@ -339,7 +430,15 @@ class ServeDaemon:
 
             signal.signal(signal.SIGTERM, self._on_signal)
             signal.signal(signal.SIGINT, self._on_signal)
-        self._worker.start()
+        self._worker_threads = [
+            threading.Thread(
+                target=self._worker_loop, args=(slot.worker,),
+                name=f"specpride-serve-worker-{slot.worker}", daemon=True,
+            )
+            for slot in self.slots
+        ]
+        for t in self._worker_threads:
+            t.start()
         try:
             self._accept_loop()
         finally:
@@ -434,9 +533,11 @@ class ServeDaemon:
             with self._rejected_lock:
                 self.jobs_rejected += 1
             # bounded label cardinality: free-text parser messages all
-            # count as "invalid"; the retriable categories keep their name
+            # count as "invalid"; the retriable categories keep their
+            # name, and per-tenant quota bounces roll up under "quota"
             self.telemetry.job_rejected(
                 reason if reason in ("draining", "queue_full")
+                else "quota" if reason.startswith("quota ")
                 else "invalid"
             )
             self.journal.emit(
@@ -486,7 +587,14 @@ class ServeDaemon:
             )
         job = Job(job_id, client or id(conn), argv, args,
                   argv[0], conn, fh)
-        if not self.queue.offer(job.client, job):
+        try:
+            admitted = self.queue.offer(job.client, job)
+        except QuotaExceeded as e:
+            # the tenant's max_inflight quota already covers its queued
+            # + executing jobs: backpressure with the quota NAMED, and
+            # retriable — `specpride submit` exits 75 (EX_TEMPFAIL)
+            return reject(str(e), True)
+        if not admitted:
             return reject(
                 "draining" if self._draining else "queue_full", True
             )
@@ -659,27 +767,32 @@ class ServeDaemon:
 
     # -- execution lane -------------------------------------------------
 
-    def _worker_loop(self) -> None:
+    def _worker_loop(self, wid: int) -> None:
         from specpride_tpu.warmstart import cache as ws_cache
 
         while True:
             job = self.queue.pop()
             if job is None:
                 return
-            self._inflight = job
+            self._inflight_by[wid] = job
             self._gate.wait()
             wait_s = time.perf_counter() - job.t_enqueued
             self.journal.emit(
                 "job_start", job_id=job.job_id, command=job.command,
                 method=getattr(job.args, "method", None),
-                queue_wait_s=round(wait_s, 4),
+                queue_wait_s=round(wait_s, 4), worker=wid,
             )
             t0 = time.perf_counter()
-            cc0 = ws_cache.counters_snapshot()
+            # THREAD-scoped compile counters: every compile a job causes
+            # fires on the worker thread that dispatched it, so this
+            # delta is the job's own even with other lanes compiling
+            # concurrently (the process-wide snapshot would cross-
+            # attribute between in-flight jobs)
+            cc0 = ws_cache.thread_counters_snapshot()
             status, rc, err, retriable, summary = "done", 0, None, False, None
             try:
                 with self.watchdog.section("serve:job"):
-                    summary = self._execute(job)
+                    summary = self._execute(job, wid)
             except SystemExit as e:
                 # CLI-style usage/abort error (bad input file, refused
                 # resume): permanent from the daemon's point of view
@@ -691,11 +804,12 @@ class ServeDaemon:
                 err = f"{type(e).__name__}: {e}"
                 retriable = rb_errors.is_transient(e)
             wall = time.perf_counter() - t0
-            cc = ws_cache.counters_delta(cc0)
-            if status == "done":
-                self.jobs_done += 1
-            else:
-                self.jobs_failed += 1
+            cc = ws_cache.thread_counters_delta(cc0)
+            with self._counts_lock:
+                if status == "done":
+                    self.jobs_done += 1
+                else:
+                    self.jobs_failed += 1
             # fold the finished job into the live metric plane; the SLO
             # evaluation (objective, measured latency, ok/breach) rides
             # the journal's job_done so `stats --slo` and /metrics agree
@@ -704,6 +818,7 @@ class ServeDaemon:
                 method=getattr(job.args, "method", None),
                 status=status, wall_s=wall, queue_wait_s=wait_s,
                 summary=summary if isinstance(summary, dict) else None,
+                worker=wid,
             )
             self.journal.emit(
                 "job_done", job_id=job.job_id, status=status,
@@ -711,6 +826,7 @@ class ServeDaemon:
                 command=job.command,
                 method=getattr(job.args, "method", None),
                 fresh_compiles=cc.get("misses", 0),
+                worker=wid,
                 **slo_fields,
                 **({"error": err} if err else {}),
             )
@@ -721,7 +837,7 @@ class ServeDaemon:
                         job.fh, ok=True, status="done", job_id=job.job_id,
                         rc=rc, wall_s=round(wall, 4),
                         queue_wait_s=round(wait_s, 4), stats=summary,
-                        compile_cache=cc,
+                        compile_cache=cc, worker=wid,
                     )
                 else:
                     protocol.write_msg(
@@ -738,25 +854,37 @@ class ServeDaemon:
                     "response", job.job_id,
                 )
             self._close(job.conn, job.fh)
-            self._inflight = None
+            self._inflight_by.pop(wid, None)
+            # free the client's inflight-quota slot and the job's
+            # conflict-guard paths only AFTER the terminal write and
+            # close: a same-output successor popping earlier could start
+            # rewriting the file a reader still attributes to this job
+            self.queue.release(job)
 
-    def _execute(self, job: Job) -> dict:
-        """Run one job through THE CLI execution body with the resident
-        backend, resetting exactly the per-run backend state first."""
+    def _execute(self, job: Job, wid: int) -> dict:
+        """Run one job through THE CLI execution body with worker
+        ``wid``'s resident backend, pinned to its placement slot,
+        resetting exactly the per-run backend state first."""
         from specpride_tpu import cli
 
+        slot = self.slots[wid]
+        # the CLI stamps the worker into the job's run_end and scopes
+        # its tracer + singleton snapshots to this thread (numpy-backend
+        # jobs too: their journal spans must not leak across lanes)
+        job.args._serve_worker = wid
         backend = None
         if getattr(job.args, "backend", "tpu") == "tpu":
-            backend = self.backend
-            # per-job telemetry state on the shared backend: run stats
-            # are per-run by contract; the journal hook and pack
+            backend = self.worker_backends[wid]
+            # per-job telemetry state on the worker's OWN backend: run
+            # stats are per-run by contract; the journal hook and pack
             # accounting are (re)set by _open_run_journal, and the
             # routing-note memo clears so EVERY job's journal carries
             # the routing events that applied to it.  Warm state
             # (_seen_shapes, jit caches) deliberately survives — and so
             # does the METRICS registry: /metrics serves it live, so its
             # counters must stay Prometheus-monotone across jobs (each
-            # job's run_end diffs a device_counters_snapshot instead).
+            # job's run_end diffs a device_counters_snapshot instead;
+            # per-worker registries make that diff concurrency-safe).
             backend.stats = RunStats()
             backend.pack_accounting = False
             backend._routing_noted.clear()
@@ -764,15 +892,17 @@ class ServeDaemon:
             # resident: per-job AOT re-warming is pure request latency
             # (manifest saving still runs so jobs seed future boots)
             job.args._resident_warm = True
-        return cli._run_pipeline_command(job.args, job.command,
-                                         backend=backend)
+        with placement.device_scope(slot.device):
+            return cli._run_pipeline_command(job.args, job.command,
+                                             backend=backend)
 
     # -- shutdown -------------------------------------------------------
 
     def drain(self) -> None:
-        """Graceful shutdown: reject queued jobs (retriable), commit the
-        in-flight one, close everything.  Idempotent and callable from
-        any thread (signal path and in-process tests share it)."""
+        """Graceful shutdown: reject queued jobs (retriable), commit
+        EVERY worker's in-flight job through its ordered write lane,
+        close everything.  Idempotent and callable from any thread
+        (signal path and in-process tests share it)."""
         with self._drain_lock:
             if self._draining:
                 return
@@ -805,8 +935,11 @@ class ServeDaemon:
                 pass  # client already gone / fh closed by its reader
             self._close(job.conn, job.fh)
         self._gate.set()  # a held test gate must not deadlock the drain
-        if self._worker.is_alive():
-            self._worker.join()
+        # every lane finishes its in-flight job (the queue is closed and
+        # empty, so each worker commits what it holds, then exits)
+        for t in self._worker_threads:
+            if t.is_alive():
+                t.join()
         # wait out an in-flight profile capture (its window breaks on
         # _stop within one sleep quantum, but stop_trace's export + the
         # journal-window scan take real time): its profile_done must
@@ -871,7 +1004,12 @@ class ServeDaemon:
             "jobs_failed": self.jobs_failed,
             "jobs_rejected": self.jobs_rejected,
             "warmed_kernels": self.warmed_kernels,
+            "workers": len(self.slots),
+            "placement": [slot.describe() for slot in self.slots],
+            "inflight": len(self._inflight_by),
             "uptime_s": round(time.perf_counter() - self._t_boot, 2),
+            **({"quota": {c: repr(q) for c, q in self.quotas.items()}}
+               if self.quotas else {}),
             **(
                 {"metrics_port": self.exporter.port,
                  "metrics_url": self.exporter.url}

@@ -1,0 +1,112 @@
+"""Parsed-input residency for the serving daemon's worker pool.
+
+The daemon's scenario is repeated small/medium jobs over the same
+clustered MGF inputs — and profiling warm served jobs shows the parse
+phase dominating them once kernels are warm (on hosts without the C++
+fast parser it is a GIL-bound Python loop, which also caps what
+concurrent lanes can overlap).  The compile cache, plan cache and jit
+caches already stay resident across jobs; this module extends the same
+residency to the PARSED INPUT: a bounded process-wide LRU of eagerly
+parsed cluster lists keyed by ``(abspath, size, mtime_ns)``, so a
+repeat job skips the parse entirely and a modified input misses by
+construction.
+
+Safety contract:
+
+* Cached cluster lists are shared READ-ONLY across jobs (and across
+  concurrent lanes).  Every consumer treats clusters/spectra as
+  immutable — the bench harness has always re-run the same in-memory
+  cluster lists through every backend with byte-identical outputs, and
+  the served byte-parity tests cover the cached path the same way.
+* Only EAGER parses cache: streamed inputs (``StreamedClusters``) are
+  a bounded-memory view, not a materialized list, and quarantine runs
+  (``--on-error skip``) must re-see malformed blocks — both bypass.
+* Keyed on size + mtime_ns: rewriting the input invalidates; same
+  bytes re-written in place (same mtime resolution caveat as make).
+
+Hit/miss counters ride each job's ``run_end.counters``
+(``ingest_cache_hits`` / ``ingest_cache_misses``) and the daemon's
+``/metrics`` exposition (``specpride_serve_ingest_cache_*_total``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+# entries, not bytes: serving workloads are "repeated small/medium
+# jobs" by design — a handful of distinct inputs covers them, and an
+# operator serving many huge distinct files should raise/disable this
+DEFAULT_MAX_ENTRIES = 4
+
+_lock = threading.Lock()
+_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+_counts = {"hits": 0, "misses": 0}
+
+
+def _max_entries() -> int:
+    try:
+        return int(os.environ.get("SPECPRIDE_INGEST_CACHE",
+                                  DEFAULT_MAX_ENTRIES))
+    except ValueError:
+        return DEFAULT_MAX_ENTRIES
+
+
+def _key(path: str) -> tuple | None:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None  # let the parser produce the real error
+    return (os.path.abspath(path), st.st_size, st.st_mtime_ns)
+
+
+def get(path: str) -> "tuple | None":
+    """``(clusters, n_spectra, n_peaks)`` for an unchanged ``path``, or
+    None (miss / disabled / unstattable)."""
+    if _max_entries() <= 0:
+        return None
+    key = _key(path)
+    if key is None:
+        return None
+    with _lock:
+        entry = _cache.get(key)
+        if entry is None:
+            # the miss is counted at put() time: a lookup whose parse
+            # then FAILS never populates, and the exported miss total
+            # must match its help text ("parses that populated") and
+            # the per-job run_end counter
+            return None
+        _counts["hits"] += 1
+        _cache.move_to_end(key)
+        return entry
+
+
+def put(path: str, clusters: list, n_spectra: int, n_peaks: int) -> None:
+    """Cache one eagerly parsed input (no-op when disabled or the file
+    cannot be stat'd — it may have been replaced mid-parse, in which
+    case caching under the NEW stat would poison a future hit)."""
+    limit = _max_entries()
+    if limit <= 0:
+        return
+    key = _key(path)
+    if key is None:
+        return
+    with _lock:
+        _counts["misses"] += 1
+        _cache[key] = (clusters, int(n_spectra), int(n_peaks))
+        _cache.move_to_end(key)
+        while len(_cache) > limit:
+            _cache.popitem(last=False)
+
+
+def info() -> dict:
+    """{"hits", "misses", "size"} — exporter mirror + tests."""
+    with _lock:
+        return dict(_counts, size=len(_cache))
+
+
+def clear() -> None:
+    with _lock:
+        _cache.clear()
+        _counts.update(hits=0, misses=0)

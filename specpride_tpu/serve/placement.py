@@ -1,0 +1,114 @@
+"""Device-aware worker placement for the serving daemon's lane pool.
+
+``specpride serve --workers N`` runs N concurrent execution lanes, each
+owning its own resident ``TpuBackend``.  This module decides where each
+lane's dispatches land:
+
+* **Accelerator hosts** (any non-CPU jax device visible): workers are
+  pinned round-robin across the local devices via
+  ``jax.default_device`` — N workers on N chips keep every chip busy
+  with independent jobs, the scale-out the pool exists for.  Pinning
+  commits each lane's jit executions to its device, so two lanes never
+  contend for one chip's queue while another sits idle.
+
+* **CPU-only hosts** (including the test suite's virtual 8-device CPU
+  split): workers share the default device/platform unpinned.  XLA's
+  CPU "devices" are one physical socket — pinning buys no parallelism
+  (the thread pool is shared) but would fork the in-process jit caches
+  AND the persistent compile cache per device ordinal (the cache key
+  includes the device assignment; measured: a kernel cached for cpu:0
+  recompiles for cpu:1), costing every lane a cold first job for
+  nothing.  Lane concurrency still wins on CPU because a served job is
+  mostly host-side work (parse, pack, QC finalize, write) that the
+  lanes overlap.
+
+Either way each worker keeps INDEPENDENT per-lane state — its own
+backend, metrics registry, run stats, seen-shape set — so per-job
+snapshot-and-diff attribution stays correct with jobs in flight
+concurrently (see ``docs/serving.md``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+
+DEFAULT_MAX_WORKERS = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerSlot:
+    """One execution lane's placement: ``device`` is a jax Device to pin
+    dispatches to, or None to share the process default."""
+
+    worker: int
+    device: object | None
+    device_index: int | None
+    platform: str
+
+    def describe(self) -> str:
+        if self.device is None:
+            return f"{self.platform}:shared"
+        return f"{self.platform}:{self.device_index}"
+
+
+def local_devices() -> list:
+    """The host's visible jax devices ([] when jax cannot initialize —
+    placement then degrades to one unpinned worker)."""
+    try:
+        import jax
+
+        return list(jax.local_devices())
+    except Exception:  # noqa: BLE001 - bring-up failure: decide nothing
+        return []
+
+
+def default_workers() -> int:
+    """``--workers`` default: ``min(#local jax devices, 4)``, floored at
+    1 — one lane per accelerator up to a host-friendly cap (more lanes
+    than devices just contend; 4 bounds the thread fan-out on big CPU
+    hosts where "devices" are virtual)."""
+    return max(1, min(DEFAULT_MAX_WORKERS, len(local_devices()) or 1))
+
+
+def plan_placement(
+    n_workers: int, *, pin_cpu: bool = False
+) -> list[WorkerSlot]:
+    """Placement for ``n_workers`` lanes: round-robin over the local
+    devices on accelerator hosts, shared/unpinned on CPU-only hosts
+    (``pin_cpu=True`` forces CPU pinning — tests exercising the pinning
+    path use it; production never should, see the module docstring)."""
+    n_workers = max(1, int(n_workers))
+    devs = local_devices()
+    if not devs:
+        return [
+            WorkerSlot(w, None, None, "unknown") for w in range(n_workers)
+        ]
+    cpu_only = all(
+        getattr(d, "platform", "cpu") == "cpu" for d in devs
+    )
+    if cpu_only and not pin_cpu:
+        plat = getattr(devs[0], "platform", "cpu")
+        return [
+            WorkerSlot(w, None, None, plat) for w in range(n_workers)
+        ]
+    return [
+        WorkerSlot(
+            w,
+            devs[w % len(devs)],
+            int(getattr(devs[w % len(devs)], "id", w % len(devs))),
+            getattr(devs[w % len(devs)], "platform", "unknown"),
+        )
+        for w in range(n_workers)
+    ]
+
+
+def device_scope(device):
+    """Context manager pinning the current thread's jax dispatches to
+    ``device`` (``jax.default_device`` is thread-scoped, so concurrent
+    lanes pin independently); a no-op for unpinned slots."""
+    if device is None:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.default_device(device)

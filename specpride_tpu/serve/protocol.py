@@ -34,15 +34,20 @@ leaves the execution lane::
     {"ok": true,  "status": "accepted", "job_id": 3, "queue_depth": 1}
     {"ok": true,  "status": "done", "job_id": 3, "rc": 0,
      "wall_s": 1.23, "queue_wait_s": 0.0, "stats": {...},
-     "compile_cache": {"hits": 0, "misses": 0, ...}}
+     "compile_cache": {"hits": 0, "misses": 0, ...}, "worker": 1}
     {"ok": false, "status": "rejected", "reason": "queue_full",
+     "retriable": true}
+    {"ok": false, "status": "rejected",
+     "reason": "quota client=teamA max_inflight=2: ...",
      "retriable": true}
     {"ok": false, "status": "error", "job_id": 3,
      "error": "ValueError: ...", "retriable": false}
 
-``retriable`` follows the robustness error taxonomy
-(``robustness.errors``): admission rejections (``queue_full``,
-``draining``) are always retriable — resubmit after backoff — while
+``worker`` on the terminal line is the execution lane that ran the job
+(``serve --workers N``).  ``retriable`` follows the robustness error
+taxonomy (``robustness.errors``): admission rejections (``queue_full``,
+``draining``, and per-tenant ``quota ...`` bounces — the quota is named
+in the reason) are always retriable — resubmit after backoff — while
 execution errors are retriable only when the taxonomy classifies them
 transient.  ``specpride submit`` maps a retriable non-success to exit
 code 75 (BSD ``EX_TEMPFAIL``), so shell callers can retry on ``$? ==
@@ -89,6 +94,10 @@ DAEMON_ONLY_FLAGS = (
     # bind ports inside the daemon process)
     "--elastic",
     "--metrics-port",
+    # jax has ONE global profiler session per process: a per-job device
+    # trace would race concurrent worker lanes (and any `specpride
+    # profile` capture).  Profile the daemon itself instead.
+    "--trace-dir",
 )
 
 # `specpride submit` exit code for a retriable non-success (BSD
@@ -148,7 +157,7 @@ def forbidden_flags(argv: list[str]) -> list[str]:
 _DAEMON_OWNED_DESTS = (
     "compile_cache", "routing_table", "layout", "force_device",
     "mesh", "coordinator", "num_processes", "process_id", "metrics_out",
-    "elastic", "metrics_port",
+    "elastic", "metrics_port", "trace_dir",
 )
 
 _daemon_owned_defaults: dict | None = None

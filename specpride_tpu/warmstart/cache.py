@@ -59,17 +59,21 @@ def _install_listeners() -> None:
     def _on_event(name: str, **kw) -> None:
         if name == "/jax/compilation_cache/cache_hits":
             _counts["hits"] += 1
+            _thread_totals()["hits"] += 1
             _bump_tls("hits")
         elif name == "/jax/compilation_cache/cache_misses":
             _counts["misses"] += 1
+            _thread_totals()["misses"] += 1
             _bump_tls("misses")
         elif name == "/jax/compilation_cache/compile_requests_use_cache":
             _counts["requests"] += 1
+            _thread_totals()["requests"] += 1
             _bump_tls("requests")
 
     def _on_duration(name: str, secs: float, **kw) -> None:
         if name == "/jax/compilation_cache/compile_time_saved_sec":
             _counts["saved_s"] += max(float(secs), 0.0)
+            _thread_totals()["saved_s"] += max(float(secs), 0.0)
 
     monitoring.register_event_listener(_on_event)
     monitoring.register_event_duration_secs_listener(_on_duration)
@@ -79,6 +83,41 @@ def _bump_tls(key: str) -> None:
     counts = getattr(_tls, "counts", None)
     if counts is not None:
         counts[key] = counts.get(key, 0) + 1
+
+
+def _thread_totals() -> dict:
+    """Always-on per-thread totals (the listeners run on the compiling
+    thread).  A serving worker lane attributes a job's compile traffic
+    by diffing THIS thread's totals — the process-wide ``_counts`` would
+    cross-attribute between jobs compiling on concurrent lanes."""
+    totals = getattr(_tls, "totals", None)
+    if totals is None:
+        totals = _tls.totals = {
+            "hits": 0, "misses": 0, "requests": 0, "saved_s": 0.0,
+        }
+    return totals
+
+
+def thread_counters_snapshot() -> dict:
+    """The CURRENT thread's persistent-cache counters (monotone within
+    the thread's lifetime) — the per-lane analogue of
+    :func:`counters_snapshot`."""
+    t = _thread_totals()
+    return {
+        "hits": t["hits"],
+        "misses": t["misses"],
+        "requests": t["requests"],
+        "saved_s": round(t["saved_s"], 4),
+    }
+
+
+def thread_counters_delta(since: dict) -> dict:
+    now = thread_counters_snapshot()
+    return {
+        k: round(now[k] - since.get(k, 0), 4) if k == "saved_s"
+        else now[k] - since.get(k, 0)
+        for k in now
+    }
 
 
 def thread_counts_reset() -> None:

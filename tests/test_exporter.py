@@ -288,6 +288,10 @@ class TestLiveExporter:
             metrics_port=0,
             metrics_out=str(tmp / "final.prom"),
             slo={"*": 300.0},
+            # single lane: this test pins "one gated in-flight, one
+            # queued" (a pool would pop both; the multi-lane exporter
+            # series are covered in test_workers.py)
+            workers=1,
         )
         d._gate.clear()  # hold the worker so the scrape sees it in flight
         t = _start(d)

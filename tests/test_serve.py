@@ -181,6 +181,10 @@ class TestResidentState:
             journal_path=str(tmp / "serve.jsonl"),
             layout="bucketized",
             force_device=True,
+            # one lane: both jobs must hit the SAME resident backend for
+            # the job-2 zero-new-shape-classes assertion to hold (a pool
+            # would route job 2 to a second backend's fresh seen-set)
+            workers=1,
         )
         t = _start(d)
         try:
@@ -259,6 +263,10 @@ class TestAdmission:
             str(tmp / "s.sock"), max_queue=1,
             compile_cache=str(tmp / "cache"),
             journal_path=str(tmp / "serve.jsonl"),
+            # single lane: the test fills the one queue slot behind a
+            # held worker — a pool would pop the queued job into a
+            # second gated lane and the queue would never reach capacity
+            workers=1,
         )
         d._gate.clear()  # hold the worker so submissions stay queued
         t = _start(d)
@@ -368,6 +376,10 @@ class TestDrain:
             str(tmp / "s.sock"),
             compile_cache=str(tmp / "cache"),
             journal_path=str(tmp / "serve.jsonl"),
+            # single lane: "one in-flight, one queued" is the state the
+            # drain contract is asserted against (the multi-worker drain
+            # matrix lives in test_workers.py)
+            workers=1,
         )
         d._gate.clear()
         t = _start(d)

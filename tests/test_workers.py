@@ -1,0 +1,674 @@
+"""Worker-pool serving (``specpride serve --workers N --quota ...``):
+weighted-fair deficit scheduling, per-tenant inflight quotas (retriable
+rejections, exit 75), the output-path conflict guard, device-aware
+placement, 2-worker concurrent byte+QC parity vs one-shot CLI runs, and
+per-worker journal/exporter attribution."""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.io.mgf import write_mgf
+from specpride_tpu.observability.journal import read_events
+from specpride_tpu.serve import client as sc
+from specpride_tpu.serve import placement
+from specpride_tpu.serve.daemon import ServeDaemon
+from specpride_tpu.serve.scheduler import (
+    AdmissionQueue,
+    Quota,
+    QuotaExceeded,
+    parse_quota_spec,
+)
+
+from conftest import make_cluster
+
+METHODS = [
+    ("bin-mean", "consensus"),
+    ("gap-average", "consensus"),
+    ("medoid", "select"),
+]
+
+
+def _start(daemon: ServeDaemon) -> threading.Thread:
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    assert sc.wait_for_socket(daemon.socket_path, timeout=120), \
+        "daemon never answered ping"
+    return t
+
+
+def _stop(daemon: ServeDaemon, thread: threading.Thread) -> None:
+    daemon.drain()
+    thread.join(timeout=60)
+    assert not thread.is_alive(), "daemon thread did not exit after drain"
+
+
+@pytest.fixture(scope="module")
+def workload(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("workers_wl")
+    rng = np.random.default_rng(41)
+    clusters = [
+        make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=25)
+        for i in range(8)
+    ]
+    src = tmp / "clustered.mgf"
+    write_mgf([s for c in clusters for s in c.members], src)
+    return str(src)
+
+
+class TestQuotaSpec:
+    def test_parse(self):
+        q = parse_quota_spec("teamA=3:2, teamB=1 ,*=1:1")
+        assert q["teamA"] == Quota(3.0, 2)
+        assert q["teamB"] == Quota(1.0, None)
+        assert q["*"] == Quota(1.0, 1)
+        assert parse_quota_spec(None) == {}
+        assert parse_quota_spec("") == {}
+
+    @pytest.mark.parametrize("bad", [
+        "teamA",            # no '='
+        "=2",               # no client
+        "teamA=x",          # weight not a number
+        "teamA=0",          # weight must be > 0
+        "teamA=-1",         # weight must be > 0
+        "teamA=1:0",        # max_inflight must be >= 1
+        "teamA=1:x",        # max_inflight not an integer
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_quota_spec(bad)
+
+
+class TestWeightedFair:
+    def test_deficit_ordering_respects_weights(self):
+        """Weight 2 vs weight 1 under continuous backlog: the deficit
+        counters serve A twice per B's once, FIFO within each client."""
+        q = AdmissionQueue(
+            64, quotas={"A": Quota(2.0), "B": Quota(1.0)},
+        )
+        for j in range(1, 7):
+            assert q.offer("A", f"a{j}")
+        for j in range(1, 4):
+            assert q.offer("B", f"b{j}")
+        order = [q.pop(timeout=0.1) for _ in range(9)]
+        assert order == [
+            "a1", "b1", "a2", "a3", "b2", "a4", "a5", "b3", "a6",
+        ]
+
+    def test_default_weights_degenerate_to_round_robin(self):
+        q = AdmissionQueue(16)
+        for client, job in [
+            ("A", "a1"), ("A", "a2"), ("B", "b1"), ("C", "c1"),
+        ]:
+            assert q.offer(client, job)
+        assert [q.pop(timeout=0.1) for _ in range(4)] == [
+            "a1", "b1", "c1", "a2",
+        ]
+
+    def test_idle_client_banks_no_credit(self):
+        """A client that sat out rounds re-enters at the virtual-time
+        frontier — it does NOT get a catch-up burst."""
+        q = AdmissionQueue(16, quotas={"A": Quota(1.0), "B": Quota(1.0)})
+        for j in range(1, 4):
+            q.offer("A", f"a{j}")
+        assert [q.pop(timeout=0.1) for _ in range(3)] == ["a1", "a2", "a3"]
+        # B shows up late: it starts at the frontier, so the backlogged
+        # A and fresh B alternate instead of B draining first
+        for j in range(1, 3):
+            q.offer("B", f"b{j}")
+        q.offer("A", "a4")
+        q.offer("A", "a5")
+        order = [q.pop(timeout=0.1) for _ in range(4)]
+        assert order[:2] in (["b1", "a4"], ["a4", "b1"])
+        assert set(order) == {"b1", "b2", "a4", "a5"}
+
+    def test_max_inflight_caps_admission(self):
+        q = AdmissionQueue(16, quotas={"A": Quota(1.0, max_inflight=2)})
+        assert q.offer("A", "a1")
+        assert q.offer("A", "a2")
+        with pytest.raises(QuotaExceeded) as ei:
+            q.offer("A", "a3")
+        assert ei.value.client == "A" and ei.value.max_inflight == 2
+        # popping does not free quota (the job is now EXECUTING) ...
+        popped = q.pop(timeout=0.1)
+        assert popped == "a1"
+        with pytest.raises(QuotaExceeded):
+            q.offer("A", "a3")
+        # ... release does
+        q.release(popped)
+        assert q.offer("A", "a3")
+        # unquota'd clients are never capped
+        for j in range(5):
+            assert q.offer("B", f"b{j}")
+
+    def test_max_inflight_enforced_at_pop(self):
+        """Even with a job queued (white-box: bypassing the admission
+        cap), a client at its inflight cap is skipped by pop until a
+        lane releases."""
+        q = AdmissionQueue(16, quotas={"A": Quota(1.0, max_inflight=1)})
+        assert q.offer("A", "a1")
+        popped = q.pop(timeout=0.1)
+        assert popped == "a1"  # A now at cap
+        with q._cond:  # inject past the admission check
+            q._states["A"].queue.append("a2")
+            q._total += 1
+        assert q.pop(timeout=0.2) is None, "capped client must not pop"
+        q.release(popped)
+        assert q.pop(timeout=1.0) == "a2"
+
+    def test_conflict_guard_serializes_same_output(self):
+        key = lambda job: job["paths"]  # noqa: E731
+        q = AdmissionQueue(16, conflict_key=key)
+        j1 = {"id": 1, "paths": ("/out/x.mgf",)}
+        j2 = {"id": 2, "paths": ("/out/x.mgf",)}
+        j3 = {"id": 3, "paths": ("/out/y.mgf",)}
+        q.offer("A", j1)
+        q.offer("B", j2)
+        q.offer("C", j3)
+        assert q.pop(timeout=0.1) is j1
+        # B's head conflicts with the in-flight j1: C flows past it
+        assert q.pop(timeout=0.1) is j3
+        assert q.pop(timeout=0.2) is None, "conflicting job must wait"
+        q.release(j1)
+        assert q.pop(timeout=1.0) is j2
+
+    def test_drain_ignores_caps_and_conflicts(self):
+        q = AdmissionQueue(
+            16, quotas={"A": Quota(1.0, max_inflight=1)},
+            conflict_key=lambda j: ("same-path",),
+        )
+        q.offer("A", "a1")
+        assert q.pop(timeout=0.1) == "a1"  # A capped, path held
+        with q._cond:
+            q._states["A"].queue.append("a2")
+            q._total += 1
+        q.offer("B", "b1")
+        # drain returns BOTH the capped client's job and the conflicted
+        # one — rejection must not deadlock on execution-time limits
+        assert sorted(q.drain()) == ["a2", "b1"]
+        assert q.pop(timeout=0.05) is None
+
+
+class TestPlacement:
+    def test_default_workers_capped(self):
+        # conftest pins 8 virtual CPU devices; the default caps at 4
+        assert placement.default_workers() == 4
+
+    def test_cpu_hosts_share_platform(self):
+        slots = placement.plan_placement(3)
+        assert [s.worker for s in slots] == [0, 1, 2]
+        assert all(s.device is None for s in slots), \
+            "CPU-only hosts must not pin (device-keyed compile caches)"
+
+    def test_pin_cpu_round_robins_devices(self):
+        slots = placement.plan_placement(3, pin_cpu=True)
+        ids = [s.device_index for s in slots]
+        assert len(set(ids)) == 3 and all(s.device is not None
+                                          for s in slots)
+
+    def test_device_scope_nullcontext_when_unpinned(self):
+        with placement.device_scope(None):
+            pass  # must be a no-op, not a jax call
+
+
+@pytest.fixture(scope="module")
+def pool_daemon(tmp_path_factory):
+    """One long-lived 2-worker daemon shared by the parity and
+    attribution tests — the concurrent multi-lane reuse the pool exists
+    for."""
+    tmp = tmp_path_factory.mktemp("workers_daemon")
+    d = ServeDaemon(
+        str(tmp / "serve.sock"),
+        compile_cache=str(tmp / "cache"),
+        journal_path=str(tmp / "serve.jsonl"),
+        workers=2,
+    )
+    t = _start(d)
+    yield d
+    _stop(d, t)
+    events, violations = read_events(d.journal_path)
+    assert not violations, violations
+    names = [e["event"] for e in events]
+    assert names[0] == "run_start" and names[-1] == "run_end"
+
+
+class TestTwoWorkerParity:
+    def test_concurrent_matrix_byte_and_qc_parity(
+        self, tmp_path, workload, pool_daemon
+    ):
+        """All three methods submitted CONCURRENTLY to the 2-worker
+        daemon reproduce the one-shot CLI's exact bytes and QC report,
+        and every job journal carries the worker lane that ran it."""
+        golden = {}
+        for method, command in METHODS:
+            out = tmp_path / f"cli_{method}.mgf"
+            qc = tmp_path / f"cli_{method}.qc.json"
+            assert cli_main([
+                command, workload, str(out), "--method", method,
+                "--qc-report", str(qc),
+            ]) == 0
+            golden[method] = (out.read_bytes(), qc.read_text())
+
+        results = {}
+
+        def _client(method, command):
+            out = tmp_path / f"served_{method}.mgf"
+            qc = tmp_path / f"served_{method}.qc.json"
+            jp = tmp_path / f"job_{method}.jsonl"
+            results[method] = (
+                sc.submit_wait(
+                    pool_daemon.socket_path,
+                    [command, workload, str(out), "--method", method,
+                     "--qc-report", str(qc), "--journal", str(jp)],
+                    client=f"tenant-{method}",
+                ),
+                out, qc, jp,
+            )
+
+        threads = [
+            threading.Thread(target=_client, args=mc) for mc in METHODS
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+            assert not t.is_alive()
+        for method, (term, out, qc, jp) in results.items():
+            assert term["status"] == "done", (method, term)
+            assert term.get("worker") in (0, 1), term
+            assert out.read_bytes() == golden[method][0], method
+            assert (
+                json.loads(qc.read_text())
+                == json.loads(golden[method][1])
+            ), method
+            events, violations = read_events(str(jp))
+            assert not violations, violations
+            end = [e for e in events if e["event"] == "run_end"][-1]
+            assert end.get("worker") in (0, 1), \
+                "job run_end must name its worker lane"
+
+    def test_journal_attribution_and_stats_grouping(self, pool_daemon):
+        """The daemon journal's job_start/job_done carry the worker
+        lane, interleaved lines stay schema-valid, and the stats serving
+        view groups jobs per worker."""
+        events, violations = read_events(pool_daemon.journal_path)
+        assert not violations, violations
+        done = [e for e in events if e["event"] == "job_done"]
+        starts = [e for e in events if e["event"] == "job_start"]
+        assert done and starts
+        assert all(e.get("worker") in (0, 1) for e in done + starts)
+        serve_ev = next(e for e in events if e["event"] == "serve_start")
+        assert serve_ev["workers"] == 2
+        assert len(serve_ev["placement"]) == 2
+        from specpride_tpu.observability.stats_cli import run_stats
+
+        buf = io.StringIO()
+        assert run_stats([pool_daemon.journal_path], out=buf) == 0
+        text = buf.getvalue()
+        assert "workers=2" in text
+        assert "worker 0:" in text or "worker 1:" in text
+
+
+class TestConcurrentLanes:
+    def test_two_lanes_hold_jobs_concurrently_and_drain_commits_both(
+        self, tmp_path_factory, workload
+    ):
+        """Deterministic two-lane occupancy via the worker gate: two
+        jobs from distinct tenants are popped by BOTH workers, drain
+        commits BOTH in-flight jobs (byte-identical outputs), and the
+        journal shows each on its own lane."""
+        tmp = tmp_path_factory.mktemp("workers_lanes")
+        cli_out = tmp / "cli.mgf"
+        assert cli_main([
+            "consensus", workload, str(cli_out), "--method", "bin-mean",
+        ]) == 0
+        d = ServeDaemon(
+            str(tmp / "s.sock"),
+            compile_cache=str(tmp / "cache"),
+            journal_path=str(tmp / "serve.jsonl"),
+            workers=2,
+        )
+        d._gate.clear()
+        t = _start(d)
+        terms = {}
+
+        def _submit(tag):
+            terms[tag] = sc.submit_wait(d.socket_path, [
+                "consensus", workload, str(tmp / f"{tag}.mgf"),
+                "--method", "bin-mean",
+            ], client=tag)
+
+        threads = [
+            threading.Thread(target=_submit, args=(tag,))
+            for tag in ("tenant-a", "tenant-b")
+        ]
+        for th in threads:
+            th.start()
+        deadline = time.time() + 30
+        while len(d._inflight_by) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(d._inflight_by) == 2, \
+            "both worker lanes must hold an in-flight job"
+        assert d._inflight is not None  # the single-lane view still works
+        _stop(d, t)  # drain: opens the gate, joins BOTH workers
+        for th in threads:
+            th.join(timeout=120)
+        for tag in ("tenant-a", "tenant-b"):
+            assert terms[tag]["status"] == "done", terms[tag]
+            assert (tmp / f"{tag}.mgf").read_bytes() == \
+                cli_out.read_bytes()
+        done = [
+            e for e in read_events(d.journal_path)[0]
+            if e["event"] == "job_done"
+        ]
+        assert sorted(e["worker"] for e in done) == [0, 1]
+
+    def test_same_output_jobs_serialize(self, tmp_path_factory, workload):
+        """The conflict guard: two jobs targeting the SAME output never
+        run concurrently — the second waits for the first's lane."""
+        tmp = tmp_path_factory.mktemp("workers_conflict")
+        d = ServeDaemon(
+            str(tmp / "s.sock"),
+            compile_cache=str(tmp / "cache"),
+            journal_path=str(tmp / "serve.jsonl"),
+            workers=2,
+        )
+        d._gate.clear()
+        t = _start(d)
+        terms = {}
+        out = tmp / "shared.mgf"
+
+        def _submit(tag):
+            terms[tag] = sc.submit_wait(d.socket_path, [
+                "consensus", workload, str(out), "--method", "bin-mean",
+            ], client=tag)
+
+        threads = [
+            threading.Thread(target=_submit, args=(tag,))
+            for tag in ("first", "second")
+        ]
+        try:
+            for th in threads:
+                th.start()
+            deadline = time.time() + 30
+            while not d._inflight_by and time.time() < deadline:
+                time.sleep(0.01)
+            # give the scheduler every chance to (wrongly) pop job 2
+            time.sleep(0.3)
+            assert len(d._inflight_by) == 1, \
+                "same-output jobs must not occupy two lanes"
+            assert len(d.queue) == 1
+        finally:
+            d._gate.set()
+            for th in threads:
+                th.join(timeout=120)
+            _stop(d, t)
+        assert terms["first"]["status"] == "done"
+        assert terms["second"]["status"] == "done"
+
+
+class TestQuotaDaemon:
+    def test_quota_rejection_retriable_exit75(
+        self, tmp_path_factory, workload
+    ):
+        """A tenant at max_inflight=1 with a job on a lane gets its next
+        submit rejected RETRIABLE with the quota named — the exit-75
+        resubmit-later path — while other tenants keep flowing."""
+        tmp = tmp_path_factory.mktemp("workers_quota")
+        d = ServeDaemon(
+            str(tmp / "s.sock"),
+            compile_cache=str(tmp / "cache"),
+            journal_path=str(tmp / "serve.jsonl"),
+            workers=1,
+            quotas=parse_quota_spec("capped=2:1"),
+        )
+        d._gate.clear()  # hold the lane so the first job stays in flight
+        t = _start(d)
+        terms = {}
+
+        def _submit(tag, client):
+            terms[tag] = sc.submit_wait(d.socket_path, [
+                "consensus", workload, str(tmp / f"{tag}.mgf"),
+                "--method", "bin-mean",
+            ], client=client)
+
+        try:
+            t1 = threading.Thread(
+                target=_submit, args=("first", "capped")
+            )
+            t1.start()
+            deadline = time.time() + 30
+            while d._inflight is None and time.time() < deadline:
+                time.sleep(0.01)
+            assert d._inflight is not None
+            # same tenant, lane occupied, cap 1: named retriable bounce
+            _submit("bounced", "capped")
+            term = terms["bounced"]
+            assert term["status"] == "rejected", term
+            assert term["retriable"] is True
+            assert "quota" in term["reason"] and "capped" in term["reason"]
+            assert sc.exit_code(term) == 75
+            # an uncapped tenant still gets in
+            t2 = threading.Thread(
+                target=_submit, args=("other", "free")
+            )
+            t2.start()
+            while len(d.queue) < 1 and time.time() < deadline:
+                time.sleep(0.01)
+            assert len(d.queue) == 1
+        finally:
+            d._gate.set()
+            t1.join(timeout=120)
+            t2.join(timeout=120)
+            _stop(d, t)
+        assert terms["first"]["status"] == "done"
+        assert terms["other"]["status"] == "done"
+        # the journal named the quota on the rejection
+        events, _ = read_events(d.journal_path)
+        rej = [e for e in events if e["event"] == "job_rejected"]
+        assert rej and "quota" in rej[0]["reason"]
+
+
+class TestIngestCache:
+    def test_unit_hit_miss_invalidate_evict(self, tmp_path):
+        from specpride_tpu.serve import ingest_cache as ic
+
+        ic.clear()
+        p = tmp_path / "a.mgf"
+        p.write_text("BEGIN IONS\nEND IONS\n")
+        assert ic.get(str(p)) is None  # miss
+        ic.put(str(p), ["clusters"], n_spectra=3, n_peaks=9)
+        assert ic.get(str(p)) == (["clusters"], 3, 9)
+        # rewriting the file invalidates (size/mtime key)
+        time.sleep(0.01)
+        p.write_text("BEGIN IONS\nPEPMASS=1\nEND IONS\n")
+        assert ic.get(str(p)) is None
+        # bounded: old entries evict
+        for i in range(10):
+            q = tmp_path / f"b{i}.mgf"
+            q.write_text("x")
+            ic.put(str(q), [i], n_spectra=1, n_peaks=1)
+        assert ic.info()["size"] <= 4
+        ic.clear()
+
+    def test_served_repeat_job_hits_and_modified_input_misses(
+        self, tmp_path, pool_daemon
+    ):
+        """Repeat served jobs skip the parse (run_end counters prove
+        it) and still produce CLI-identical bytes; a MODIFIED input
+        re-parses and serves the new content."""
+        rng = np.random.default_rng(77)
+        src = tmp_path / "in.mgf"
+        write_mgf(
+            [s for c in (
+                make_cluster(rng, f"x-{i}", n_members=3, n_peaks=20)
+                for i in range(6)
+            ) for s in c.members],
+            src,
+        )
+        cli_out = tmp_path / "cli.mgf"
+        assert cli_main([
+            "consensus", str(src), str(cli_out), "--method", "bin-mean",
+        ]) == 0
+
+        def served(tag):
+            out = tmp_path / f"{tag}.mgf"
+            jp = tmp_path / f"{tag}.jsonl"
+            term = sc.submit_wait(pool_daemon.socket_path, [
+                "consensus", str(src), str(out), "--method", "bin-mean",
+                "--journal", str(jp),
+            ])
+            assert term["status"] == "done", term
+            events, violations = read_events(str(jp))
+            assert not violations, violations
+            end = [e for e in events if e["event"] == "run_end"][-1]
+            return out, end["counters"]
+
+        out1, c1 = served("first")
+        out2, c2 = served("second")
+        assert c1.get("ingest_cache_hits", 0) == 0
+        assert c1.get("ingest_cache_misses", 0) == 1
+        assert c2.get("ingest_cache_hits", 0) == 1, c2
+        assert out1.read_bytes() == cli_out.read_bytes()
+        assert out2.read_bytes() == cli_out.read_bytes()
+        # rewrite the input: the cache must miss and the job must serve
+        # the NEW content
+        time.sleep(0.01)
+        write_mgf(
+            [s for c in (
+                make_cluster(rng, f"y-{i}", n_members=3, n_peaks=20)
+                for i in range(4)
+            ) for s in c.members],
+            src,
+        )
+        cli_out2 = tmp_path / "cli2.mgf"
+        assert cli_main([
+            "consensus", str(src), str(cli_out2), "--method", "bin-mean",
+        ]) == 0
+        out3, c3 = served("third")
+        assert c3.get("ingest_cache_hits", 0) == 0
+        assert out3.read_bytes() == cli_out2.read_bytes()
+        assert out3.read_bytes() != cli_out.read_bytes()
+
+    def test_one_shot_cli_never_caches(self, tmp_path):
+        from specpride_tpu.serve import ingest_cache as ic
+
+        ic.clear()
+        rng = np.random.default_rng(5)
+        src = tmp_path / "cli_in.mgf"
+        write_mgf(
+            [s for c in (
+                make_cluster(rng, f"z-{i}", n_members=2, n_peaks=10)
+                for i in range(3)
+            ) for s in c.members],
+            src,
+        )
+        assert cli_main([
+            "consensus", str(src), str(tmp_path / "o.mgf"),
+            "--method", "bin-mean",
+        ]) == 0
+        assert ic.info()["size"] == 0, \
+            "one-shot runs must not populate the serving ingest cache"
+
+
+class TestWorkerTelemetry:
+    def test_worker_registries_render_labeled_and_valid(self):
+        from specpride_tpu.observability.exporter import (
+            ServeTelemetry,
+            validate_exposition,
+        )
+        from specpride_tpu.observability.registry import MetricsRegistry
+
+        regs = {}
+        for wid in ("0", "1"):
+            r = MetricsRegistry()
+            r.counter(
+                "specpride_dispatches_total", "device kernel dispatches",
+                labels=("kernel",),
+            ).inc(3 + int(wid), kernel="bin_mean")
+            r.histogram(
+                "specpride_dispatch_seconds", "dispatch wall",
+                labels=("kernel",),
+            ).observe(0.01, kernel="bin_mean")
+            regs[wid] = r
+        t = ServeTelemetry(worker_registries=regs)
+        t.workers.set(2)
+        for wid in ("0", "1"):
+            t.inflight_worker.set(0, worker=wid)
+        t.job_done(
+            command="consensus", method="bin-mean", status="done",
+            wall_s=1.5, queue_wait_s=0.1, worker=1,
+        )
+        text = t.exposition()
+        problems = validate_exposition(text)
+        assert not problems, problems
+        # one TYPE per metric even though both registries carry it
+        assert text.count("# TYPE specpride_dispatches_total") == 1
+        assert 'specpride_dispatches_total{worker="0",kernel="bin_mean"} 3' \
+            in text
+        assert 'specpride_dispatches_total{worker="1",kernel="bin_mean"} 4' \
+            in text
+        assert "specpride_serve_workers 2" in text
+        assert 'specpride_serve_inflight_worker{worker="0"} 0' in text
+        assert (
+            'specpride_serve_worker_busy_seconds_total{worker="1"} 1.5'
+            in text
+        )
+
+    def test_render_labeled_rejects_schema_drift(self):
+        from specpride_tpu.observability.registry import (
+            MetricsRegistry,
+            render_labeled,
+        )
+
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("m_total", "x").inc(1)
+        b.gauge("m_total", "x").set(1)
+        with pytest.raises(ValueError):
+            render_labeled({"0": a, "1": b})
+
+    def test_live_scrape_carries_worker_series(
+        self, tmp_path, workload, pool_daemon
+    ):
+        """The 2-worker daemon's own telemetry plane: after served jobs,
+        the exposition validates strictly and carries the pool series."""
+        from specpride_tpu.observability.exporter import (
+            parse_exposition,
+        )
+
+        # --qc-report forces a real device dispatch (the cosine kernel)
+        # even on CPU hosts where the bin-mean consensus itself computes
+        # host-side — so the worker's backend registry has series
+        term = sc.submit_wait(pool_daemon.socket_path, [
+            "consensus", workload, str(tmp_path / "scrape.mgf"),
+            "--method", "bin-mean",
+            "--qc-report", str(tmp_path / "scrape.qc.json"),
+        ])
+        assert term["status"] == "done"
+        text = pool_daemon.telemetry.exposition()
+        samples, problems = parse_exposition(text)
+        assert not problems, problems
+        names = {name for name, _ in samples}
+        assert "specpride_serve_workers" in names
+        assert "specpride_serve_inflight_worker" in names
+        assert "specpride_serve_worker_busy_seconds_total" in names
+        # both lanes' inflight gauges are present (0 when idle)
+        workers = {
+            dict(labels).get("worker")
+            for name, labels in samples
+            if name == "specpride_serve_inflight_worker"
+        }
+        assert workers == {"0", "1"}
+        # the resident backend registries ride along worker-labeled
+        backend_workers = {
+            dict(labels).get("worker")
+            for name, labels in samples
+            if name == "specpride_dispatches_total"
+        }
+        assert backend_workers <= {"0", "1"} and backend_workers
