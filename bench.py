@@ -485,6 +485,211 @@ def bench_elastic(clusters, workdir: str, repeats: int = 3) -> dict:
     return out
 
 
+def bench_elastic_steal(clusters, workdir: str) -> dict:
+    """Elastic tier 2: (a) live work-stealing on a SKEWED fleet — one
+    rank ``rank_slow``-handicapped per chunk — makespan with
+    ``--elastic-steal on`` vs ``off`` (acceptance: stealing recovers
+    >= 1.3x), with steal counts from the journals; (b) coordinator
+    backend overhead on a HEALTHY 2-rank fleet — filesystem vs the
+    in-tree CAS object store, identical flags, min-of-repeats
+    (acceptance: within host noise).  Byte parity against the serial
+    golden in every cell."""
+    import os
+    import shutil
+    import subprocess
+    import sys as _sys
+
+    from specpride_tpu.io.mgf import write_mgf
+    from specpride_tpu.parallel.store import CasServer
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+
+    # the skewed cells use a compute-light subset so the injected
+    # per-chunk stall (the slow HARDWARE being modeled) dominates the
+    # wall — the quantity stealing can actually recover
+    skew_clusters = clusters[:768]
+    src = os.path.join(workdir, "steal_clustered.mgf")
+    write_mgf([s for c in skew_clusters for s in c.members], src)
+    golden = os.path.join(workdir, "steal_serial.mgf")
+    subprocess.run(
+        [_sys.executable, "-m", "specpride_tpu", "consensus", src, golden,
+         "--method", "bin-mean"],
+        env=env, check=True, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    with open(golden, "rb") as fh:
+        golden_bytes = fh.read()
+
+    def skew_fleet(tag: str, steal: str, i: int) -> tuple[float, int, int]:
+        """One 2-rank skewed run: rank 0 stalls 0.75s per chunk.
+        Returns (makespan, n_splits, n_steals)."""
+        d = os.path.join(workdir, f"steal_{tag}_{i}")
+        shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d)
+        out = os.path.join(d, "out.mgf")
+        coord = os.path.join(d, "coord")
+
+        def argv(rank):
+            return [
+                _sys.executable, "-m", "specpride_tpu", "consensus",
+                src, out, "--method", "bin-mean",
+                "--elastic", coord, "--process-id", str(rank),
+                "--elastic-range", "384", "--checkpoint-every", "32",
+                "--elastic-ttl", "2", "--elastic-steal", steal,
+                "--journal", os.path.join(d, "j.jsonl"),
+            ]
+
+        slow_env = dict(
+            env, SPECPRIDE_FAULTS="dispatch:rank_slow:1:0:9999",
+            SPECPRIDE_SLOW_S="1.0",
+        )
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen(argv(0), env=slow_env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.PIPE),
+            subprocess.Popen(argv(1), env=env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.PIPE),
+        ]
+        for p in procs:
+            _, err = p.communicate(timeout=600)
+            assert p.returncode == 0, err.decode()[-2000:]
+        wall = time.perf_counter() - t0
+        subprocess.run(
+            [_sys.executable, "-m", "specpride_tpu", "merge-parts", out,
+             "--elastic", coord],
+            env=env, check=True, stdout=subprocess.DEVNULL,
+        )
+        with open(out, "rb") as fh:
+            assert fh.read() == golden_bytes, f"{tag} diverged from serial"
+        splits = steals = 0
+        import glob as _glob
+
+        for jp in _glob.glob(os.path.join(d, "j.jsonl.part*")):
+            with open(jp) as fh:
+                for line in fh:
+                    e = json.loads(line)
+                    if e.get("event") == "lease_split":
+                        splits += 1
+                    elif e.get("event") == "chunk_reassign" and (
+                        e.get("via") == "lease_split"
+                    ):
+                        steals += 1
+        return wall, splits, steals
+
+    # unmeasured warmup pair (page cache, compile cache fill)
+    skew_fleet("warm", "on", 0)
+    skew: dict[str, list] = {"on": [], "off": []}
+    counts = {"on": [0, 0], "off": [0, 0]}
+    repeats = 2
+    for i in range(1, repeats + 1):
+        for steal in ("on", "off"):
+            wall, splits, steals = skew_fleet(steal, steal, i)
+            skew[steal].append(wall)
+            counts[steal][0] += splits
+            counts[steal][1] += steals
+    assert counts["off"] == [0, 0], "steal off but splits journaled"
+    on, off = min(skew["on"]), min(skew["off"])
+
+    # healthy 2-rank coordinator-backend overhead: fs vs object store
+    healthy_src = _sweep_source(clusters, workdir)
+
+    def healthy_fleet(tag: str, spec: str, out: str) -> float:
+        def argv(rank):
+            return [
+                _sys.executable, "-m", "specpride_tpu", "consensus",
+                healthy_src, out, "--method", "bin-mean",
+                "--elastic", spec, "--process-id", str(rank),
+                "--elastic-range", "512", "--checkpoint-every", "256",
+                "--elastic-local", f"{out}.elastic",
+            ]
+
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen(argv(r), env=env, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.PIPE)
+            for r in range(2)
+        ]
+        for p in procs:
+            _, err = p.communicate(timeout=600)
+            assert p.returncode == 0, err.decode()[-2000:]
+        wall = time.perf_counter() - t0
+        subprocess.run(
+            [_sys.executable, "-m", "specpride_tpu", "merge-parts", out,
+             "--elastic", spec],
+            env=env, check=True, stdout=subprocess.DEVNULL,
+        )
+        return wall
+
+    walls: dict[str, list[float]] = {"fs": [], "objstore": []}
+    outs: dict[str, str] = {}
+    for i in range(repeats + 1):  # i == 0 is the unmeasured warmup
+        for mode in ("fs", "objstore"):
+            d = os.path.join(workdir, f"ov_{mode}_{i}")
+            shutil.rmtree(d, ignore_errors=True)
+            os.makedirs(d)
+            out = os.path.join(d, "out.mgf")
+            if mode == "fs":
+                wall = healthy_fleet(mode, os.path.join(d, "coord"), out)
+            else:
+                server = CasServer().start()
+                try:
+                    wall = healthy_fleet(mode, server.url, out)
+                finally:
+                    server.stop()
+            if i > 0:
+                walls[mode].append(wall)
+                outs[mode] = out
+    with open(outs["fs"], "rb") as fh:
+        fs_bytes = fh.read()
+    with open(outs["objstore"], "rb") as fh:
+        assert fh.read() == fs_bytes, "object-store merge diverged"
+    fs_wall = min(walls["fs"])
+    os_wall = min(walls["objstore"])
+
+    out = {
+        "skewed": {
+            "ranks": 2,
+            "n_clusters": len(skew_clusters),
+            "slow_s_per_chunk": 1.0,
+            "repeats": repeats,
+            "steal_on_wall_s": round(on, 3),
+            "steal_off_wall_s": round(off, 3),
+            "makespan_recovery": round(off / on, 3) if on > 0 else None,
+            "splits": counts["on"][0],
+            "steals": counts["on"][1],
+            "steal_on_wall_all_s": [round(w, 3) for w in skew["on"]],
+            "steal_off_wall_all_s": [round(w, 3) for w in skew["off"]],
+            "byte_identical": True,
+        },
+        "backend_overhead": {
+            "ranks": 2,
+            "n_clusters": len(clusters),
+            "repeats": repeats,
+            "fs_wall_s": round(fs_wall, 3),
+            "objstore_wall_s": round(os_wall, 3),
+            "overhead_frac": (
+                round(os_wall / fs_wall - 1.0, 4) if fs_wall > 0 else None
+            ),
+            "fs_wall_all_s": [round(w, 3) for w in walls["fs"]],
+            "objstore_wall_all_s": [round(w, 3) for w in walls["objstore"]],
+            "byte_identical": True,
+        },
+    }
+    eprint(
+        f"[elastic_steal] skewed makespan on {on:.2f}s / off {off:.2f}s "
+        f"-> {out['skewed']['makespan_recovery']}x recovery "
+        f"({counts['on'][0]} splits); healthy fs {fs_wall:.2f}s vs "
+        f"objstore {os_wall:.2f}s "
+        f"({out['backend_overhead']['overhead_frac']:+.2%})"
+    )
+    return out
+
+
 def bench_prefetch_sweep(
     clusters, workdir: str, prefetches=(0, 1, 2, 4)
 ) -> list[dict]:
@@ -1474,7 +1679,7 @@ def main() -> None:
         help="with --report: comma list of report sections to run "
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
         "prefetch_sweep,worker_sweep,fault_overhead,warm_start,serving,"
-        "serving_concurrency,telemetry,elastic,pallas",
+        "serving_concurrency,telemetry,elastic,elastic_steal,pallas",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -1499,7 +1704,7 @@ def main() -> None:
     all_sections = (
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
         "worker_sweep,fault_overhead,warm_start,serving,"
-        "serving_concurrency,telemetry,elastic,pallas"
+        "serving_concurrency,telemetry,elastic,elastic_steal,pallas"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -1651,6 +1856,10 @@ def main() -> None:
                     )
                 if "elastic" in secs:
                     report["elastic"] = bench_elastic(clusters, workdir)
+                if "elastic_steal" in secs:
+                    report["elastic_steal"] = bench_elastic_steal(
+                        clusters, workdir
+                    )
             if "pallas" in secs:
                 ab = pallas_ab(clusters, report_path=args.report)
                 if ab is not None:

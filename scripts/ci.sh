@@ -195,7 +195,12 @@ cmp "$rb_tmp/qc_clean.mgf" "$rb_tmp/qc_chaos.mgf"
 cmp "$rb_tmp/qc_clean.json" "$rb_tmp/qc_chaos.json"
 python - "$rb_tmp"/chaos_*.jsonl <<'EOF'
 import json, sys
-from specpride_tpu.robustness.faults import FAULT_SITES, audit_fault_recovery
+# the executor's lane sites only: `cas` fires exclusively in elastic
+# runs and is exercised (and audited) by the preemption-storm pass
+from specpride_tpu.robustness.faults import (
+    EXECUTOR_FAULT_SITES as FAULT_SITES,
+    audit_fault_recovery,
+)
 fired = set()
 for path in sys.argv[1:]:
     events = [json.loads(l) for l in open(path)]
@@ -294,6 +299,110 @@ env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
 test "$MP_RC" -ne 0
 grep -q "missing \[0\]" "$el_tmp/mp.err"
 rm -rf "$el_tmp"
+
+echo "== robustness: elastic tier-2 preemption storm (both coordinator backends) =="
+# the tier-2 acceptance bar, on the filesystem AND object-store
+# coordinator backends: 2 ranks + 1 fleet-managed warm spare, one rank
+# SIGKILLed mid-run (rank_kill), one rank handicapped per chunk
+# (rank_slow) with an injected CAS conflict on its first claim.  The
+# fleet must spawn the spare (journaled rank_spawn), the dead rank's
+# range must be reassigned (lease_expire + chunk_reassign), the slow
+# rank must be relieved by a live steal (lease_split + chunk_reassign
+# via=lease_split), every fault must audit as recovered, and the
+# merged output + QC report must be byte-identical to the single-host
+# serial golden.
+st_tmp=$(mktemp -d)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=".:tests" python - "$st_tmp" <<'EOF'
+import sys
+import numpy as np
+from conftest import make_cluster
+from specpride_tpu.io.mgf import write_mgf
+rng = np.random.default_rng(99)
+cl = [make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=20)
+      for i in range(48)]
+write_mgf([s for c in cl for s in c.members], sys.argv[1] + "/in.mgf")
+EOF
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$st_tmp/in.mgf" "$st_tmp/serial.mgf" \
+    --method bin-mean --backend tpu --qc-report "$st_tmp/serial_qc.json"
+st_storm() { # $1 = tag; $2 = coordinator spec (dir or URL)
+    tag="$1"; spec="$2"; d="$st_tmp/$tag"; mkdir -p "$d"
+    st_rank() { # $1 = rank id; rest = env KEY=VAL words
+        _r="$1"; shift
+        env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" "$@" \
+            python -m specpride_tpu \
+            consensus "$st_tmp/in.mgf" "$d/out.mgf" \
+            --method bin-mean --backend tpu \
+            --elastic "$spec" --process-id "$_r" \
+            --elastic-range 24 --checkpoint-every 2 --elastic-ttl 1 \
+            --elastic-local "$d/local" \
+            --qc-report "$d/qc.json" --journal "$d/j.jsonl"
+    }
+    # rank 1: SIGKILLed at write visit 3; rank 0: 0.5s stall per chunk
+    # dispatch plus one injected CAS conflict on its first lease claim
+    st_rank 1 SPECPRIDE_FAULTS="write:rank_kill:1:3" & ST_V=$!
+    st_rank 0 \
+        SPECPRIDE_FAULTS="dispatch:rank_slow:1:0:9999,cas:cas_conflict:1:0" \
+        SPECPRIDE_SLOW_S=0.5 & ST_S=$!
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        fleet --ranks 0 --spares 1 --timeout 240 \
+        --journal "$d/fleet.jsonl" -- \
+        consensus "$st_tmp/in.mgf" "$d/out.mgf" \
+        --method bin-mean --backend tpu \
+        --elastic "$spec" \
+        --elastic-range 24 --checkpoint-every 2 --elastic-ttl 1 \
+        --elastic-local "$d/local" \
+        --qc-report "$d/qc.json" --journal "$d/j.jsonl" & ST_F=$!
+    ST_RC=0; wait $ST_V || ST_RC=$?
+    test "$ST_RC" -ne 0  # SIGKILL: the victim must NOT exit cleanly
+    wait $ST_S           # the slow rank survives and exits 0
+    wait $ST_F           # the fleet exits 0 once every range commits
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        merge-parts "$d/out.mgf" --elastic "$spec" \
+        --qc-report "$d/qc.json"
+    cmp "$st_tmp/serial.mgf" "$d/out.mgf"
+    cmp "$st_tmp/serial_qc.json" "$d/qc.json"
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$d" <<'EOF'
+import glob, json, sys
+from specpride_tpu.parallel.elastic import audit_elastic
+from specpride_tpu.robustness.faults import audit_fault_recovery
+d = sys.argv[1]
+ev = []
+for p in sorted(glob.glob(d + "/j.jsonl.part*")):
+    ev += [json.loads(line) for line in open(p)]
+fleet = [json.loads(line) for line in open(d + "/fleet.jsonl")]
+kinds = {e["kind"] for e in ev if e["event"] == "fault"}
+assert {"rank_kill", "rank_slow", "cas_conflict"} <= kinds, kinds
+expires = [e for e in ev if e["event"] == "lease_expire"]
+splits = [e for e in ev if e["event"] == "lease_split"]
+steals = [e for e in ev if e["event"] == "chunk_reassign"
+          and e.get("via") == "lease_split"]
+spawns = [e for e in fleet if e["event"] == "rank_spawn"]
+assert expires, "the SIGKILLed rank's lease never expired"
+assert splits and steals, "the slow rank was never relieved by a steal"
+assert spawns, "the fleet never warmed its spare"
+assert not audit_elastic(ev), "unpaired lease expiries/splits"
+assert not audit_fault_recovery(ev), "unrecovered faults"
+cas_retries = [e for e in ev if e["event"] == "retry"
+               and e.get("site") == "cas"]
+assert cas_retries, "the injected CAS conflict left no retry evidence"
+print("storm OK: kill reassigned, slow rank split-stolen "
+      f"({len(splits)} split(s)), spare spawned, all faults recovered")
+EOF
+    # the stats rank view renders splits + the pairing audit at exit 0
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        stats "$d/j.jsonl" | grep -q "split(s)"
+}
+st_storm fs "$st_tmp/fs/coord"
+# object-store backend: the in-tree CAS server IS the coordinator — no
+# shared directory, conditional-put/ETag all the way down
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    cas-server --url-file "$st_tmp/cas.url" & ST_CAS=$!
+for _ in $(seq 50); do test -s "$st_tmp/cas.url" && break; sleep 0.1; done
+st_storm objstore "$(cat "$st_tmp/cas.url")"
+kill $ST_CAS 2>/dev/null || true
+wait $ST_CAS 2>/dev/null || true
+rm -rf "$st_tmp"
 
 echo "== warm start: compile-cache + AOT warmup + zero fresh compiles =="
 # each method runs twice against ONE fresh --compile-cache dir: the cold

@@ -207,17 +207,20 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
         "shape_manifest.json)",
     )
     p.add_argument(
-        "--elastic", metavar="DIR",
+        "--elastic", metavar="DIR|URL",
         help="elastic multi-host mode: instead of the static per-rank "
         "block partition, ranks dynamically claim chunk RANGES from a "
-        "work queue in this shared directory (leases + heartbeats; no "
-        "network dependency beyond the filesystem).  Each committed "
-        "range is one <output>.part<range> shard with a sha256 "
-        "manifest; a rank that dies mid-range has its uncommitted "
-        "chunks reassigned to a survivor, and the merged output stays "
-        "byte-identical to a single-host serial run (merge with "
-        "`specpride merge-parts OUTPUT --elastic DIR`).  Rank identity "
-        "comes from --process-id, else auto-assigned.  See "
+        "work queue in a shared directory — or, with an http(s):// "
+        "URL, a conditional-put/ETag object store (no shared "
+        "filesystem needed; `specpride cas-server` is the in-tree "
+        "test server).  Each committed range is one <output>."
+        "part<range> shard with a sha256 manifest; a rank that dies "
+        "mid-range has its uncommitted chunks reassigned to a "
+        "survivor, a rank that merely lags is relieved by live "
+        "work-stealing (see --elastic-steal), and the merged output "
+        "stays byte-identical to a single-host serial run (merge with "
+        "`specpride merge-parts OUTPUT --elastic DIR|URL`).  Rank "
+        "identity comes from --process-id, else auto-assigned.  See "
         "docs/robustness.md",
     )
     p.add_argument(
@@ -235,6 +238,22 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--elastic-heartbeat", type=float, default=0.0, metavar="S",
         help="heartbeat/lease-renewal interval (default 0 = TTL/4)",
+    )
+    p.add_argument(
+        "--elastic-steal", choices=["on", "off"], default="on",
+        help="live work-stealing between LIVE ranks (default on): a "
+        "rank with nothing claimable proposes a split of the "
+        "most-loaded live peer's range; the donor ratifies at its next "
+        "chunk boundary (journaled as lease_split) and the tail runs "
+        "as a new overlay range — merged output stays byte-identical. "
+        "'off' restores tier-1 behavior (only DEAD ranks lose work)",
+    )
+    p.add_argument(
+        "--elastic-local", metavar="DIR",
+        help="(object-store coordinator) local directory for the "
+        "per-range resume manifests (default: <output>.elastic).  "
+        "Share it between ranks on one host so takeovers resume a "
+        "dead rank's committed prefix instead of recomputing",
     )
 
 
@@ -952,15 +971,20 @@ class _CommitItem:
     write lane: everything the commit protocol needs, snapshotted on the
     dispatch lane so commits are byte-identical to serial runs."""
 
-    __slots__ = ("index", "reps", "part_ids", "qc_rows", "failed", "chunk_t0")
+    __slots__ = ("index", "reps", "part_ids", "qc_rows", "failed",
+                 "chunk_t0", "max_idx")
 
-    def __init__(self, index, reps, part_ids, qc_rows, failed, chunk_t0):
+    def __init__(self, index, reps, part_ids, qc_rows, failed, chunk_t0,
+                 max_idx=None):
         self.index = index
         self.reps = reps
         self.part_ids = part_ids
         self.qc_rows = qc_rows  # finalized QC rows for this chunk (or None)
         self.failed = failed  # sorted failure snapshot at submit time
         self.chunk_t0 = chunk_t0
+        # highest LOCAL cluster index in this chunk — what the elastic
+        # commit fence compares against a ratified split cut
+        self.max_idx = max_idx
 
 
 def _commit_chunk(item: _CommitItem, args, journal, stats: RunStats,
@@ -986,11 +1010,15 @@ def _commit_chunk(item: _CommitItem, args, journal, stats: RunStats,
 
     fence = getattr(args, "_elastic_fence", None)
     if fence is not None:
-        # elastic mode: prove this rank STILL holds the range's lease
-        # before any bytes land.  A rank that stalled past its TTL gets
-        # LeaseExpiredError (permanent — no retry) and abandons the
-        # range instead of racing the rank that took it over.
-        fence()
+        # elastic mode: prove this rank STILL holds the range's lease —
+        # and, tier 2, that this chunk sits below any ratified split
+        # cut — before any bytes land.  A rank that stalled past its
+        # TTL (or a zombie donor dispatching past its cut) gets
+        # LeaseExpiredError (permanent — no retry) and abandons instead
+        # of racing the rank that took the work over.  The fence also
+        # folds this chunk's wall into the progress mirror peers use to
+        # pick steal targets.
+        fence(item)
     if item.qc_rows:
         qc.extend(item.qc_rows)
     pre_bytes = (
@@ -1557,8 +1585,25 @@ def _checkpointed_run_impl(
     idle_s = 0.0
     loop_t0 = _time.perf_counter()
 
+    clip_fn = getattr(args, "_elastic_clip", None)
     try:
         for item in items:
+            if clip_fn is not None and item.idxs:
+                # elastic tier 2: before dispatching this chunk, let the
+                # coordinator ratify a pending steal proposal at THIS
+                # boundary (everything already submitted commits below
+                # it) or report an existing cut.  Chunks at/past the cut
+                # belong to the stealing rank now — stop dispatching.
+                clip = clip_fn(item.idxs[0])
+                if clip is not None and item.idxs[0] >= clip:
+                    logger.info(
+                        "range split: stopping before local cluster %d "
+                        "(%d chunk(s) ceded to the stealing rank)",
+                        clip, len(worklist) - item.index,
+                    )
+                    if hasattr(items, "close"):
+                        items.close()  # shut the pack lanes promptly
+                    break
             chunk_index, part = item.index, item.part
             idle_s += item.wait_s
             if item.pack_stats is not None:
@@ -1688,6 +1733,7 @@ def _checkpointed_run_impl(
                 commit_item = _CommitItem(
                     chunk_index, reps, [c.cluster_id for c in part],
                     chunk_qc, sorted(failed) if failed else None, chunk_t0,
+                    max_idx=item.idxs[-1] if item.idxs else None,
                 )
                 if committer is not None:
                     # ordered write lane: the whole commit tail (QC finalize,
@@ -2331,7 +2377,13 @@ def _run_elastic_range(
     args_k.output, args_k.qc_report = _elastic_range_paths(args, k)
     args_k.checkpoint = coord.checkpoint_path(k)
     args_k.append = False
-    args_k._elastic_fence = lambda: coord.check_lease(k)
+    args_k._elastic_fence = lambda item: coord.commit_fence(
+        k, max_idx=item.max_idx, n_clusters=len(item.part_ids),
+        chunk_t0=item.chunk_t0,
+    )
+    args_k._elastic_clip = lambda next_min_idx: coord.clip_or_ratify(
+        k, next_min_idx
+    )
     qc: list | None = [] if args_k.qc_report else None
     try:
         resumed, failed, qc_failed = _checkpointed_run(
@@ -2340,15 +2392,22 @@ def _run_elastic_range(
             quarantine=getattr(args, "_quarantine", None),
             harness=harness,
         )
+        # a mid-run split narrowed this range: the suffix past the cut
+        # belongs to the stealing rank's overlay range now, so this
+        # range's QC shard and commit marker cover [start, cut) only
+        rng_eff = coord.effective_range(k)
+        if rng_eff.stop < claim.range.stop:
+            sub = clusters[claim.range.start : rng_eff.stop]
         if qc is not None:
             _write_qc_report(
                 args_k, backend, sub, qc, stats, resumed, failed,
                 qc_failed,
             )
     except LeaseExpiredError as e:
-        # another rank holds this range now (we stalled past the TTL):
-        # abandon — our partial state is exactly what ITS resume pass
-        # repairs — and go claim fresh work
+        # another rank holds this range now (we stalled past the TTL,
+        # or a zombie dispatch reached past a ratified cut): abandon —
+        # our partial state is exactly what ITS resume pass repairs —
+        # and go claim fresh work
         logger.warning(
             "rank %d abandoning range %d: %s", coord.rank, k, e,
         )
@@ -2368,12 +2427,12 @@ def _run_elastic_range(
         output_bytes = os.path.getsize(args_k.output)
         sha = sha256_file(args_k.output, output_bytes)
     committed = coord.commit(k, {
-        "start": claim.range.start,
-        "stop": claim.range.stop,
+        "start": rng_eff.start,
+        "stop": rng_eff.stop,
         "part": os.path.basename(args_k.output),
         "output_bytes": output_bytes,
         "sha256": sha,
-        "n_clusters": claim.range.n_clusters,
+        "n_clusters": rng_eff.n_clusters,
     })
     if not committed:
         # the double-commit race: a zombie peer finished the same range
@@ -2417,12 +2476,28 @@ def _run_elastic(
             "manifests live under <DIR>/ck/ — reassignment depends on "
             "them); drop the flag"
         )
+    from specpride_tpu.parallel.store import is_remote_spec
+
     root = args.elastic
-    os.makedirs(root, exist_ok=True)
+    local_dir = None
+    if is_remote_spec(root):
+        # coordination state lives in the object store; the per-range
+        # resume manifests stay on a filesystem (they are atomic-replace
+        # checkpoint files) — shared between co-hosted ranks so a
+        # takeover resumes instead of recomputing
+        local_dir = (
+            getattr(args, "elastic_local", None) or f"{args.output}.elastic"
+        )
+        os.makedirs(local_dir, exist_ok=True)
+    else:
+        os.makedirs(root, exist_ok=True)
     rank = getattr(args, "process_id", None)
     if rank is None:
         rank = Coordinator.assign_rank(root)
     rank = int(rank)
+    # the fault plan (chaos CI's rank_kill/rank_slow) and journal names
+    # key off the rank — pin it for everything built below
+    args.process_id = rank
     # per-rank telemetry shards, exactly like static multi-host runs
     # (outputs/QC/checkpoints are per-RANGE instead — see
     # _elastic_range_paths)
@@ -2445,11 +2520,15 @@ def _run_elastic(
             getattr(args, "elastic_heartbeat", 0.0) or 0.0
         ),
         journal=journal,
+        local_dir=local_dir,
+        steal=getattr(args, "elastic_steal", "on") != "off",
+        chunk_hint=max(int(getattr(args, "checkpoint_every", 512)), 1),
     )
     logger.info(
-        "elastic rank %d: %d ranges of <=%d clusters under %s "
-        "(ttl %.1fs)", rank, len(coord.ranges), range_size, root,
-        coord.ttl,
+        "elastic rank %d: %d ranges of <=%d clusters via %s "
+        "(ttl %.1fs, steal %s)", rank, len(coord.ranges), range_size,
+        coord.store.describe(), coord.ttl,
+        "on" if coord.steal_enabled else "off",
     )
     exporter = None
     if getattr(args, "metrics_port", None) is not None:
@@ -2482,7 +2561,11 @@ def _run_elastic(
                 if coord.all_committed():
                     break
                 # every open range is leased by a (presumed) live peer:
-                # linger as a warm spare so a peer's death is noticed
+                # tier 2 — try to STEAL a split of the most-loaded live
+                # peer's range before lingering as a warm spare (either
+                # way a peer's death is still noticed via lease expiry)
+                claim = coord.try_steal()
+            if claim is None:
                 coord.wait_for_work()
                 continue
             _run_elastic_range(
@@ -2497,12 +2580,16 @@ def _run_elastic(
     _save_shape_manifest(args, backend)
     stats.elastic = {
         "rank": rank,
+        "backend": coord.store.describe(),
         "n_ranges": len(coord.ranges),
         "range_size": range_size,
         "ranges_run": coord.ranges_run,
         "ranges_committed": coord.done_count(),
         "lease_expires_observed": coord.lease_expires_observed,
         "reassignments": coord.reassignments,
+        "lease_splits": coord.lease_splits,
+        "steals": coord.steals,
+        "cas_conflicts": coord.cas_conflicts,
     }
     _finish_run(args, backend, stats, journal)
 
@@ -2758,9 +2845,20 @@ def cmd_submit(args) -> int:
     """``specpride submit -- consensus IN OUT ...``: run one job through
     a serving daemon.  Streams the daemon's status lines as JSON on
     stdout; exit code 0 = done, 75 = retriable rejection (queue full /
-    draining — resubmit after backoff), 2 = permanently rejected,
-    1 = job error."""
+    quota / draining — resubmit after backoff), 2 = permanently
+    rejected, 1 = job error.
+
+    ``--retry N`` folds the resubmit loop preempted-fleet tenants
+    otherwise hand-roll into the client: a retriable (exit-75 class)
+    outcome is retried up to N times with the robustness layer's
+    exponential backoff + deterministic jitter; permanent outcomes
+    never retry.  Resubmitting is safe because served jobs are
+    idempotent (same argv -> same bytes)."""
+    import time as _time
+
+    from specpride_tpu.robustness.retry import RetryPolicy
     from specpride_tpu.serve import client as serve_client
+    from specpride_tpu.serve import protocol as serve_protocol
 
     job = list(args.job)
     if job and job[0] == "--":
@@ -2770,23 +2868,116 @@ def cmd_submit(args) -> int:
             "submit needs a job argv after --, e.g.: "
             "specpride submit -- consensus in.mgf out.mgf --method bin-mean"
         )
-    last = None
-    try:
-        for msg in serve_client.submit(args.socket, job,
-                                       timeout=args.timeout,
-                                       client=args.client):
-            print(json.dumps(msg), flush=True)
-            last = msg
-    except (OSError, serve_client.ServeError) as e:
+    retries = max(int(getattr(args, "retry", 0) or 0), 0)
+    policy = RetryPolicy(
+        retries=retries, backoff=getattr(args, "retry_backoff", 0.5),
+    )
+
+    def _attempt() -> int:
+        last = None
+        try:
+            for msg in serve_client.submit(args.socket, job,
+                                           timeout=args.timeout,
+                                           client=args.client):
+                print(json.dumps(msg), flush=True)
+                last = msg
+        except (OSError, serve_client.ServeError) as e:
+            print(
+                json.dumps({
+                    "ok": False, "status": "error",
+                    "error": f"{type(e).__name__}: {e}", "retriable": True,
+                }),
+                flush=True,
+            )
+            return 75
+        return serve_client.exit_code(last)
+
+    attempt = 0
+    while True:
+        rc = _attempt()
+        if rc != serve_protocol.EX_TEMPFAIL or attempt >= retries:
+            return rc
+        wait = policy.backoff_s("submit", attempt)
         print(
             json.dumps({
-                "ok": False, "status": "error",
-                "error": f"{type(e).__name__}: {e}", "retriable": True,
+                "status": "retrying", "attempt": attempt + 1,
+                "of": retries, "backoff_s": round(wait, 3),
             }),
             flush=True,
         )
-        return 75
-    return serve_client.exit_code(last)
+        _time.sleep(wait)
+        attempt += 1
+
+
+def cmd_fleet(args) -> int:
+    """``specpride fleet --ranks N --spares M -- consensus … --elastic
+    SPEC``: the warm-spare autoscaling supervisor.  Spawns N rank
+    processes over the supervised argv, replaces abnormal exits while
+    work remains, scales up to M spares on stale heartbeats or a long
+    completion horizon, retires idle excess — every decision journaled
+    as rank_spawn/rank_retire.  Exits 0 once every range is committed
+    (merge with `specpride merge-parts`)."""
+    from specpride_tpu.observability.journal import open_journal
+    from specpride_tpu.parallel.fleet import FleetSupervisor
+
+    job = list(args.job)
+    if job and job[0] == "--":
+        job = job[1:]
+    if not job:
+        raise SystemExit(
+            "fleet needs a supervised argv after --, e.g.: specpride "
+            "fleet --ranks 2 -- consensus in.mgf out.mgf --method "
+            "bin-mean --elastic /shared/coord"
+        )
+    journal = open_journal(args.journal)
+    try:
+        try:
+            sup = FleetSupervisor(
+                job, ranks=args.ranks, spares=args.spares,
+                max_ranks=args.max_ranks, journal=journal,
+                poll_interval=args.poll,
+                scale_horizon=args.scale_horizon,
+            )
+        except ValueError as e:
+            raise SystemExit(str(e))
+        rc = sup.run(timeout=args.timeout)
+        summary = sup.summary()
+        logger.info(
+            "fleet done: %d spawned, %d retired, %d replaced",
+            summary["spawned"], summary["retired"], summary["replaced"],
+        )
+        print(json.dumps(summary), file=sys.stderr)
+        if rc != 0:
+            for problem in summary["failures"]:
+                logger.error("fleet: %s", problem)
+        return rc
+    finally:
+        journal.close()
+
+
+def cmd_cas_server(args) -> int:
+    """``specpride cas-server``: the in-tree conditional-put/ETag object
+    store — the reference backend behind ``--elastic URL``, used by CI
+    and the bench so the object-store protocol is exercised without a
+    cloud account.  Prints its URL on stdout (and to --url-file for
+    scripts) and serves until SIGTERM/SIGINT."""
+    from specpride_tpu.parallel.store import CasServer
+
+    server = CasServer(host=args.host, port=args.port)
+    print(server.url, flush=True)
+    if args.url_file:
+        with open(args.url_file, "w", encoding="utf-8") as fh:
+            fh.write(server.url + "\n")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        try:
+            server.stop()
+        except Exception:  # noqa: BLE001 - already shutting down
+            pass
+    return 0
 
 
 def cmd_stats(args) -> int:
@@ -2867,24 +3058,38 @@ def cmd_merge_parts(args) -> int:
             print(f"unrecognized part name {p}", file=sys.stderr)
             return 1
         ranks.append(int(suffix))
-    plan = None
-    if getattr(args, "elastic", None):
-        from specpride_tpu.parallel.coordinator import Coordinator
+    table = None
+    elastic = getattr(args, "elastic", None)
+    if elastic:
+        from specpride_tpu.parallel.elastic import elastic_range_table
 
-        plan = Coordinator.read_plan(args.elastic)
-        if plan is None:
+        table, problem = elastic_range_table(elastic)
+        if table is None:
             print(
-                f"--elastic {args.elastic}: no readable plan.json — is "
-                "this the coordinator directory the ranks ran against?",
+                f"--elastic {elastic}: {problem} — is this the "
+                "coordinator store the ranks ran against?",
                 file=sys.stderr,
             )
             return 1
-    expected = (
-        plan["n_ranges"] if plan is not None
-        else args.num_processes or (max(ranks) + 1 if ranks else 0)
-    )
-    missing = sorted(set(range(expected)) - set(ranks))
-    extra = sorted(set(ranks) - set(range(expected)))
+    if table is not None:
+        # elastic: the EFFECTIVE range set (base plan + work-stealing
+        # overlays, cuts applied), not a dense id sequence — overlay
+        # ids sit past the base plan, and cluster order is START order
+        expected_ids = [row["range_id"] for row in table]
+        missing = sorted(set(expected_ids) - set(ranks))
+        extra = sorted(set(ranks) - set(expected_ids))
+        by_id = dict(zip(ranks, parts))
+        ordered = [by_id[i] for i in expected_ids if i in by_id]
+        verify_order = [
+            (row["range_id"], by_id[row["range_id"]])
+            for row in table if row["range_id"] in by_id
+        ]
+    else:
+        expected = args.num_processes or (max(ranks) + 1 if ranks else 0)
+        missing = sorted(set(range(expected)) - set(ranks))
+        extra = sorted(set(ranks) - set(range(expected)))
+        ordered = [p for _, p in sorted(zip(ranks, parts))]
+        verify_order = sorted(zip(ranks, parts))
     if missing or extra or len(ranks) != len(set(ranks)):
         print(
             f"incomplete part set for {args.output}: have ids {ranks}, "
@@ -2896,31 +3101,38 @@ def cmd_merge_parts(args) -> int:
             file=sys.stderr,
         )
         return 1
-    ordered = [p for _, p in sorted(zip(ranks, parts))]
     # manifest verification BEFORE any byte moves: a corrupt or torn
     # shard must fail the merge loudly, never reach the merged output
-    if plan is not None or getattr(args, "checkpoint", None):
-        from specpride_tpu.parallel.elastic import verify_part_manifest
+    if table is not None or getattr(args, "checkpoint", None):
+        from specpride_tpu.parallel.elastic import (
+            read_done_marker,
+            verify_part_manifest,
+        )
 
-        for rank, part in sorted(zip(ranks, parts)):
-            if plan is not None:
-                mpath = os.path.join(
-                    args.elastic, "done", f"range_{rank:05d}.json"
-                )
+        for rank, part in verify_order:
+            if table is not None:
+                manifest = read_done_marker(elastic, rank)
                 kind = "commit marker"
+                if manifest is None:
+                    print(
+                        f"rank/range {rank}: unreadable {kind} for range "
+                        f"{rank} — refusing to merge an unverifiable "
+                        "shard", file=sys.stderr,
+                    )
+                    return 1
             else:
                 mpath = f"{args.checkpoint}.part{part.rsplit('.part', 1)[1]}"
                 kind = "checkpoint manifest"
-            try:
-                with open(mpath, encoding="utf-8") as fh:
-                    manifest = json.load(fh)
-            except (OSError, ValueError) as e:
-                print(
-                    f"rank/range {rank}: unreadable {kind} {mpath} ({e}) "
-                    "— refusing to merge an unverifiable shard",
-                    file=sys.stderr,
-                )
-                return 1
+                try:
+                    with open(mpath, encoding="utf-8") as fh:
+                        manifest = json.load(fh)
+                except (OSError, ValueError) as e:
+                    print(
+                        f"rank/range {rank}: unreadable {kind} {mpath} "
+                        f"({e}) — refusing to merge an unverifiable "
+                        "shard", file=sys.stderr,
+                    )
+                    return 1
             problem = verify_part_manifest(part, manifest)
             if problem is not None:
                 print(
@@ -2933,7 +3145,9 @@ def cmd_merge_parts(args) -> int:
         from specpride_tpu.parallel.elastic import merge_qc_reports
 
         shards = []
-        for rank, part in sorted(zip(ranks, parts)):
+        for rank, part in verify_order if table is not None else sorted(
+            zip(ranks, parts)
+        ):
             qpath = f"{args.qc_report}.part{part.rsplit('.part', 1)[1]}"
             if not os.path.exists(qpath):
                 print(
@@ -3180,11 +3394,13 @@ def build_parser() -> argparse.ArgumentParser:
     pm.add_argument("--num-processes", type=int,
                     help="expected part count (refuse to merge fewer)")
     pm.add_argument(
-        "--elastic", metavar="DIR",
-        help="verify against an elastic run's coordinator directory: "
-        "the plan pins the expected range count and every part's size "
-        "+ sha256 is checked against its range commit marker before "
-        "any bytes move",
+        "--elastic", metavar="DIR|URL",
+        help="verify against an elastic run's coordinator store "
+        "(shared directory or object-store URL): the plan plus any "
+        "work-stealing overlay ranges pin the expected part set (and "
+        "the cluster order — split-off tails merge by START, not id), "
+        "and every part's size + sha256 is checked against its range "
+        "commit marker before any bytes move",
     )
     pm.add_argument(
         "--checkpoint", metavar="BASE",
@@ -3394,12 +3610,94 @@ def build_parser() -> argparse.ArgumentParser:
         "submitting process = one tenant)",
     )
     psb.add_argument(
+        "--retry", type=int, default=0, metavar="N",
+        help="resubmit up to N times on a RETRIABLE rejection (queue "
+        "full, quota overrun, draining, connect failure — the exit-75 "
+        "class), with the robustness layer's exponential backoff + "
+        "deterministic jitter between attempts (default 0: fail fast)",
+    )
+    psb.add_argument(
+        "--retry-backoff", type=float, default=0.5, metavar="S",
+        help="base backoff before the first resubmit; doubles per "
+        "attempt with deterministic jitter (default 0.5)",
+    )
+    psb.add_argument(
         "job", nargs=argparse.REMAINDER,
         help="the one-shot CLI argv to run, after --: consensus|select "
         "INPUT OUTPUT [flags] (daemon-owned flags like --compile-cache "
         "and --layout are rejected)",
     )
     psb.set_defaults(fn=cmd_submit)
+
+    pf = sub.add_parser(
+        "fleet",
+        help="warm-spare autoscaling supervisor for an elastic run: "
+        "spawn N rank processes over the argv after --, replace dead "
+        "ones, scale spares up/down from heartbeat ages and the "
+        "completion horizon (journals rank_spawn/rank_retire)",
+    )
+    pf.add_argument(
+        "--ranks", type=int, default=2, metavar="N",
+        help="baseline worker processes to keep running while "
+        "uncommitted ranges remain (default 2; 0 = pure-spare mode "
+        "supervising externally launched ranks)",
+    )
+    pf.add_argument(
+        "--spares", type=int, default=0, metavar="M",
+        help="extra warm workers to spawn when a rank's heartbeat goes "
+        "stale (presumed dead/stalled) or the completion horizon "
+        "exceeds --scale-horizon (default 0)",
+    )
+    pf.add_argument(
+        "--max-ranks", type=int, default=None, metavar="N",
+        help="hard cap on concurrent workers (default ranks + spares)",
+    )
+    pf.add_argument(
+        "--scale-horizon", type=float, default=60.0, metavar="S",
+        help="projected seconds of remaining work (ranges left / "
+        "commit rate) beyond which spares warm up (default 60)",
+    )
+    pf.add_argument(
+        "--poll", type=float, default=0.5, metavar="S",
+        help="supervision loop interval (default 0.5)",
+    )
+    pf.add_argument(
+        "--timeout", type=float, default=None, metavar="S",
+        help="abort the fleet after S seconds (default: unbounded)",
+    )
+    pf.add_argument(
+        "--journal", metavar="FILE",
+        help="JSONL journal for the supervisor's rank_spawn/rank_retire "
+        "decisions (workers journal separately via their own --journal)",
+    )
+    pf.add_argument(
+        "job", nargs=argparse.REMAINDER,
+        help="the rank argv to supervise, after --: consensus|select "
+        "INPUT OUTPUT --elastic DIR|URL [flags] (no --process-id — "
+        "workers auto-assign fresh ranks)",
+    )
+    pf.set_defaults(fn=cmd_fleet)
+
+    pcs = sub.add_parser(
+        "cas-server",
+        help="in-tree conditional-put/ETag object store (the --elastic "
+        "URL backend's reference server; in-memory, for CI/bench/dev)",
+    )
+    pcs.add_argument(
+        "--host", default="127.0.0.1", metavar="HOST",
+        help="bind address (default 127.0.0.1)",
+    )
+    pcs.add_argument(
+        "--port", type=int, default=0, metavar="PORT",
+        help="bind port (default 0 = ephemeral; the chosen URL prints "
+        "on stdout)",
+    )
+    pcs.add_argument(
+        "--url-file", metavar="FILE",
+        help="also write the server URL to FILE (for shell scripts "
+        "that need it before stdout is line-buffered through a pipe)",
+    )
+    pcs.set_defaults(fn=cmd_cas_server)
 
     pst = sub.add_parser(
         "stats",

@@ -382,7 +382,10 @@ class ElasticTelemetry:
         self.coord = coordinator
         self.extra_registries = tuple(extra_registries)
         self._render_lock = threading.Lock()
-        self._counters_last = {"expires": 0.0, "reassigns": 0.0}
+        self._counters_last = {
+            "expires": 0.0, "reassigns": 0.0, "splits": 0.0,
+            "steals": 0.0,
+        }
         r = self.registry = MetricsRegistry()
         self.hb_age = r.gauge(
             "specpride_rank_heartbeat_age_seconds",
@@ -412,6 +415,15 @@ class ElasticTelemetry:
             "specpride_elastic_reassignments_total",
             "dead ranks' chunk ranges THIS rank reclaimed",
         )
+        self.splits = r.counter(
+            "specpride_elastic_lease_splits_total",
+            "live work-stealing splits THIS rank ratified as donor "
+            "(its range was cut and the tail handed to a faster peer)",
+        )
+        self.steals = r.counter(
+            "specpride_elastic_steals_total",
+            "split-off tails THIS rank claimed from slower live peers",
+        )
 
     def exposition(self) -> str:
         with self._render_lock:
@@ -429,6 +441,8 @@ class ElasticTelemetry:
             for counter, attr, key in (
                 (self.lease_expires, "lease_expires_observed", "expires"),
                 (self.reassigns, "reassignments", "reassigns"),
+                (self.splits, "lease_splits", "splits"),
+                (self.steals, "steals", "steals"),
             ):
                 total = float(getattr(coord, attr, 0))
                 last = self._counters_last[key]
@@ -439,6 +453,8 @@ class ElasticTelemetry:
             # series beats an absent one for rate() queries)
             self.lease_expires.inc(0)
             self.reassigns.inc(0)
+            self.splits.inc(0)
+            self.steals.inc(0)
             parts = [self.registry.to_prometheus_text()]
             parts.extend(
                 r.to_prometheus_text() for r in self.extra_registries

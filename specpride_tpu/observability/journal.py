@@ -80,7 +80,18 @@ EVENT_FIELDS: dict[str, frozenset] = {
     # `parallel.elastic.audit_elastic` and the chaos CI pass)
     "lease_expire": frozenset({"rank", "range"}),
     # the surviving rank reclaimed the dead rank's uncommitted chunks
+    # (or, with `via="lease_split"`, claimed a split-off live tail)
     "chunk_reassign": frozenset({"range", "from_rank", "to_rank"}),
+    # live work-stealing (tier 2): the DONOR ratified a split of its own
+    # range at a chunk boundary — the suffix [split_at, stop) is now
+    # overlay range `new_range`, and every lease_split must pair with a
+    # chunk_reassign for that new range (audited like lease_expire)
+    "lease_split": frozenset({"range", "new_range", "rank", "split_at"}),
+    # fleet supervisor (`specpride fleet`): a rank process was spawned
+    # (boot, replacement for a dead rank, or a warm spare scaled up) or
+    # retired (excess capacity scaled down)
+    "rank_spawn": frozenset({"pid"}),
+    "rank_retire": frozenset({"pid", "reason"}),
     # warm-start subsystem (specpride_tpu.warmstart): how the persistent
     # compilation cache resolved for this run (dir, or the reason it
     # stayed off) — post-mortems must be able to tell cached from cold

@@ -338,7 +338,8 @@ def _render_rank_view(view: dict, out) -> None:
     print(
         f"ranks: {len(view.get('ranks', {}))} seen, "
         f"{view.get('reassignments', 0)} reassignment(s), "
-        f"{audit} {state} lease expiries", file=out,
+        f"{view.get('lease_splits', 0)} split(s), "
+        f"{audit} {state} lease expiries/splits", file=out,
     )
     for rank, r in view.get("ranks", {}).items():
         age = r.get("last_heartbeat_age_s")
@@ -353,7 +354,15 @@ def _render_rank_view(view: dict, out) -> None:
             bits.append(f"leases_expired={r['leases_expired']}")
         if r.get("reassigned_away"):
             bits.append(f"reassigned_away={r['reassigned_away']}")
-        print(f"  rank {rank}: {' '.join(bits)}", file=out)
+        if r.get("lease_splits"):
+            bits.append(f"lease_splits={r['lease_splits']}")
+        if r.get("steals"):
+            bits.append(f"steals={r['steals']}")
+        # stale-but-alive: heartbeat silent past the TTL with leases
+        # still held and no expiry recorded — the rank the fleet should
+        # be stealing from (or the autoscaler replacing)
+        slow = "slow: " if r.get("slow") else ""
+        print(f"  rank {rank}: {slow}{' '.join(bits)}", file=out)
 
 
 def _render_run(run: dict, out, slo: bool = False) -> None:
@@ -455,12 +464,18 @@ def _render_run(run: dict, out, slo: bool = False) -> None:
         print(f"  warmstart: {' '.join(bits)}", file=out)
     el = run.get("elastic")
     if el:
+        extras = "".join(
+            f" {key}={el[key]}"
+            for key in ("lease_splits", "steals", "cas_conflicts")
+            if el.get(key)
+        )
         print(
             f"  elastic: rank={el.get('rank')} "
             f"ranges_run={el.get('ranges_run')}/"
             f"{el.get('n_ranges')} "
             f"committed={el.get('ranges_committed')} "
-            f"reassignments={el.get('reassignments', 0)}", file=out,
+            f"reassignments={el.get('reassignments', 0)}"
+            f"{extras}", file=out,
         )
     rb = run.get("robustness")
     if rb:

@@ -8,12 +8,16 @@ the vmapped programs with zero cross-device communication in the hot loop,
 and the only collectives are the output all-gather and a final metrics
 all-reduce (survey §2 / BASELINE.json config 5).
 
-Two submodules sit beside the mesh: :mod:`.coordinator` (the
-filesystem-backed elastic work queue — leases, heartbeats, exactly-once
-range commits) and :mod:`.elastic` (journal audits, the stats rank view,
-manifest-verified merging).  Both are jax-free, so the mesh exports below
-resolve LAZILY — ``specpride stats`` / ``merge-parts`` on a login node
-must not pay (or require) a jax import to read journals.
+Four submodules sit beside the mesh: :mod:`.store` (the pluggable
+coordinator state backend — shared directory or conditional-put object
+store, plus the in-tree CAS test server), :mod:`.coordinator` (the
+elastic work queue — leases, heartbeats, exactly-once range commits,
+live work-stealing), :mod:`.elastic` (journal audits, the stats rank
+view, manifest-verified merging) and :mod:`.fleet` (the warm-spare
+autoscaling supervisor behind ``specpride fleet``).  All four are
+jax-free, so the mesh exports below resolve LAZILY — ``specpride
+stats`` / ``merge-parts`` / ``fleet`` on a login node must not pay (or
+require) a jax import.
 """
 
 _MESH_EXPORTS = (

@@ -28,12 +28,21 @@ import statistics
 
 
 def audit_elastic(events: list[dict]) -> list[dict]:
-    """Unpaired ``lease_expire`` events: each must be followed by a
-    ``chunk_reassign`` for the same range (the stealing rank emits the
-    pair back to back, so pairing is per-range and order-aware).
-    Feed MERGED events from every rank's journal — the expiry and the
-    reassignment always live in the observer's journal, but a multi-file
-    audit must not depend on which file they came from."""
+    """Unpaired work-movement events.  Two pairings are audited the
+    same way:
+
+    * every ``lease_expire`` must pair with a ``chunk_reassign`` for
+      the same range (a dead rank's work actually moved);
+    * every ``lease_split`` must pair with a ``chunk_reassign`` for its
+      ``new_range`` (a split-off tail was actually claimed — a ratified
+      split nobody picked up is lost work exactly like an unreassigned
+      expiry).
+
+    Feed MERGED events from every rank's journal — an expiry and its
+    reassignment live in the observer's journal, but a split lives in
+    the DONOR's journal while the reassignment lives in the claimer's,
+    so a multi-file audit must never depend on which file an event came
+    from."""
     reassigned: dict[int, int] = {}
     for e in events:
         if e.get("event") == "chunk_reassign":
@@ -42,9 +51,13 @@ def audit_elastic(events: list[dict]) -> list[dict]:
                 reassigned[k] = reassigned.get(k, 0) + 1
     unmatched = []
     for e in events:
-        if e.get("event") != "lease_expire":
+        ev = e.get("event")
+        if ev == "lease_expire":
+            k = e.get("range")
+        elif ev == "lease_split":
+            k = e.get("new_range")
+        else:
             continue
-        k = e.get("range")
         if isinstance(k, int) and reassigned.get(k, 0) > 0:
             reassigned[k] -= 1
         else:
@@ -62,8 +75,10 @@ def summarize_ranks(events_per_file: list[list[dict]]) -> dict | None:
     def row(r) -> dict:
         return ranks.setdefault(int(r), {
             "heartbeats": 0, "last_heartbeat_ts": None,
+            "last_holding": [], "ttl": None,
             "ranges_claimed": 0, "takeovers": 0, "chunks_committed": 0,
             "leases_expired": 0, "reassigned_away": 0,
+            "lease_splits": 0, "steals": 0,
         })
 
     saw_elastic = False
@@ -82,11 +97,17 @@ def summarize_ranks(events_per_file: list[list[dict]]) -> dict | None:
                 saw_elastic = True
                 r = row(e.get("rank", -1))
                 r["heartbeats"] += 1
-                if isinstance(ts, (int, float)):
-                    r["last_heartbeat_ts"] = (
-                        ts if r["last_heartbeat_ts"] is None
-                        else max(r["last_heartbeat_ts"], ts)
+                if isinstance(ts, (int, float)) and (
+                    r["last_heartbeat_ts"] is None
+                    or ts >= r["last_heartbeat_ts"]
+                ):
+                    r["last_heartbeat_ts"] = ts
+                    holding = e.get("holding")
+                    r["last_holding"] = (
+                        list(holding) if isinstance(holding, list) else []
                     )
+                if isinstance(e.get("ttl"), (int, float)):
+                    r["ttl"] = e["ttl"]
                 file_rank = e.get("rank", file_rank)
             elif ev == "lease_claim":
                 saw_elastic = True
@@ -98,9 +119,14 @@ def summarize_ranks(events_per_file: list[list[dict]]) -> dict | None:
             elif ev == "lease_expire":
                 saw_elastic = True
                 row(e.get("rank", -1))["leases_expired"] += 1
+            elif ev == "lease_split":
+                saw_elastic = True
+                row(e.get("rank", -1))["lease_splits"] += 1
             elif ev == "chunk_reassign":
                 saw_elastic = True
                 row(e.get("from_rank", -1))["reassigned_away"] += 1
+                if e.get("via") == "lease_split":
+                    row(e.get("to_rank", -1))["steals"] += 1
             elif ev == "chunk_done":
                 chunk_done += 1
         if file_rank is not None and chunk_done:
@@ -109,9 +135,23 @@ def summarize_ranks(events_per_file: list[list[dict]]) -> dict | None:
         return None
     for r in ranks.values():
         last = r.pop("last_heartbeat_ts")
+        holding = r.pop("last_holding")
+        ttl = r.pop("ttl")
         r["last_heartbeat_age_s"] = (
             round(max_ts - last, 3)
             if last is not None and max_ts is not None else None
+        )
+        # stale-but-alive: the rank's heartbeat went silent past its
+        # TTL while it still HELD leases, yet nobody expired it — the
+        # signature of a slow (throttled, swapping, noisy-neighbour)
+        # rank a live fleet should be stealing from, rendered as a
+        # `slow:` marker by `specpride stats`
+        r["slow"] = bool(
+            holding
+            and isinstance(ttl, (int, float))
+            and r["last_heartbeat_age_s"] is not None
+            and r["last_heartbeat_age_s"] > ttl
+            and r["leases_expired"] == 0
         )
     unpaired = audit_elastic(
         [e for events in events_per_file for e in events]
@@ -121,11 +161,100 @@ def summarize_ranks(events_per_file: list[list[dict]]) -> dict | None:
         "reassignments": sum(
             r["reassigned_away"] for r in ranks.values()
         ),
+        "lease_splits": sum(
+            r["lease_splits"] for r in ranks.values()
+        ),
         "unpaired_lease_expiries": len(unpaired),
     }
 
 
 # -- manifest-verified merging ------------------------------------------
+
+
+def elastic_range_table(spec: str) -> tuple[list[dict] | None, str | None]:
+    """The EFFECTIVE range set of an elastic run: the base plan plus
+    every overlay range the work-stealing handshake registered, with
+    ratified cuts applied to their parents — sorted by cluster START,
+    which is the concatenation order that reproduces single-host serial
+    bytes (overlay ids are allocated past the base plan, so id order is
+    NOT cluster order once a split happened).
+
+    Returns ``(table, problem)``: ``table`` is a list of
+    ``{"range_id", "start", "stop"}`` rows, or None with a problem
+    string when the plan is unreadable or the effective ranges do not
+    tile ``[0, n_clusters)`` exactly (overlapping or gapped splits —
+    states the handshake cannot legally produce, so seeing one means
+    the store was tampered with or torn)."""
+    from specpride_tpu.parallel.coordinator import plan_ranges
+    from specpride_tpu.parallel.store import store_from_spec
+
+    store = store_from_spec(spec)
+    got = store.get("plan.json")
+    if got is None:
+        return None, "no readable plan.json"
+    plan = got[0]
+    n = plan.get("n_clusters")
+    size = plan.get("range_size")
+    if not isinstance(n, int) or not isinstance(size, int):
+        return None, "malformed plan.json"
+    rows = {
+        r.range_id: {"range_id": r.range_id, "start": r.start,
+                     "stop": r.stop}
+        for r in plan_ranges(n, size)
+    }
+    # splits are discovered from CUT records only — the single atomic
+    # publication of the handshake.  Overlay records are id-allocation
+    # markers; one without a referencing cut is debris from a donor
+    # that died mid-handshake and must NOT appear in the table (its
+    # parent was never narrowed).
+    for key in store.list_keys("split/"):
+        if ".cut." not in key:
+            continue
+        rec_got = store.get(key)
+        if rec_got is None:
+            return None, f"unreadable cut record {key}"
+        rec = rec_got[0]
+        cut = rec.get("cut")
+        try:
+            parent = int(
+                key.rsplit("/", 1)[1].split(".", 1)[0].replace("range_", "")
+            )
+        except ValueError:
+            continue
+        if not isinstance(cut, int):
+            return None, f"malformed cut record {key}"
+        rid = rec.get("new_range")
+        if isinstance(rid, int):
+            stop = rec.get("stop")
+            if not isinstance(stop, int):
+                return None, f"malformed cut record {key}"
+            rows[rid] = {"range_id": rid, "start": cut, "stop": stop}
+        row = rows.get(parent)
+        if row is not None and cut < row["stop"]:
+            row["stop"] = cut
+    table = sorted(rows.values(), key=lambda r: (r["start"], r["range_id"]))
+    pos = 0
+    for row in table:
+        if row["start"] != pos or row["stop"] < row["start"]:
+            return None, (
+                f"effective ranges do not tile the input: range "
+                f"{row['range_id']} spans [{row['start']}, {row['stop']}) "
+                f"but cluster {pos} is next"
+            )
+        pos = row["stop"]
+    if pos != n:
+        return None, (
+            f"effective ranges cover {pos} of {n} clusters"
+        )
+    return table, None
+
+
+def read_done_marker(spec: str, range_id: int) -> dict | None:
+    """Range ``range_id``'s commit marker (None = never committed)."""
+    from specpride_tpu.parallel.store import store_from_spec
+
+    got = store_from_spec(spec).get(f"done/range_{range_id:05d}.json")
+    return got[0] if got is not None else None
 
 
 def sha256_file(path: str, upto: int | None = None) -> str:

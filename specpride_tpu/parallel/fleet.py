@@ -1,0 +1,345 @@
+"""Warm-spare fleet supervisor: ``specpride fleet``.
+
+A preemptible fleet needs someone to notice that a rank evaporated and
+to decide when extra capacity is worth paying for.  The elastic
+coordinator already makes rank DEATH safe (lease expiry + reassignment)
+and rank SLOWNESS recoverable (live work-stealing) — this module closes
+the loop by managing the rank processes themselves:
+
+* keep ``--ranks N`` workers running while uncommitted ranges remain;
+* **scale up** — spawn up to ``--spares M`` extra workers (bounded by
+  ``--max-ranks``) when the fleet looks unhealthy or behind: a
+  heartbeat older than the lease TTL + grace (a rank presumed dead or
+  badly stalled — its work is about to be reassigned, so capacity to
+  absorb it should already be warm), or a completion horizon
+  (``remaining ranges / committed rate``) beyond ``--scale-horizon``
+  seconds;
+* **scale down** — SIGTERM workers that the store shows idle (holding
+  no leases) once fewer ranges remain than workers; an idle warm spare
+  costs a slot on the machine, nothing in the run (it would linger
+  polling until the fleet finishes);
+* **replace** — a worker that exits abnormally (preemption, SIGKILL,
+  OOM) is respawned while claimable work remains.
+
+Every decision is journaled: ``rank_spawn`` (``reason`` ∈ ``boot`` /
+``replace_dead`` / ``scale_up``) and ``rank_retire`` (``reason`` =
+``excess_capacity``) — so a post-mortem reads autoscaling the same way
+it reads leases.  The supervisor itself holds NO lease and writes no
+output; killing it mid-run loses nothing (workers finish or age out
+like any other rank).
+
+Worker processes are the ordinary CLI: the supervised argv is a
+complete ``specpride consensus/select … --elastic SPEC`` command line
+WITHOUT ``--process-id`` (each worker auto-assigns a fresh rank id).
+This module is jax-free: supervision is pure process + store watching.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from specpride_tpu.observability.stats import logger
+from specpride_tpu.parallel.store import Store, store_from_spec
+
+# default seconds of projected remaining work that justifies warming a
+# spare: small enough to react within one CI-scale run, large enough
+# that a healthy fleet finishing soon is left alone
+DEFAULT_SCALE_HORIZON_S = 60.0
+
+
+def extract_flag(argv: list[str], flag: str) -> str | None:
+    """The value of ``--flag VALUE`` or ``--flag=VALUE`` in a job argv
+    (last occurrence wins, like argparse)."""
+    value = None
+    for i, tok in enumerate(argv):
+        if tok == flag and i + 1 < len(argv):
+            value = argv[i + 1]
+        elif tok.startswith(flag + "="):
+            value = tok.split("=", 1)[1]
+    return value
+
+
+class FleetSupervisor:
+    """Drive one elastic run to completion with ``ranks`` workers and up
+    to ``spares`` warm spares.  :meth:`run` blocks until every range is
+    committed (returns 0) or no worker can make progress (returns 1)."""
+
+    def __init__(
+        self,
+        job_argv: list[str],
+        ranks: int,
+        spares: int = 0,
+        max_ranks: int | None = None,
+        journal=None,
+        poll_interval: float = 0.5,
+        scale_horizon: float = DEFAULT_SCALE_HORIZON_S,
+        env: dict | None = None,
+    ):
+        spec = extract_flag(job_argv, "--elastic")
+        if not spec:
+            raise ValueError(
+                "fleet needs an --elastic DIR|URL in the supervised argv"
+            )
+        if extract_flag(job_argv, "--process-id") is not None:
+            raise ValueError(
+                "drop --process-id from the supervised argv: every "
+                "spawned worker must auto-assign a fresh rank"
+            )
+        self.job_argv = list(job_argv)
+        self.spec = spec
+        self.ranks = max(int(ranks), 0)
+        self.spares = max(int(spares), 0)
+        self.max_ranks = (
+            int(max_ranks) if max_ranks else self.ranks + self.spares
+        )
+        self.journal = journal
+        self.poll_interval = max(float(poll_interval), 0.05)
+        self.scale_horizon = max(float(scale_horizon), 1.0)
+        self.env = dict(env if env is not None else os.environ)
+        ttl = extract_flag(job_argv, "--elastic-ttl")
+        try:
+            self.ttl = float(ttl) if ttl else 10.0
+        except ValueError:
+            self.ttl = 10.0
+        self.grace = self.ttl * 0.5
+        self.store: Store = store_from_spec(spec)
+        # per-worker stderr lands in files, never a pipe: an undrained
+        # pipe blocks a chatty worker's writes once the OS buffer fills
+        # (the supervisor only reads stderr AFTER exit)
+        self.scratch = tempfile.mkdtemp(prefix="specpride-fleet-")
+        self.procs: list[subprocess.Popen] = []
+        self.spawned = 0
+        self.retired = 0
+        self.replaced = 0
+        self.failures: list[str] = []
+        self._done_cache: set[str] = set()
+
+    # -- store views -----------------------------------------------------
+
+    def _plan(self) -> dict | None:
+        got = self.store.get("plan.json")
+        return got[0] if got is not None else None
+
+    def _range_ids(self) -> set[int]:
+        plan = self._plan()
+        if plan is None:
+            return set()
+        ids = set(range(int(plan.get("n_ranges", 0) or 0)))
+        # split-off tails count from their CUT records (the atomic
+        # publication) — an overlay id allocated by a donor that died
+        # mid-handshake has no cut, is claimable by nobody, and must
+        # not inflate the remaining-work count forever
+        for key in self.store.list_keys("split/"):
+            if ".cut." not in key:
+                continue
+            got = self.store.get(key)
+            if got is not None and isinstance(got[0].get("new_range"), int):
+                ids.add(got[0]["new_range"])
+        return ids
+
+    def _done_ids(self) -> set[str]:
+        for key in self.store.list_keys("done/"):
+            self._done_cache.add(key)
+        return self._done_cache
+
+    def _heartbeats(self) -> list[tuple[dict, float]]:
+        out = []
+        for key in self.store.list_keys("hb/"):
+            got = self.store.get_with_age(key)
+            if got is not None and got[2] is not None:
+                out.append((got[0], got[2]))
+        return out
+
+    # -- process management ----------------------------------------------
+
+    def _spawn(self, reason: str) -> None:
+        argv = [sys.executable, "-m", "specpride_tpu"] + self.job_argv
+        err_path = os.path.join(
+            self.scratch, f"worker-{self.spawned:04d}.stderr"
+        )
+        with open(err_path, "wb") as err_fh:
+            proc = subprocess.Popen(
+                argv, env=self.env,
+                stdout=subprocess.DEVNULL, stderr=err_fh,
+            )
+        proc.stderr_path = err_path  # type: ignore[attr-defined]
+        self.procs.append(proc)
+        self.spawned += 1
+        if self.journal is not None:
+            self.journal.emit("rank_spawn", pid=proc.pid, reason=reason)
+        logger.info("fleet: spawned worker pid %d (%s)", proc.pid, reason)
+
+    def _retire(self, proc: subprocess.Popen, reason: str) -> None:
+        try:
+            proc.send_signal(signal.SIGTERM)
+        except OSError:
+            return
+        self.retired += 1
+        if self.journal is not None:
+            self.journal.emit("rank_retire", pid=proc.pid, reason=reason)
+        logger.info("fleet: retiring worker pid %d (%s)", proc.pid, reason)
+
+    def _reap(self, work_remains: bool) -> None:
+        """Collect exited workers; replace abnormal exits while work
+        remains (a clean exit 0 means the worker saw every range
+        committed — no replacement needed)."""
+        alive: list[subprocess.Popen] = []
+        for proc in self.procs:
+            rc = proc.poll()
+            if rc is None:
+                alive.append(proc)
+                continue
+            err = b""
+            try:
+                with open(proc.stderr_path, "rb") as fh:
+                    err = fh.read()
+            except OSError:
+                pass
+            if rc != 0 and rc != -signal.SIGTERM:
+                tail = err.decode(errors="replace")[-2000:]
+                logger.warning(
+                    "fleet: worker pid %d exited %s%s", proc.pid, rc,
+                    f"\n{tail}" if tail.strip() else "",
+                )
+                if work_remains:
+                    self.replaced += 1
+                    self._spawn("replace_dead")
+                else:
+                    self.failures.append(
+                        f"pid {proc.pid} exited {rc} with no work left"
+                    )
+        self.procs = alive
+
+    # -- the policy loop -------------------------------------------------
+
+    def _desired(self, remaining: int, rate: float) -> int:
+        """How many workers to keep alive right now."""
+        if remaining <= 0:
+            return 0
+        target = self.ranks
+        # a rank whose heartbeat went silent past TTL + grace WITHOUT
+        # the clean-shutdown marker is presumed dead or badly stalled —
+        # capacity to absorb its reassigned work should already be warm
+        stale = any(
+            age > hb.get("ttl", self.ttl) + self.grace
+            for hb, age in self._heartbeats()
+            if not hb.get("stopped")
+        )
+        # the horizon trigger needs an OBSERVED commit rate: before the
+        # first commits land, rate 0 says "unknown", not "infinitely
+        # behind" — stale heartbeats are the early-trouble signal
+        behind = rate > 0 and (remaining / rate) > self.scale_horizon
+        if stale or behind:
+            target = self.ranks + self.spares
+        # never more workers than claimable units of work — a worker
+        # beyond that could only idle (an existing spare already covers
+        # the warm-takeover case).  A pure-spare supervisor (--ranks 0
+        # watching externally-launched ranks) floors at zero: it adds
+        # capacity only when the policy above asks for it.
+        floor = 1 if self.ranks > 0 else 0
+        return max(min(target, self.max_ranks, remaining), floor)
+
+    def run(self, timeout: float | None = None) -> int:
+        deadline = (
+            time.perf_counter() + timeout if timeout is not None else None
+        )
+        t0 = time.perf_counter()
+        for _ in range(self.ranks):
+            self._spawn("boot")
+        rate_window: list[tuple[float, int]] = []  # (mono, n_done)
+        try:
+            while True:
+                if deadline is not None and time.perf_counter() > deadline:
+                    self.failures.append("fleet timeout")
+                    return 1
+                ids = self._range_ids()
+                done = {
+                    key for key in self._done_ids()
+                }
+                remaining = max(len(ids) - len(done), 0) if ids else None
+                now = time.perf_counter()
+                rate_window.append((now, len(done)))
+                rate_window[:] = [
+                    (t, n) for t, n in rate_window if now - t <= 10.0
+                ]
+                rate = 0.0
+                if len(rate_window) >= 2:
+                    dt = rate_window[-1][0] - rate_window[0][0]
+                    dn = rate_window[-1][1] - rate_window[0][1]
+                    rate = dn / dt if dt > 0 else 0.0
+                work_remains = remaining is None or remaining > 0
+                self._reap(work_remains)
+                if remaining == 0:
+                    # ranges all committed: workers exit on their own
+                    # (their claim loop sees all_committed) — wait for
+                    # them, then report
+                    for proc in self.procs:
+                        try:
+                            proc.wait(timeout=max(self.ttl * 4, 30.0))
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                            self.failures.append(
+                                f"pid {proc.pid} hung after completion"
+                            )
+                    self._reap(work_remains=False)
+                    self.procs = []
+                    return 1 if self.failures else 0
+                if remaining is not None:
+                    desired = self._desired(remaining, rate)
+                    while len(self.procs) < desired:
+                        self._spawn(
+                            "scale_up" if self.spawned >= self.ranks
+                            else "boot"
+                        )
+                    if len(self.procs) > desired and remaining < len(
+                        self.procs
+                    ):
+                        self._scale_down(len(self.procs) - desired)
+                elif not self.procs and time.perf_counter() - t0 > 60.0:
+                    # no plan after a generous boot window and nobody
+                    # alive to write one — a --ranks 0 supervisor is
+                    # waiting for externally-launched ranks that never
+                    # registered
+                    self.failures.append(
+                        "no worker alive and no plan registered"
+                    )
+                    return 1
+                time.sleep(self.poll_interval)
+        finally:
+            for proc in self.procs:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in self.procs:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    def _scale_down(self, n: int) -> None:
+        """Retire up to ``n`` workers the store shows IDLE (no held
+        leases): pid -> rank via the heartbeat records each rank
+        publishes about itself."""
+        idle_pids = {
+            hb.get("pid")
+            for hb, age in self._heartbeats()
+            if not hb.get("holding") and age <= self.ttl
+        }
+        for proc in list(self.procs):
+            if n <= 0:
+                break
+            if proc.poll() is None and proc.pid in idle_pids:
+                self._retire(proc, "excess_capacity")
+                n -= 1
+
+    def summary(self) -> dict:
+        return {
+            "spawned": self.spawned,
+            "retired": self.retired,
+            "replaced": self.replaced,
+            "failures": list(self.failures),
+        }

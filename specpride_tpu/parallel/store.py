@@ -1,0 +1,554 @@
+"""Pluggable coordinator state store: the small-record CAS layer the
+elastic :class:`~specpride_tpu.parallel.coordinator.Coordinator`
+protocol (leases + exactly-once commits + split/steal handshake) runs
+on top of.
+
+PR 9's coordinator talked to the filesystem directly, so a fleet
+without a shared POSIX mount — the cheap preemptible cloud deployment
+the ROADMAP's "millions of users" north star implies — could not run
+elastically at all.  This module extracts the storage operations the
+protocol actually needs into :class:`Store` and ships two backends:
+
+* :class:`FsStore` — the original shared-directory backend, preserved
+  byte-for-byte on disk (``leases/range_*.json`` O_EXCL creates, utime
+  renewals, tombstone renames, ``done/`` hardlink commit markers), so
+  everything PR 9 proved — and every existing journal/merge consumer —
+  keeps working unchanged.
+* :class:`HttpCasStore` — a conditional-put/ETag object-store client
+  speaking the subset every real object store exposes (S3
+  ``If-None-Match: *`` / ``If-Match``, GCS ``x-goog-if-generation-
+  match``, Azure ETags): create-if-absent, ETag-guarded replace/delete,
+  and provider-clock freshness.  ``--elastic http://host:port`` selects
+  it.
+
+The protocol was shaped so every mutation maps onto one of FOUR
+primitive shapes, each atomic on both backends:
+
+====================  =====================  ==========================
+protocol step         FsStore                HttpCasStore
+====================  =====================  ==========================
+claim / commit /      ``os.link`` from a     ``PUT`` with
+propose / ratify      private temp (EEXIST   ``If-None-Match: *``
+(``put_new``)         = lost the race)       (412 = lost the race)
+lease renewal         ``os.utime`` (atomic   ``PUT`` with ``If-Match:
+(``touch``)           mtime bump; can never  <etag>`` re-writing the
+                      shadow a stealer's     same body (412 = a stealer
+                      fresh lease)           replaced the lease)
+expiry steal          nonce-checked rename   ``DELETE`` with
+(``delete_if``)       to a tombstone (one    ``If-Match`` (one racer
+                      racer's rename wins)   gets 204, the rest 412)
+liveness judgment     ``now - st_mtime``     server-computed age header
+(``age_s``)           (grace absorbs         (single clock — client
+                      client/NFS skew)       skew cannot early-steal)
+====================  =====================  ==========================
+
+ETags are content-derived on the filesystem backend (sha256 of the
+record bytes — stable across ``utime`` renewals, unique per lease
+because every lease carries a fresh nonce) and server-assigned
+revisions on the HTTP backend.
+
+:class:`CasServer` is the in-tree test/reference server (stdlib
+``ThreadingHTTPServer``, in-memory) so CI and the bench exercise the
+object-store protocol without a cloud account: ``specpride cas-server``
+runs it standalone.
+
+This module is deliberately jax-free.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+
+from specpride_tpu.observability.stats import logger
+
+# request timeout for every object-store round trip: coordinator records
+# are tiny, so anything slower than this is an outage the lease TTL
+# machinery should see, not a transfer in progress
+HTTP_TIMEOUT_S = 10.0
+
+
+def _etag_of(body: bytes) -> str:
+    return hashlib.sha256(body).hexdigest()[:16]
+
+
+def _encode(payload: dict) -> bytes:
+    return (json.dumps(payload) + "\n").encode("utf-8")
+
+
+def _decode(body: bytes) -> dict | None:
+    """Torn/concurrent states decode as None — callers treat that as
+    "contested, look again", never as a crash."""
+    try:
+        data = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+class Store:
+    """The coordinator's storage contract.  Keys are ``/``-separated
+    relative paths (``leases/range_00003.json``); payloads are small
+    JSON objects.  Every mutator is atomic per key; cross-key
+    transactions are deliberately absent — the protocol never needs
+    one."""
+
+    def put_new(self, key: str, payload: dict) -> bool:
+        """Create-if-absent.  False = the key already exists (something
+        else won the race); the caller's claim/commit/proposal lost."""
+        raise NotImplementedError
+
+    def get(self, key: str) -> tuple[dict, str] | None:
+        """``(payload, etag)`` or None (absent/torn)."""
+        raise NotImplementedError
+
+    def put(self, key: str, payload: dict) -> None:
+        """Unconditional atomic replace — last writer wins.  Only used
+        for single-writer records (a rank's own heartbeat)."""
+        raise NotImplementedError
+
+    def touch(self, key: str) -> bool:
+        """Refresh the key's freshness (``age_s`` restarts) WITHOUT
+        changing its content.  False = the key is gone or was replaced
+        out from under us (the caller lost its lease)."""
+        raise NotImplementedError
+
+    def delete_if(self, key: str, etag: str) -> bool:
+        """Compare-and-delete: remove the key iff its etag still
+        matches.  False = mismatch/absent (lost the race)."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        """Best-effort unconditional delete (release cleanup)."""
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str) -> list[str]:
+        """Sorted keys under ``prefix`` (one directory level)."""
+        raise NotImplementedError
+
+    def age_s(self, key: str) -> float | None:
+        """Seconds since the key was last written/touched, judged by
+        the STORE's clock (None = absent).  This is the liveness input:
+        the grace margin on top of the TTL absorbs whatever skew the
+        backend's clock model leaves."""
+        raise NotImplementedError
+
+    def get_with_age(
+        self, key: str
+    ) -> tuple[dict, str, float | None] | None:
+        """``(payload, etag, age_s)`` in ONE store round trip where the
+        backend can manage it — the claim/steal scans judge liveness on
+        every record they read, and paying a second request per key
+        against a billed, rate-limited object store would double the
+        protocol's traffic."""
+        got = self.get(key)
+        if got is None:
+            return None
+        return got[0], got[1], self.age_s(key)
+
+    def describe(self) -> str:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FsStore(Store):
+    """Shared-directory backend — PR 9's on-disk layout, unchanged."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        # the store's clock, overridable by skew tests
+        self._now = time.time
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def put_new(self, key: str, payload: dict) -> bool:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as fh:
+            fh.write(_encode(payload))
+        try:
+            os.link(tmp, path)  # atomic create-if-absent, full content
+        except FileExistsError:
+            return False
+        finally:
+            os.unlink(tmp)
+        return True
+
+    def get(self, key: str) -> tuple[dict, str] | None:
+        try:
+            with open(self._path(key), "rb") as fh:
+                body = fh.read()
+        except OSError:
+            return None
+        payload = _decode(body)
+        if payload is None:
+            return None
+        return payload, _etag_of(body)
+
+    def put(self, key: str, payload: dict) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as fh:
+            fh.write(_encode(payload))
+        os.replace(tmp, path)
+
+    def touch(self, key: str) -> bool:
+        try:
+            os.utime(self._path(key))
+        except OSError:
+            return False
+        return True
+
+    def delete_if(self, key: str, etag: str) -> bool:
+        path = self._path(key)
+        current = self.get(key)
+        if current is None or current[1] != etag:
+            return False
+        # rename to a tombstone, not unlink: only one racer's rename
+        # succeeds, and the debris is post-mortem evidence of the steal
+        tomb = f"{path}.dead.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        try:
+            os.rename(path, tomb)
+        except FileNotFoundError:
+            return False
+        return True
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def list_keys(self, prefix: str) -> list[str]:
+        directory = self._path(prefix.rstrip("/"))
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            return []
+        clean = prefix.rstrip("/") + "/"
+        return sorted(
+            clean + name
+            for name in names
+            if not name.endswith(".lock")
+            and ".tmp." not in name and ".dead." not in name
+        )
+
+    def age_s(self, key: str) -> float | None:
+        try:
+            mtime = os.stat(self._path(key)).st_mtime
+        except OSError:
+            return None
+        return max(self._now() - mtime, 0.0)
+
+    def describe(self) -> str:
+        return f"filesystem:{self.root}"
+
+
+class HttpCasStore(Store):
+    """Conditional-put/ETag object-store client (``--elastic URL``).
+
+    Every mutation is one HTTP round trip; conflicts come back as 412
+    (Precondition Failed) and map onto the same False/None returns the
+    filesystem backend produces, so the coordinator protocol above is
+    backend-blind.  Freshness (``age_s``) is the server-computed
+    ``X-SpecPride-Age`` header — a single clock, so a skewed client can
+    never judge a live lease expired early."""
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+
+    def _url(self, key: str) -> str:
+        return f"{self.base_url}/{key}"
+
+    def _request(self, method: str, key: str, body: bytes | None = None,
+                 headers: dict | None = None):
+        req = urllib.request.Request(
+            self._url(key), data=body, method=method,
+            headers=headers or {},
+        )
+        return urllib.request.urlopen(req, timeout=HTTP_TIMEOUT_S)
+
+    def put_new(self, key: str, payload: dict) -> bool:
+        try:
+            with self._request(
+                "PUT", key, _encode(payload), {"If-None-Match": "*"}
+            ):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code == 412:
+                return False
+            raise
+
+    def get(self, key: str) -> tuple[dict, str] | None:
+        try:
+            with self._request("GET", key) as resp:
+                body = resp.read()
+                etag = resp.headers.get("ETag", "")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        payload = _decode(body)
+        if payload is None:
+            return None
+        return payload, etag.strip('"')
+
+    def put(self, key: str, payload: dict) -> None:
+        with self._request("PUT", key, _encode(payload)):
+            pass
+
+    def touch(self, key: str) -> bool:
+        current = self.get(key)
+        if current is None:
+            return False
+        payload, etag = current
+        try:
+            with self._request(
+                "PUT", key, _encode(payload), {"If-Match": f'"{etag}"'}
+            ):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 412):
+                return False
+            raise
+
+    def delete_if(self, key: str, etag: str) -> bool:
+        try:
+            with self._request(
+                "DELETE", key, headers={"If-Match": f'"{etag}"'}
+            ):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 412):
+                return False
+            raise
+
+    def delete(self, key: str) -> None:
+        try:
+            with self._request("DELETE", key):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+    def list_keys(self, prefix: str) -> list[str]:
+        url = f"{self.base_url}/?prefix={urllib.parse.quote(prefix)}"
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=HTTP_TIMEOUT_S) as resp:
+            data = json.loads(resp.read().decode("utf-8"))
+        keys = data.get("keys", []) if isinstance(data, dict) else []
+        return sorted(k for k in keys if isinstance(k, str))
+
+    def age_s(self, key: str) -> float | None:
+        try:
+            with self._request("GET", key) as resp:
+                age = resp.headers.get("X-SpecPride-Age")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        try:
+            return max(float(age), 0.0)
+        except (TypeError, ValueError):
+            return None
+
+    def get_with_age(
+        self, key: str
+    ) -> tuple[dict, str, float | None] | None:
+        """Body, ETag and the server-computed age off ONE GET."""
+        try:
+            with self._request("GET", key) as resp:
+                body = resp.read()
+                etag = resp.headers.get("ETag", "")
+                age = resp.headers.get("X-SpecPride-Age")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        payload = _decode(body)
+        if payload is None:
+            return None
+        try:
+            age_s = max(float(age), 0.0)
+        except (TypeError, ValueError):
+            age_s = None
+        return payload, etag.strip('"'), age_s
+
+    def describe(self) -> str:
+        return f"object-store:{self.base_url}"
+
+
+def store_from_spec(spec: str) -> Store:
+    """``--elastic`` value -> backend: an ``http(s)://`` URL selects the
+    object-store client, anything else is a shared directory."""
+    if spec.startswith(("http://", "https://")):
+        return HttpCasStore(spec)
+    return FsStore(spec)
+
+
+def is_remote_spec(spec: str) -> bool:
+    return spec.startswith(("http://", "https://"))
+
+
+# -- the in-tree CAS test server ----------------------------------------
+
+
+class CasServer:
+    """In-memory conditional-put object store over HTTP — the reference
+    implementation of the contract :class:`HttpCasStore` consumes, so
+    CI's preemption-storm pass and the bench's backend-overhead cell
+    run the REAL wire protocol with no cloud account.
+
+    Semantics (the subset S3/GCS/Azure all offer):
+
+    * ``PUT`` — unconditional replace; ``If-None-Match: *`` = create
+      only (412 if present); ``If-Match: <etag>`` = replace only if
+      unchanged (412 otherwise).  Replies carry the new ``ETag``.
+    * ``GET`` — body + ``ETag`` + ``X-SpecPride-Age`` (seconds since
+      last write, SERVER clock — the skew-proof liveness input).
+      ``GET /?prefix=P`` lists keys.
+    * ``DELETE`` — optional ``If-Match`` precondition.
+
+    ETags are server-assigned revisions (``"<rev>-<sha12>"``): two
+    writes of identical bytes still produce distinct etags, so an
+    etag-guarded steal can never confuse a re-claimed lease with the
+    one it read."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self._lock = threading.Lock()
+        # key -> (body, etag, last_write_monotonic)
+        self._data: dict[str, tuple[bytes, str, float]] = {}
+        self._rev = 0
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # stdlib default spams stderr
+                pass
+
+            def _key(self) -> str:
+                return self.path.lstrip("/").split("?", 1)[0]
+
+            def _reply(self, code: int, body: bytes = b"",
+                       headers: dict | None = None) -> None:
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                if "?" in self.path and "prefix=" in self.path:
+                    prefix = urllib.parse.unquote(
+                        self.path.split("prefix=", 1)[1].split("&", 1)[0]
+                    )
+                    with store._lock:
+                        keys = sorted(
+                            k for k in store._data if k.startswith(prefix)
+                        )
+                    self._reply(
+                        200, json.dumps({"keys": keys}).encode(),
+                        {"Content-Type": "application/json"},
+                    )
+                    return
+                with store._lock:
+                    rec = store._data.get(self._key())
+                    now = time.monotonic()
+                if rec is None:
+                    self._reply(404)
+                    return
+                body, etag, written = rec
+                self._reply(200, body, {
+                    "ETag": f'"{etag}"',
+                    "X-SpecPride-Age": f"{max(now - written, 0.0):.3f}",
+                    "Content-Type": "application/json",
+                })
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                key = self._key()
+                if_none = self.headers.get("If-None-Match")
+                if_match = self.headers.get("If-Match")
+                with store._lock:
+                    existing = store._data.get(key)
+                    if if_none == "*" and existing is not None:
+                        self._reply(412)
+                        return
+                    if if_match is not None and (
+                        existing is None
+                        or existing[1] != if_match.strip('"')
+                    ):
+                        self._reply(412)
+                        return
+                    store._rev += 1
+                    etag = (
+                        f"{store._rev}-"
+                        f"{hashlib.sha256(body).hexdigest()[:12]}"
+                    )
+                    store._data[key] = (body, etag, time.monotonic())
+                self._reply(
+                    201 if existing is None else 200, b"",
+                    {"ETag": f'"{etag}"'},
+                )
+
+            def do_DELETE(self):
+                key = self._key()
+                if_match = self.headers.get("If-Match")
+                with store._lock:
+                    existing = store._data.get(key)
+                    if existing is None:
+                        self._reply(404)
+                        return
+                    if if_match is not None and (
+                        existing[1] != if_match.strip('"')
+                    ):
+                        self._reply(412)
+                        return
+                    del store._data[key]
+                self._reply(204)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "CasServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="specpride-cas-server",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("CAS server listening on %s", self.url)
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -50,15 +50,38 @@ import time
 
 from specpride_tpu.robustness.errors import InjectedFault, LaneHangError
 
-FAULT_SITES = (
+# the chunk executor's lane-boundary sites — every chunked run visits
+# all of these, which is what the ci.sh chaos matrix asserts
+EXECUTOR_FAULT_SITES = (
     "parse", "pack", "prepare", "dispatch", "d2h", "qc", "write",
     "checkpoint_write",
 )
 
-FAULT_KINDS = ("io", "oom", "malformed", "hang", "rank_kill")
+# all injectable sites: the executor lanes plus the elastic
+# coordinator's compare-and-swap ops (`cas` fires only in --elastic
+# runs — the preemption-storm CI pass owns exercising it)
+FAULT_SITES = EXECUTOR_FAULT_SITES + ("cas",)
+
+FAULT_KINDS = (
+    "io", "oom", "malformed", "hang", "rank_kill", "rank_slow",
+    "cas_conflict",
+)
 
 # a hang with no watchdog armed must still end: hard bound on the block
 MAX_HANG_S = 5.0
+
+# per-visit stall of the `rank_slow` kind (a degraded-but-alive host:
+# thermal throttling, a noisy neighbour, a failing disk).  Unlike
+# `hang` it raises NOTHING and the watchdog must not break it — the
+# point is to force the elastic tier's work-stealing, not a retry.
+# Overridable for chaos scenarios via SPECPRIDE_SLOW_S.
+DEFAULT_SLOW_S = 0.5
+
+# fault kinds that perturb the run without failing anything: no
+# recovery event is expected, so audit_fault_recovery must not flag
+# them (a rank_slow rank still commits every chunk — just late; the
+# recovery it forces, a lease_split, is audited by audit_elastic)
+_SELF_RECOVERING_KINDS = frozenset({"rank_slow"})
 
 # which retry-wrapper site recovers a fault fired at SITE: the pack-lane
 # wrapper covers everything the pack stage runs (materialization,
@@ -73,6 +96,10 @@ _RECOVERY_SITES = {
     "qc": ("qc",),
     "write": ("write",),
     "checkpoint_write": ("checkpoint_write",),
+    # coordinator compare-and-swap races: the recovery is the
+    # coordinator's own conflict handler (lose gracefully, re-scan),
+    # journaled as a zero-backoff retry at the same site
+    "cas": ("cas",),
 }
 
 
@@ -96,6 +123,13 @@ class InjectedValueError(ValueError, InjectedFault):
 
 class InjectedHang(LaneHangError, InjectedFault):
     pass
+
+
+class InjectedCasConflict(RuntimeError, InjectedFault):
+    """A coordinator compare-and-swap lost its race (injected stand-in
+    for a real 412/EEXIST under contention).  The coordinator catches
+    it at the op boundary and loses gracefully — it never propagates
+    into the executor."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +272,21 @@ class FaultPlan:
 
     def _raise(self, site: str, spec: FaultSpec, visit: int) -> None:
         msg = f"injected {spec.kind} fault at {site} (visit {visit})"
+        if spec.kind == "rank_slow":
+            # a slow-but-alive rank: stall this visit, then CONTINUE —
+            # nothing fails, heartbeats keep renewing the lease, and
+            # the per-chunk wall the rank publishes climbs until a
+            # peer's work-stealing handshake relieves it.  Deliberately
+            # immune to the watchdog's hang-cancel: slowness is not a
+            # stall the lane can break.
+            try:
+                slow_s = float(os.environ.get("SPECPRIDE_SLOW_S", "") or 0)
+            except ValueError:
+                slow_s = 0.0
+            time.sleep(slow_s if slow_s > 0 else DEFAULT_SLOW_S)
+            return
+        if spec.kind == "cas_conflict":
+            raise InjectedCasConflict(msg)
         if spec.kind == "rank_kill":
             # chaos-CI rank death: SIGKILL this process at a site
             # boundary — no handlers, no atexit, no flushes beyond the
@@ -329,7 +378,11 @@ def audit_fault_recovery(events: list[dict]) -> list[dict]:
     ``--on-error skip`` outcome).  Each recovery event backs at most
     one fault.  Returns the faults left unmatched — the chaos CI pass
     asserts this list is empty."""
-    faults = [e for e in events if e.get("event") == "fault"]
+    faults = [
+        e for e in events
+        if e.get("event") == "fault"
+        and e.get("kind") not in _SELF_RECOVERING_KINDS
+    ]
     recoveries = [
         e for e in events
         if e.get("event") in (

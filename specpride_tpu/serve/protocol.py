@@ -93,6 +93,8 @@ DAEMON_ONLY_FLAGS = (
     # daemon, not a rank (an in-job coordinator would lease ranges and
     # bind ports inside the daemon process)
     "--elastic",
+    "--elastic-steal",
+    "--elastic-local",
     "--metrics-port",
     # jax has ONE global profiler session per process: a per-job device
     # trace would race concurrent worker lanes (and any `specpride
@@ -157,7 +159,8 @@ def forbidden_flags(argv: list[str]) -> list[str]:
 _DAEMON_OWNED_DESTS = (
     "compile_cache", "routing_table", "layout", "force_device",
     "mesh", "coordinator", "num_processes", "process_id", "metrics_out",
-    "elastic", "metrics_port", "trace_dir",
+    "elastic", "elastic_steal", "elastic_local", "metrics_port",
+    "trace_dir",
 )
 
 _daemon_owned_defaults: dict | None = None

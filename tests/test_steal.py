@@ -1,0 +1,468 @@
+"""Elastic tier 2: live work-stealing (split handshake, donor fence,
+steal-half policy), the warm-spare fleet supervisor, the object-store
+coordinator end to end, split-aware merging/stats, and the submit
+retry satellite."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.io.mgf import write_mgf
+from specpride_tpu.observability.journal import read_events
+from specpride_tpu.parallel.coordinator import Coordinator
+from specpride_tpu.parallel.elastic import (
+    audit_elastic,
+    elastic_range_table,
+    summarize_ranks,
+)
+from specpride_tpu.parallel.fleet import FleetSupervisor, extract_flag
+from specpride_tpu.parallel.store import CasServer
+from specpride_tpu.robustness.errors import LeaseExpiredError
+
+from conftest import make_cluster
+
+
+class RecordingJournal:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        rec = {"event": event, "ts": time.time(),
+               "mono": time.perf_counter(), **fields}
+        self.events.append(rec)
+        return rec
+
+    def close(self):
+        pass
+
+
+# -- the split handshake, unit level -------------------------------------
+
+
+def _pair(tmp_path, n=12, chunk=2, **kw):
+    ja, jb = RecordingJournal(), RecordingJournal()
+    a = Coordinator(str(tmp_path), 0, n, n, ttl=5.0, journal=ja,
+                    chunk_hint=chunk, **kw)
+    b = Coordinator(str(tmp_path), 1, n, n, ttl=5.0, journal=jb,
+                    chunk_hint=chunk, **kw)
+    return a, b, ja, jb
+
+
+def test_steal_handshake_moves_the_tail(tmp_path):
+    """Propose -> ratify (steal-half, at a chunk boundary) -> claim:
+    the donor journals lease_split, the stealer journals the paired
+    chunk_reassign, and the overlay range covers exactly the ceded
+    suffix."""
+    a, b, ja, jb = _pair(tmp_path)
+    try:
+        assert a.claim_next().range.range_id == 0
+        # donor progress: 2 chunks of 2 committed
+        a.commit_fence(0, max_idx=1, n_clusters=2,
+                       chunk_t0=time.perf_counter() - 0.1)
+        a.commit_fence(0, max_idx=3, n_clusters=2,
+                       chunk_t0=time.perf_counter() - 0.1)
+        a._beat()
+        assert b.claim_next() is None  # everything leased
+
+        got = {}
+        th = threading.Thread(
+            target=lambda: got.update(c=b.try_steal(poll_timeout=3.0))
+        )
+        th.start()
+        time.sleep(0.2)
+        # donor's dispatch lane reaches the next chunk (local idx 4):
+        # remaining 8 -> donor keeps 4, cedes [8, 12)
+        clip = a.clip_or_ratify(0, next_min_idx=4)
+        assert clip == 8
+        th.join()
+        tail = got["c"]
+        assert tail is not None
+        assert (tail.range.start, tail.range.stop) == (8, 12)
+        assert tail.range.parent == 0 and tail.range.from_rank == 0
+        splits = [e for e in ja.events if e["event"] == "lease_split"]
+        assert splits and splits[0]["split_at"] == 8
+        assert splits[0]["new_range"] == tail.range.range_id
+        re = [e for e in jb.events if e["event"] == "chunk_reassign"]
+        assert re and re[0]["range"] == tail.range.range_id
+        assert re[0]["from_rank"] == 0 and re[0]["to_rank"] == 1
+        assert not audit_elastic(ja.events + jb.events)
+        assert a.lease_splits == 1 and b.steals == 1
+        # the donor's effective range narrowed; commits below the cut
+        # pass, the stolen suffix fences
+        assert a.effective_range(0).stop == 8
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_donor_fences_on_commit_of_stolen_suffix(tmp_path):
+    """A donor whose lease was split MUST get LeaseExpiredError on its
+    next commit at/past the cut — the backstop that makes a zombie
+    donor safe even if it never ran the dispatch-lane clip."""
+    a, b, ja, jb = _pair(tmp_path)
+    try:
+        assert a.claim_next() is not None
+        a._beat()
+        got = {}
+        th = threading.Thread(
+            target=lambda: got.update(c=b.try_steal(poll_timeout=3.0))
+        )
+        th.start()
+        time.sleep(0.2)
+        assert a.clip_or_ratify(0, next_min_idx=4) == 8
+        th.join()
+        assert got["c"] is not None
+        a.commit_fence(0, max_idx=7, n_clusters=2)  # below the cut: fine
+        with pytest.raises(LeaseExpiredError):
+            a.commit_fence(0, max_idx=8, n_clusters=2)
+        # the lease itself is still the donor's (only the suffix moved)
+        a.check_lease(0)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_donor_keeps_first_chunk_and_declines_when_empty(tmp_path):
+    a, b, ja, jb = _pair(tmp_path)
+    try:
+        assert a.claim_next() is not None
+        nonce = a._held[0].nonce
+        b.store.put_new(
+            b._proposal_key(0, nonce),
+            {"parent": 0, "stealer_rank": 1, "donor_nonce": nonce},
+        )
+        # nothing submitted yet: never cede the first chunk
+        assert a.clip_or_ratify(0, next_min_idx=0) is None
+        # on the LAST chunk: decline with a published cut so the
+        # stealer's poll terminates instead of timing out
+        assert a.clip_or_ratify(0, next_min_idx=10) is None
+        cut = a.store.get(a._cut_key(0, nonce))
+        assert cut is not None and cut[0]["new_range"] is None
+        assert not [e for e in ja.events if e["event"] == "lease_split"]
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_donor_defers_its_own_split_tail(tmp_path):
+    """The donor must not re-claim the tail it just ceded (it is the
+    slow rank by construction); after a full expiry window unclaimed,
+    it may."""
+    a, b, ja, jb = _pair(tmp_path)
+    try:
+        assert a.claim_next() is not None
+        a._beat()
+        got = {}
+        th = threading.Thread(
+            target=lambda: got.update(c=b.try_steal(poll_timeout=3.0))
+        )
+        th.start()
+        time.sleep(0.2)
+        assert a.clip_or_ratify(0, next_min_idx=4) == 8
+        th.join()
+        tail_id = got["c"].range.range_id
+        b.release(tail_id)  # stealer abandons (simulates its death)
+        # donor finishes + releases its narrowed range; its scan must
+        # NOT pick the tail back up inside the expiry window
+        a.release(0)
+        a.commit(0, {"output_bytes": 0, "sha256": "x"})
+        claim = a.claim_next()
+        assert claim is None
+        # fake the window having passed: age the overlay record
+        path = os.path.join(
+            str(tmp_path), "overlay", f"range_{tail_id:05d}.json"
+        )
+        old = time.time() - 60
+        os.utime(path, (old, old))
+        claim = a.claim_next()
+        assert claim is not None and claim.range.range_id == tail_id
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_audit_flags_unclaimed_split():
+    events = [
+        {"event": "lease_split", "range": 0, "new_range": 5, "rank": 0,
+         "split_at": 8},
+    ]
+    assert len(audit_elastic(events)) == 1
+    events.append({"event": "chunk_reassign", "range": 5, "from_rank": 0,
+                   "to_rank": 1})
+    assert not audit_elastic(events)
+
+
+def test_elastic_range_table_rejects_gaps(tmp_path):
+    coord = Coordinator(str(tmp_path), 0, 10, 5, ttl=5.0)
+    coord.stop()
+    table, problem = elastic_range_table(str(tmp_path))
+    assert problem is None
+    assert [(r["start"], r["stop"]) for r in table] == [(0, 5), (5, 10)]
+    coord2 = Coordinator(str(tmp_path), 0, 10, 5, ttl=5.0)
+    # an overlay ALLOCATION marker with no referencing cut record is
+    # debris from a donor that died mid-handshake: invisible, the
+    # parent stays whole and the table stays valid
+    coord2.store.put_new(
+        "overlay/range_00002.json",
+        {"range_id": 2, "start": 3, "stop": 10, "parent": 1},
+    )
+    table, problem = elastic_range_table(str(tmp_path))
+    assert problem is None
+    assert [(r["start"], r["stop"]) for r in table] == [(0, 5), (5, 10)]
+    # a tampered CUT whose tail overlaps the plan must refuse
+    coord2.store.put_new(
+        "split/range_00001.cut.deadbeef.json",
+        {"cut": 3, "new_range": 2, "stop": 10, "parent": 1},
+    )
+    coord2.stop()
+    table, problem = elastic_range_table(str(tmp_path))
+    assert table is None and "tile" in problem
+
+
+# -- stats: slow marker + split counters ---------------------------------
+
+
+def test_stats_slow_marker_and_split_rollup(capsys):
+    base = time.time()
+    donor = [
+        {"event": "heartbeat", "rank": 0, "holding": [0], "ttl": 1.0,
+         "ts": base},
+        {"event": "lease_claim", "rank": 0, "range": 0, "takeover": False,
+         "ts": base},
+        {"event": "lease_split", "range": 0, "new_range": 2, "rank": 0,
+         "split_at": 8, "ts": base + 0.5},
+    ]
+    stealer = [
+        {"event": "heartbeat", "rank": 1, "holding": [], "ttl": 1.0,
+         "ts": base + 5.0},
+        {"event": "chunk_reassign", "range": 2, "from_rank": 0,
+         "to_rank": 1, "via": "lease_split", "ts": base + 1.0},
+    ]
+    view = summarize_ranks([donor, stealer])
+    assert view["lease_splits"] == 1
+    assert view["unpaired_lease_expiries"] == 0
+    r0, r1 = view["ranks"]["0"], view["ranks"]["1"]
+    # rank 0: silent for 5s with TTL 1 while holding a lease, never
+    # expired -> stale-but-alive
+    assert r0["slow"] is True and r0["lease_splits"] == 1
+    assert r1["slow"] is False and r1["steals"] == 1
+
+    from specpride_tpu.observability.stats_cli import _render_rank_view
+
+    out = io.StringIO()
+    _render_rank_view(view, out)
+    text = out.getvalue()
+    assert "slow: " in text and "1 split(s)" in text
+    assert "lease_splits=1" in text and "steals=1" in text
+
+
+# -- end to end ----------------------------------------------------------
+
+
+def _write_input(tmp_path, rng, n):
+    clusters = [
+        make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=20)
+        for i in range(n)
+    ]
+    src = tmp_path / "clustered.mgf"
+    write_mgf([s for c in clusters for s in c.members], src)
+    return src
+
+
+def _serial_golden(tmp_path, src, backend="tpu"):
+    out = tmp_path / "serial.mgf"
+    qc = tmp_path / "serial_qc.json"
+    assert cli_main([
+        "consensus", str(src), str(out), "--method", "bin-mean",
+        "--backend", backend, "--qc-report", str(qc),
+    ]) == 0
+    return out.read_bytes(), qc.read_bytes()
+
+
+def test_forced_steal_two_ranks_byte_identical(tmp_path, rng):
+    """The tier-2 acceptance scenario in miniature: a rank_slow-
+    handicapped donor and a fast peer; the peer must steal a split of
+    the donor's range (lease_split paired with chunk_reassign) and the
+    merged output + QC report stay byte-identical to serial."""
+    src = _write_input(tmp_path, rng, 24)
+    golden, golden_qc = _serial_golden(tmp_path, src)
+    out = tmp_path / "out.mgf"
+    coord = tmp_path / "coord"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    slow_env = dict(
+        env, SPECPRIDE_FAULTS="dispatch:rank_slow:1:0:9999",
+        SPECPRIDE_SLOW_S="0.4",
+    )
+
+    def argv(rank):
+        return [
+            sys.executable, "-m", "specpride_tpu", "consensus", str(src),
+            str(out), "--method", "bin-mean",
+            "--elastic", str(coord), "--process-id", str(rank),
+            "--elastic-range", "12", "--checkpoint-every", "2",
+            "--elastic-ttl", "2",
+            "--qc-report", f"{out}.qc.json",
+            "--journal", str(tmp_path / "j.jsonl"),
+        ]
+
+    procs = [
+        subprocess.Popen(argv(0), env=slow_env,
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.PIPE),
+        subprocess.Popen(argv(1), env=env, stdout=subprocess.DEVNULL,
+                         stderr=subprocess.PIPE),
+    ]
+    for p in procs:
+        _, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err.decode()[-3000:]
+    assert cli_main([
+        "merge-parts", str(out), "--elastic", str(coord),
+        "--qc-report", f"{out}.qc.json",
+    ]) == 0
+    assert out.read_bytes() == golden
+    assert (tmp_path / "out.mgf.qc.json").read_bytes() == golden_qc
+    events = []
+    for r in (0, 1):
+        ev, violations = read_events(str(tmp_path / f"j.jsonl.part0000{r}"))
+        assert not violations, violations[:5]
+        events += ev
+    splits = [e for e in events if e["event"] == "lease_split"]
+    assert splits, "the slow rank was never relieved"
+    reassigns = [
+        e for e in events
+        if e["event"] == "chunk_reassign" and e.get("via") == "lease_split"
+    ]
+    assert any(e["to_rank"] == 1 for e in reassigns)
+    assert not audit_elastic(events)
+    ends = [e for e in events if e["event"] == "run_end"]
+    assert sum(e["elastic"]["lease_splits"] for e in ends) == len(splits)
+    assert sum(e["elastic"]["steals"] for e in ends) >= 1
+
+
+def test_object_store_elastic_byte_identical(tmp_path, rng):
+    """A full elastic run against the in-tree CAS object store — no
+    coordinator directory at all — merges byte-identically, and the
+    coordinator state (plan/lease/done) lives server-side."""
+    src = _write_input(tmp_path, rng, 8)
+    golden, golden_qc = _serial_golden(tmp_path, src)
+    server = CasServer().start()
+    try:
+        out = tmp_path / "os.mgf"
+        assert cli_main([
+            "consensus", str(src), str(out), "--method", "bin-mean",
+            "--elastic", server.url, "--process-id", "0",
+            "--elastic-range", "3", "--checkpoint-every", "1",
+            "--qc-report", f"{out}.qc.json",
+            "--journal", str(tmp_path / "jos.jsonl"),
+        ]) == 0
+        assert cli_main([
+            "merge-parts", str(out), "--elastic", server.url,
+            "--qc-report", f"{out}.qc.json",
+        ]) == 0
+        assert out.read_bytes() == golden
+        assert (tmp_path / "os.mgf.qc.json").read_bytes() == golden_qc
+        # coordination state went through the store, not the filesystem
+        assert server._data.get("plan.json") is not None
+        assert [k for k in server._data if k.startswith("done/")]
+        ev, violations = read_events(str(tmp_path / "jos.jsonl.part00000"))
+        assert not violations
+        end = [e for e in ev if e["event"] == "run_end"][-1]
+        assert end["elastic"]["backend"].startswith("object-store:")
+    finally:
+        server.stop()
+
+
+# -- fleet supervisor ----------------------------------------------------
+
+
+def test_fleet_supervises_to_completion(tmp_path, rng):
+    """`specpride fleet --ranks 2` drives an elastic run to exit 0 with
+    journaled rank_spawn events and a byte-identical merge."""
+    src = _write_input(tmp_path, rng, 8)
+    golden, _ = _serial_golden(tmp_path, src, backend="numpy")
+    out = tmp_path / "out.mgf"
+    coord = tmp_path / "coord"
+    fj = tmp_path / "fleet.jsonl"
+    assert cli_main([
+        "fleet", "--ranks", "2", "--timeout", "180",
+        "--journal", str(fj), "--",
+        "consensus", str(src), str(out), "--method", "bin-mean",
+        "--backend", "numpy",
+        "--elastic", str(coord), "--elastic-range", "3",
+        "--checkpoint-every", "1", "--elastic-ttl", "2",
+    ]) == 0
+    assert cli_main([
+        "merge-parts", str(out), "--elastic", str(coord),
+    ]) == 0
+    assert out.read_bytes() == golden
+    events, violations = read_events(str(fj))
+    assert not violations
+    spawns = [e for e in events if e["event"] == "rank_spawn"]
+    assert len(spawns) == 2
+    assert all(e["reason"] == "boot" for e in spawns)
+
+
+def test_fleet_requires_elastic_and_rejects_process_id(tmp_path):
+    with pytest.raises(ValueError):
+        FleetSupervisor(["consensus", "a", "b"], ranks=1)
+    with pytest.raises(ValueError):
+        FleetSupervisor(
+            ["consensus", "a", "b", "--elastic", str(tmp_path),
+             "--process-id", "0"],
+            ranks=1,
+        )
+    assert extract_flag(["--elastic=x", "--elastic", "y"], "--elastic") == "y"
+
+
+# -- submit --retry -------------------------------------------------------
+
+
+def test_submit_retry_backs_off_on_retriable(tmp_path):
+    """With no daemon listening, every attempt is retriable: --retry 2
+    must make exactly 3 attempts with journaled backoff lines and still
+    exit 75."""
+    sock = str(tmp_path / "no-daemon.sock")
+    buf = io.StringIO()
+    t0 = time.perf_counter()
+    with redirect_stdout(buf):
+        rc = cli_main([
+            "submit", "--socket", sock, "--retry", "2",
+            "--retry-backoff", "0.05", "--timeout", "0.2",
+            "--", "consensus", "in.mgf", "out.mgf",
+        ])
+    elapsed = time.perf_counter() - t0
+    assert rc == 75
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    errors = [m for m in lines if m.get("status") == "error"]
+    retries = [m for m in lines if m.get("status") == "retrying"]
+    assert len(errors) == 3 and len(retries) == 2
+    assert retries[0]["attempt"] == 1 and retries[1]["attempt"] == 2
+    # exponential: second wait ~2x the first, plus deterministic jitter
+    assert retries[1]["backoff_s"] > retries[0]["backoff_s"]
+    assert elapsed >= retries[0]["backoff_s"] + retries[1]["backoff_s"]
+
+
+def test_submit_no_retry_by_default(tmp_path):
+    sock = str(tmp_path / "no-daemon.sock")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main([
+            "submit", "--socket", sock, "--timeout", "0.2",
+            "--", "consensus", "in.mgf", "out.mgf",
+        ])
+    assert rc == 75
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert not [m for m in lines if m.get("status") == "retrying"]

@@ -1,0 +1,246 @@
+"""Pluggable coordinator store: filesystem + object-store (CAS) backend
+contract, the in-tree CAS server, clock-skew liveness judgment, and
+injected compare-and-swap conflicts."""
+
+import time
+
+import pytest
+
+from specpride_tpu.parallel.coordinator import Coordinator
+from specpride_tpu.parallel.store import (
+    CasServer,
+    FsStore,
+    HttpCasStore,
+    is_remote_spec,
+    store_from_spec,
+)
+from specpride_tpu.robustness import faults
+
+
+@pytest.fixture()
+def cas_server():
+    server = CasServer().start()
+    yield server
+    server.stop()
+
+
+def _contract(store):
+    """The op contract both backends must satisfy identically."""
+    # create-if-absent: exactly one winner
+    assert store.put_new("leases/range_00000.json", {"nonce": "a"})
+    assert not store.put_new("leases/range_00000.json", {"nonce": "b"})
+    payload, etag = store.get("leases/range_00000.json")
+    assert payload["nonce"] == "a"
+    # touch refreshes freshness without changing content
+    time.sleep(0.05)
+    before = store.age_s("leases/range_00000.json")
+    assert store.touch("leases/range_00000.json")
+    after = store.age_s("leases/range_00000.json")
+    assert after is not None and after <= before
+    got = store.get("leases/range_00000.json")
+    assert got[0]["nonce"] == "a"
+    # compare-and-delete: stale token loses, current token wins
+    assert not store.delete_if("leases/range_00000.json", "bogus-etag")
+    current_etag = store.get("leases/range_00000.json")[1]
+    assert store.delete_if("leases/range_00000.json", current_etag)
+    assert store.get("leases/range_00000.json") is None
+    assert not store.delete_if("leases/range_00000.json", current_etag)
+    # unconditional put: last writer wins; listing sees only live keys
+    store.put("hb/rank_00000.json", {"rank": 0})
+    store.put("hb/rank_00000.json", {"rank": 0, "ts": 1})
+    store.put("hb/rank_00001.json", {"rank": 1})
+    assert store.list_keys("hb/") == [
+        "hb/rank_00000.json", "hb/rank_00001.json",
+    ]
+    assert store.get("hb/rank_00000.json")[0]["ts"] == 1
+    # absent keys
+    assert store.get("nope.json") is None
+    assert store.age_s("nope.json") is None
+    assert not store.touch("nope.json")
+    store.delete("hb/rank_00001.json")
+    assert store.list_keys("hb/") == ["hb/rank_00000.json"]
+
+
+def test_fs_store_contract(tmp_path):
+    _contract(FsStore(str(tmp_path)))
+
+
+def test_http_store_contract(cas_server):
+    _contract(HttpCasStore(cas_server.url))
+
+
+def test_fs_etag_stable_under_touch_distinct_per_content(tmp_path):
+    """The filesystem token is content-derived: a renewal (utime) keeps
+    it, a re-created lease (fresh nonce) changes it — so an expiry
+    steal's compare-and-delete can never confuse the two."""
+    store = FsStore(str(tmp_path))
+    store.put_new("leases/r.json", {"nonce": "first"})
+    etag = store.get("leases/r.json")[1]
+    store.touch("leases/r.json")
+    assert store.get("leases/r.json")[1] == etag
+    store.delete("leases/r.json")
+    store.put_new("leases/r.json", {"nonce": "second"})
+    assert store.get("leases/r.json")[1] != etag
+
+
+def test_http_etag_changes_per_revision(cas_server):
+    """The object-store token is a server revision: even identical
+    bytes re-written produce a fresh token (a stealer holding the old
+    one loses, as it must)."""
+    store = HttpCasStore(cas_server.url)
+    store.put_new("k.json", {"x": 1})
+    e1 = store.get("k.json")[1]
+    assert store.touch("k.json")  # same body, new revision
+    e2 = store.get("k.json")[1]
+    assert e1 != e2
+    assert not store.delete_if("k.json", e1)
+    assert store.delete_if("k.json", e2)
+
+
+def test_fs_tombstone_left_behind(tmp_path):
+    """A filesystem compare-and-delete renames to a tombstone — steal
+    debris stays on disk for post-mortems, and listings hide it."""
+    store = FsStore(str(tmp_path))
+    store.put_new("leases/r.json", {"nonce": "x"})
+    etag = store.get("leases/r.json")[1]
+    assert store.delete_if("leases/r.json", etag)
+    leftovers = list((tmp_path / "leases").iterdir())
+    assert leftovers and ".dead." in leftovers[0].name
+    assert store.list_keys("leases/") == []
+
+
+def test_store_from_spec_dispatch(tmp_path):
+    assert isinstance(store_from_spec(str(tmp_path)), FsStore)
+    assert isinstance(
+        store_from_spec("http://127.0.0.1:1/x"), HttpCasStore
+    )
+    assert is_remote_spec("https://host/bucket")
+    assert not is_remote_spec(str(tmp_path))
+
+
+def test_http_age_is_server_clock(cas_server):
+    """Liveness age comes from the SERVER's clock: a skewed client
+    reads the same age any other observer would."""
+    store = HttpCasStore(cas_server.url)
+    store.put("hb/r.json", {"rank": 0})
+    age = store.age_s("hb/r.json")
+    assert age is not None and age < 1.0
+    time.sleep(0.15)
+    age2 = store.age_s("hb/r.json")
+    assert age2 > age
+
+
+# -- clock skew must not early-steal ------------------------------------
+
+
+def test_skewed_observer_cannot_steal_inside_grace(tmp_path):
+    """An observer whose clock runs ahead must NOT judge a live lease
+    expired inside the TTL + grace window: with TTL=1s (grace 0.5s) and
+    a +1.2s skew the lease looks 1.2s old — past the TTL but inside the
+    grace — so the claim attempt yields nothing and the holder keeps
+    its range.  Past TTL + grace the same observer may steal."""
+    holder = Coordinator(str(tmp_path), 0, 4, 4, ttl=1.0)
+    claim = holder.claim_next()
+    assert claim is not None
+    observer = Coordinator(str(tmp_path), 1, 4, 4, ttl=1.0)
+    real_now = time.time
+    try:
+        # skew: past TTL, inside grace -> no steal
+        observer.store._now = lambda: real_now() + 1.2
+        assert observer.claim_next() is None
+        holder.check_lease(0)  # holder is untouched
+        # skew past TTL + grace -> the lease is fair game
+        observer.store._now = lambda: real_now() + 2.0
+        stolen = observer.claim_next()
+        assert stolen is not None and stolen.takeover
+    finally:
+        holder.stop()
+        observer.stop()
+
+
+def test_renewal_resets_the_skewed_window(tmp_path):
+    """A heartbeat renewal restarts the age even under observer skew —
+    only a rank that STOPS renewing can be stolen from."""
+    holder = Coordinator(str(tmp_path), 0, 4, 4, ttl=0.4,
+                         heartbeat_interval=0.1)
+    assert holder.claim_next() is not None
+    observer = Coordinator(str(tmp_path), 1, 4, 4, ttl=0.4)
+    real_now = time.time
+    try:
+        observer.store._now = lambda: real_now() + 0.5
+        # the holder's heartbeat thread renews every 0.1s: repeated
+        # scans across > TTL+grace of wall time never find it expired
+        deadline = time.perf_counter() + 1.5
+        while time.perf_counter() < deadline:
+            assert observer.claim_next() is None
+            time.sleep(0.1)
+        holder.check_lease(0)
+    finally:
+        holder.stop()
+        observer.stop()
+
+
+# -- injected CAS conflicts ---------------------------------------------
+
+
+class RecordingJournal:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        rec = {"event": event, **fields}
+        self.events.append(rec)
+        return rec
+
+    def close(self):
+        pass
+
+
+def test_cas_conflict_injection_loses_gracefully(tmp_path):
+    """An injected `cas` conflict makes the claim attempt lose like a
+    real race: no lease lands, a `retry` event (site=cas) journals the
+    recovery, and the next scan claims normally."""
+    journal = RecordingJournal()
+    plan = faults.FaultPlan.parse("cas:cas_conflict:1", seed=0)
+    prev = faults.install(plan, journal=journal)
+    try:
+        coord = Coordinator(str(tmp_path), 0, 4, 4, ttl=5.0,
+                            journal=journal)
+        try:
+            assert coord.claim_next() is None  # conflict injected
+            assert coord.cas_conflicts == 1
+            claim = coord.claim_next()  # plan MAX=1: second scan clean
+            assert claim is not None
+            retries = [
+                e for e in journal.events
+                if e["event"] == "retry" and e.get("site") == "cas"
+            ]
+            assert len(retries) == 1
+            fired = [e for e in journal.events if e["event"] == "fault"]
+            assert fired and fired[0]["kind"] == "cas_conflict"
+            merged = journal.events
+            assert not faults.audit_fault_recovery(merged)
+        finally:
+            coord.stop()
+    finally:
+        faults.install(prev)
+
+
+def test_rank_slow_stalls_without_failing(monkeypatch):
+    """`rank_slow` delays the visit and returns — no exception, and the
+    recovery audit does not expect one."""
+    monkeypatch.setenv("SPECPRIDE_SLOW_S", "0.05")
+    plan = faults.FaultPlan.parse("dispatch:rank_slow:1:0:3", seed=0)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        plan.check("dispatch")  # must NOT raise
+    assert time.perf_counter() - t0 >= 0.15
+    assert plan.fired_by_site["dispatch"] == 3
+    events = [
+        {"event": "fault", "site": "dispatch", "kind": "rank_slow",
+         "visit": i, "mono": float(i)}
+        for i in range(3)
+    ]
+    assert not faults.audit_fault_recovery(events)
