@@ -715,6 +715,100 @@ print(f"worker pool OK: {n_done} jobs byte-identical across 2 lanes "
 EOF
 rm -rf "$wp_tmp"
 
+echo "== serve: cross-job micro-batching (--batch-window 25, shared dispatch) =="
+# boot a 2-lane daemon with the 25ms batch window and the telemetry
+# plane armed, fire a 6-job two-tenant burst of same-method small jobs
+# (one python process, six threads — they arrive together, so the
+# window coalesces them), and assert: >= 1 batch_dispatch journaled
+# with jobs >= 2, EVERY job's output + QC byte-identical to the solo
+# CLI run, the batch metrics on the drain snapshot pass the strict
+# exposition check, and `stats` renders the batching: line
+mb_tmp=$(mktemp -d)
+MB_IN=tests/data/golden_clustered.mgf
+MBSOCK="$mb_tmp/serve.sock"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    serve --socket "$MBSOCK" --compile-cache "$mb_tmp/cache" \
+    --journal "$mb_tmp/serve.jsonl" --workers 2 --max-queue 32 \
+    --batch-window 25 --metrics-port 0 --metrics-out "$mb_tmp/serve.prom" &
+MB_PID=$!
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$MBSOCK" <<'EOF'
+import sys
+from specpride_tpu.serve.client import wait_for_socket
+assert wait_for_socket(sys.argv[1], timeout=180), "batch daemon never came up"
+EOF
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$MB_IN" "$mb_tmp/cli.mgf" --method bin-mean \
+    --qc-report "$mb_tmp/cli.qc.json"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - \
+    "$MBSOCK" "$MB_IN" "$mb_tmp" <<'EOF'
+import sys, threading
+from specpride_tpu.serve import client as sc
+sock, src, tmp = sys.argv[1:4]
+terms = {}
+def submit(i):
+    tenant = "tenantA" if i % 2 == 0 else "tenantB"
+    terms[i] = sc.submit_wait(
+        sock,
+        ["consensus", src, f"{tmp}/burst_{i}.mgf", "--method", "bin-mean",
+         "--qc-report", f"{tmp}/burst_{i}.qc.json"],
+        client=tenant, timeout=600,
+    )
+threads = [threading.Thread(target=submit, args=(i,)) for i in range(6)]
+for t in threads: t.start()
+for t in threads: t.join()
+bad = {i: t for i, t in terms.items() if t.get("status") != "done"}
+assert not bad, bad
+batched = [t for t in terms.values() if t.get("batch")]
+print(f"burst OK: 6 jobs done, {len(batched)} rode a shared dispatch")
+EOF
+kill -TERM $MB_PID
+MB_RC=0; wait $MB_PID || MB_RC=$?
+test "$MB_RC" -eq 0
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$mb_tmp" <<'EOF'
+import json, os, sys
+tmp = sys.argv[1]
+from specpride_tpu.observability.journal import read_events
+events, violations = read_events(os.path.join(tmp, "serve.jsonl"))
+assert not violations, violations
+shared = [e for e in events if e["event"] == "batch_dispatch"
+          and e.get("status") == "shared"]
+assert shared, "the 6-job burst must coalesce at least one shared dispatch"
+assert any(e["n_jobs"] >= 2 for e in shared), shared
+done = [e for e in events if e["event"] == "job_done"]
+assert len(done) == 6 and all(e["status"] == "done" for e in done), done
+# byte + QC parity for EVERY burst job vs the solo CLI run
+golden = open(os.path.join(tmp, "cli.mgf"), "rb").read()
+golden_qc = json.load(open(os.path.join(tmp, "cli.qc.json")))
+for i in range(6):
+    got = open(os.path.join(tmp, f"burst_{i}.mgf"), "rb").read()
+    assert got == golden, f"burst_{i}: batched output diverged from solo CLI"
+    qc = json.load(open(os.path.join(tmp, f"burst_{i}.qc.json")))
+    assert qc == golden_qc, f"burst_{i}: batched QC report diverged"
+# strict exposition check on the drain snapshot, batch series included
+from specpride_tpu.observability.exporter import parse_exposition
+text = open(os.path.join(tmp, "serve.prom")).read()
+samples, problems = parse_exposition(text)
+assert not problems, problems
+names = {name for name, _ in samples}
+for need in ("specpride_serve_batch_dispatches_total",
+             "specpride_serve_batch_jobs_total",
+             "specpride_serve_batch_clusters_total",
+             "specpride_serve_batch_occupancy",
+             "specpride_serve_batch_jobs_per_dispatch_bucket",
+             "specpride_serve_batch_window_wait_seconds_bucket"):
+    assert need in names, f"missing batch series {need}"
+n_disp = samples[("specpride_serve_batch_dispatches_total", ())]
+n_batched = samples[("specpride_serve_batch_jobs_total", ())]
+assert n_disp == len(shared), (n_disp, len(shared))
+assert n_batched == sum(e["n_jobs"] for e in shared), n_batched
+print(f"micro-batching OK: {len(shared)} shared dispatch(es) covering "
+      f"{int(n_batched)} of 6 jobs, byte+QC parity for all, "
+      "batch metrics strictly valid")
+EOF
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$mb_tmp/serve.jsonl" | grep -q "batching:"
+rm -rf "$mb_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

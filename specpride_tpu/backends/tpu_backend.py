@@ -1503,6 +1503,62 @@ class TpuBackend:
         indices = self.medoid_indices(clusters, config)
         return [c.members[i] for c, i in zip(clusters, indices)]
 
+    # -- cross-job shared dispatch (serve.batcher) -----------------------
+
+    def run_shared(
+        self, method: str, parts, config, cos_config=None
+    ) -> list:
+        """Run one consensus/select method over clusters from SEVERAL
+        sources as ONE batch-scoped prepare + dispatch group — the
+        device half of the serving daemon's cross-job micro-batching
+        (``serve.batcher``).  ``parts`` is a list of cluster lists (one
+        per tenant job); they are merged into a single pack input, so
+        the bucket planner fills buckets across jobs and the fixed
+        dispatch overhead is paid once instead of per job.
+
+        Per-cluster independence (the same property that makes output
+        chunk-invariant) guarantees each cluster's representative — and
+        its QC cosine, computed when ``cos_config`` is given — is
+        bit-identical to a solo run over that source alone; provenance
+        spans from ``merge_cluster_sources`` scatter results back.
+
+        Returns one ``(representatives, cosines-or-None)`` pair per
+        source, aligned with that source's cluster order."""
+        from specpride_tpu.data.packed import merge_cluster_sources
+
+        merged, spans = merge_cluster_sources(parts)
+        cosines = None
+        if method == "bin-mean":
+            if cos_config is not None:
+                reps, cosines = self.run_bin_mean_with_cosines(
+                    merged, config, cos_config
+                )
+            else:
+                reps = self.run_bin_mean(merged, config)
+        elif method == "gap-average":
+            reps = self.run_gap_average(merged, config)
+        elif method == "medoid":
+            reps = self.run_medoid(merged, config)
+        else:
+            raise ValueError(f"method {method!r} is not batchable")
+        if len(reps) != len(merged):
+            # a method dropped clusters (should not happen for the
+            # batchable methods, which are total): the span scatter
+            # would misalign — refuse rather than mis-scatter
+            raise RuntimeError(
+                f"shared {method} dispatch returned {len(reps)} "
+                f"representatives for {len(merged)} clusters"
+            )
+        if cos_config is not None and cosines is None:
+            cosines = self.average_cosines(reps, merged, cos_config)
+        out = []
+        for start, stop in spans:
+            out.append((
+                reps[start:stop],
+                None if cosines is None else cosines[start:stop],
+            ))
+        return out
+
     # -- best-spectrum representative (host-only; ref src/best_spectrum.py) --
 
     def run_best_spectrum(

@@ -2788,10 +2788,21 @@ def cmd_serve(args) -> int:
         raise SystemExit(str(e))
     if args.workers < 0:
         raise SystemExit(f"--workers must be >= 0 (got {args.workers})")
+    if args.batch_window < 0:
+        raise SystemExit(
+            f"--batch-window must be >= 0 ms (got {args.batch_window})"
+        )
+    if args.batch_max_clusters < 1:
+        raise SystemExit(
+            "--batch-max-clusters must be >= 1 "
+            f"(got {args.batch_max_clusters})"
+        )
     return ServeDaemon(
         args.socket,
         max_queue=args.max_queue,
         workers=args.workers,
+        batch_window=args.batch_window / 1000.0,
+        batch_max_clusters=args.batch_max_clusters,
         quotas=quotas,
         compile_cache=args.compile_cache,
         routing_table=args.routing_table,
@@ -3469,6 +3480,23 @@ def build_parser() -> argparse.ArgumentParser:
         "distinct outputs run concurrently; same-output jobs are "
         "serialized by the conflict guard.  Default 0 = min(#local jax "
         "devices, 4); 1 = the single-lane daemon",
+    )
+    psv.add_argument(
+        "--batch-window", type=float, default=0.0, metavar="MS",
+        help="cross-job micro-batching: a worker popping a batch-"
+        "eligible job waits up to MS milliseconds collecting further "
+        "COMPATIBLE queued jobs (same method + config digest; same "
+        "weighted-fair/quota/conflict eligibility as a normal pop) and "
+        "runs their cluster work as ONE shared packed-bucket device "
+        "dispatch — per-job outputs stay byte-identical to solo runs, "
+        "and the shared dispatch is journaled as batch_dispatch.  "
+        "Default 0 = off (every job dispatches alone, the PR 10 "
+        "behavior)",
+    )
+    psv.add_argument(
+        "--batch-max-clusters", type=int, default=4096, metavar="N",
+        help="size bound for one shared dispatch: stop collecting once "
+        "the batch's merged cluster count reaches N (default 4096)",
     )
     psv.add_argument(
         "--quota", metavar="CLIENT=WEIGHT[:MAX_INFLIGHT],...",

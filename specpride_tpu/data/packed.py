@@ -165,6 +165,30 @@ class GapPackedBatch:
     source_indices: list[int]
 
 
+def merge_cluster_sources(
+    parts: "Sequence[Sequence[Cluster]]",
+) -> tuple[list, list[tuple[int, int]]]:
+    """Concatenate cluster lists from several SOURCES (the serving
+    daemon's cross-job micro-batching: each source is one tenant job's
+    parsed input) into ONE pack/dispatch input, with provenance spans
+    for scattering per-cluster results back to each owning source.
+
+    Every consensus/select method is per-cluster, so the merged list
+    flows through the ordinary pack functions — which then build ONE
+    bucket plan covering all sources instead of one under-filled plan
+    per job — and per-cluster results are sliced back out by span.
+    Returns ``(merged, spans)`` where ``spans[i] = (start, stop)`` is
+    source ``i``'s half-open slice of ``merged`` (and of any
+    cluster-aligned result list computed from it)."""
+    merged: list = []
+    spans: list[tuple[int, int]] = []
+    for part in parts:
+        start = len(merged)
+        merged.extend(part)
+        spans.append((start, len(merged)))
+    return merged, spans
+
+
 # ---------------------------------------------------------------------------
 # Shared vectorized grouping machinery
 # ---------------------------------------------------------------------------

@@ -221,11 +221,64 @@ class ServeTelemetry:
         )
         for method, seconds in self.slo.items():
             slo_objective_g.set(seconds, method=method)
+        # cross-job micro-batching (serve.batcher): shared-dispatch
+        # accounting.  Counters and the occupancy gauge pre-register at
+        # 0 so the series exist from the first scrape through the final
+        # --metrics-out drain snapshot — a 0-valued row beats an absent
+        # one for rate() queries AND for auditing that batching never
+        # fired (the histograms appear with the first dispatch)
+        self.batch_dispatches = r.counter(
+            "specpride_serve_batch_dispatches_total",
+            "shared packed-bucket device dispatches coalescing work "
+            "from multiple jobs",
+        )
+        self.batch_jobs = r.counter(
+            "specpride_serve_batch_jobs_total",
+            "served jobs whose compute rode a shared batch dispatch",
+        )
+        self.batch_clusters = r.counter(
+            "specpride_serve_batch_clusters_total",
+            "clusters computed through shared batch dispatches",
+        )
+        self.batch_jobs_hist = r.histogram(
+            "specpride_serve_batch_jobs_per_dispatch",
+            "jobs coalesced into each shared dispatch",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+        )
+        self.batch_window_wait = r.histogram(
+            "specpride_serve_batch_window_wait_seconds",
+            "batch-collection time per shared dispatch (companion wait "
+            "bounded by --batch-window, plus member input parses)",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0),
+        )
+        self.batch_occupancy = r.gauge(
+            "specpride_serve_batch_occupancy",
+            "bucket occupancy (real rows / padded rows) of the most "
+            "recent shared dispatch",
+        )
+        self.batch_dispatches.inc(0)
+        self.batch_jobs.inc(0)
+        self.batch_clusters.inc(0)
+        self.batch_occupancy.set(0.0)
 
     # -- event hooks (worker / reader threads) -------------------------
 
     def job_rejected(self, reason: str) -> None:
         self.jobs_rejected.inc(1, reason=reason)
+
+    def batch_dispatch(
+        self, *, n_jobs: int, n_clusters: int, window_wait_s: float,
+        occupancy_frac: float,
+    ) -> None:
+        """Fold one shared cross-job dispatch into the live plane (the
+        journal's ``batch_dispatch`` event carries the same numbers)."""
+        self.batch_dispatches.inc(1)
+        self.batch_jobs.inc(int(n_jobs))
+        self.batch_clusters.inc(int(n_clusters))
+        self.batch_jobs_hist.observe(float(n_jobs))
+        self.batch_window_wait.observe(float(window_wait_s))
+        self.batch_occupancy.set(float(occupancy_frac))
 
     def job_done(
         self, *, command: str, method: str | None, status: str,

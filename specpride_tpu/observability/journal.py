@@ -115,6 +115,18 @@ EVENT_FIELDS: dict[str, frozenset] = {
     # additive fields, so pre-SLO consumers keep validating
     "job_done": frozenset({"job_id", "status", "wall_s"}),
     "job_rejected": frozenset({"reason"}),
+    # cross-job micro-batching (serve.batcher): one SHARED packed-bucket
+    # dispatch coalescing several jobs' cluster work.  `jobs` lists the
+    # member job ids; `n_clusters` is the merged size; `window_wait_s`
+    # the collection wait; occupancy/fresh-compile/plan-cache deltas
+    # attribute the one dispatch's device work that no single job's
+    # run_end can claim.  status="shared" ran the coalesced dispatch;
+    # "fallback_solo" means the shared pass failed and every member ran
+    # solo (additive fields — pre-batching consumers keep validating).
+    "batch_dispatch": frozenset(
+        {"batch_id", "jobs", "n_jobs", "n_clusters", "window_wait_s",
+         "status"}
+    ),
     "serve_drain": frozenset({"n_rejected"}),
     # on-demand device profiling (`specpride profile` against a live
     # daemon): one bounded jax.profiler capture window

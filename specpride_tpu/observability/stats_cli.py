@@ -208,6 +208,41 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
                 row["max_latency_s"] = round(row["max_latency_s"], 4)
             sv["slo"] = slo
             sv["slo_breaches"] = sum(r["breaches"] for r in slo.values())
+        # cross-job micro-batching: shared-dispatch rollup from the
+        # batch_dispatch events (jobs coalesced, merged clusters, bucket
+        # occupancy, window wait) — the journal-side view of the
+        # specpride_serve_batch_* exposition
+        shared_b = [
+            e for e in events
+            if e["event"] == "batch_dispatch" and e.get("status") == "shared"
+        ]
+        fellback = sum(
+            1 for e in events
+            if e["event"] == "batch_dispatch"
+            and e.get("status") == "fallback_solo"
+        )
+        if shared_b or fellback:
+            bt: dict = {
+                "dispatches": len(shared_b),
+                "batched_jobs": sum(e.get("n_jobs", 0) for e in shared_b),
+                "clusters": sum(e.get("n_clusters", 0) for e in shared_b),
+            }
+            if shared_b:
+                bt["max_jobs"] = max(e.get("n_jobs", 0) for e in shared_b)
+                bt["mean_occupancy"] = round(
+                    sum(e.get("bucket_occupancy_frac", 0.0)
+                        for e in shared_b) / len(shared_b), 4,
+                )
+                bt["mean_window_wait_s"] = round(
+                    sum(e.get("window_wait_s", 0.0) for e in shared_b)
+                    / len(shared_b), 4,
+                )
+                bt["fresh_compiles"] = sum(
+                    e.get("fresh_compiles", 0) for e in shared_b
+                )
+            if fellback:
+                bt["fallback_solo"] = fellback
+            sv["batching"] = bt
         monos = [
             e["mono"] for e in jobs if isinstance(e.get("mono"), (int, float))
         ]
@@ -294,6 +329,28 @@ def _render_serving(sv: dict, out) -> None:
     if "n_workers" in sv:
         bits.append(f"workers={sv['n_workers']}")
     print(f"  serving: {' '.join(bits)}", file=out)
+    # cross-job micro-batching rollup (daemons booted with
+    # --batch-window): how much work rode shared dispatches
+    bt = sv.get("batching")
+    if bt:
+        bbits = [
+            f"dispatches={bt.get('dispatches', 0)}",
+            f"jobs={bt.get('batched_jobs', 0)}",
+            f"clusters={bt.get('clusters', 0)}",
+        ]
+        if "max_jobs" in bt:
+            bbits.append(f"max_jobs={bt['max_jobs']}")
+        if "mean_occupancy" in bt:
+            bbits.append(f"mean_occupancy={bt['mean_occupancy']}")
+        if "mean_window_wait_s" in bt:
+            bbits.append(
+                f"mean_window_wait_s={bt['mean_window_wait_s']}"
+            )
+        if "fresh_compiles" in bt:
+            bbits.append(f"fresh_compiles={bt['fresh_compiles']}")
+        if bt.get("fallback_solo"):
+            bbits.append(f"fallback_solo={bt['fallback_solo']}")
+        print(f"  batching: {' '.join(bbits)}", file=out)
     # per-lane rollup (multi-worker daemons): which lane ran what, and
     # how busy it was — the journal-side view of the exporter's
     # specpride_serve_worker_busy_seconds_total{worker}

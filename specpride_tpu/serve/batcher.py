@@ -1,0 +1,321 @@
+"""Cross-job micro-batching for the serving daemon (ROADMAP item 3(b)).
+
+The paper's workloads are MANY SMALL per-cluster consensus computations,
+and through PR 10 the worker pool still dispatched each tenant job's
+packed buckets to the device alone — BENCH_r14 plateaued at 1.75x on
+small jobs because per-job dispatches under-fill buckets and pay the
+fixed dispatch overhead per job.  This module coalesces cluster work
+from multiple queued jobs into SHARED packed-bucket device dispatches:
+
+* **Compatibility key** (:func:`batch_key`) — jobs may share a dispatch
+  only when one device program can serve them all: same command +
+  method, byte-identical method/QC config (a digest of the constructed
+  config objects, so argparse spelling differences cannot split
+  compatible jobs or merge incompatible ones), same backend, and the
+  daemon's one platform.  Anything with job-scoped execution semantics
+  (elastic/mesh/multi-host flags, fault injection, ``--on-error skip``
+  quarantine, streamed/mzML inputs, best-spectrum's per-job score
+  source) is ineligible and runs solo exactly as before.
+
+* **Collection** — the worker that pops a batch-eligible job becomes
+  the batch LEADER: it pulls further compatible jobs from the admission
+  queue (``AdmissionQueue.pop_compatible`` — same weighted-fair order,
+  same inflight-quota and output-conflict eligibility as a normal pop,
+  so scheduling policy is unchanged by batching), bounded by
+  ``--batch-window`` (max wait for the first companion) and
+  ``--batch-max-clusters`` (merged size).  A window that closes empty
+  degenerates to the solo path untouched.
+
+* **Shared dispatch** (:func:`compute_shared`) — each job's input is
+  parsed once (through the ingest-cache residency), identical inputs
+  are computed ONCE and fanned out, and distinct inputs are merged by
+  ``data.packed.merge_cluster_sources`` into one
+  ``TpuBackend.run_shared`` pack + dispatch group with provenance
+  spans for the scatter.
+
+* **Scatter with byte parity** — every job still runs the exact CLI
+  execution body (``cli._run_pipeline_command``) through its own
+  QC/write/checkpoint lanes; only its backend is wrapped in
+  :class:`BatchResultBackend`, a read-only view serving the batch's
+  precomputed per-cluster results (and QC cosines) by cluster id.
+  Because every batchable method is per-cluster, the precomputed
+  results are bit-identical to a solo run's, so each job's output
+  bytes, QC report and checkpoint manifest match its solo CLI run —
+  the same parity bar every other serving feature is held to.  Any
+  cluster the shared pass did not cover (or a shared-dispatch failure)
+  falls back to the real backend / a solo run, never to a wrong
+  answer.
+
+Attribution: the shared dispatch's compile-cache, bucket-plan and
+device-counter deltas cannot be charged to any single job — they ride
+the daemon journal's ``batch_dispatch`` event (jobs, clusters, bucket
+occupancy, window wait, fresh compiles, plan traffic) and the
+``specpride_serve_batch_*`` exposition instead, while each job's own
+``run_end`` snapshot-and-diff accounting keeps reporting only the work
+performed on its own lane (near zero when served from the batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+from specpride_tpu.observability import RunStats
+
+# methods whose results are a pure per-cluster function of the input +
+# config — the precondition for sharing a dispatch across jobs.
+# best-spectrum is excluded: its result depends on a per-job score
+# source (--msms/--psms), which is not part of the cluster data.
+BATCHABLE_METHODS = ("bin-mean", "gap-average", "medoid")
+
+
+def _eager_input(args) -> bool:
+    """True when the job will parse its input EAGERLY (a materialized
+    cluster list the batch can merge) — mirrors ``cli._load_clusters``'s
+    streaming decision so eligibility never diverges from execution."""
+    import os
+
+    from specpride_tpu.cli import _STREAM_AUTO_BYTES
+
+    if args.input.endswith(".gz"):
+        # the ingest cache refuses .gz, so the batch parse could not be
+        # reused by the job's own pipeline — it would parse twice
+        return False
+    mode = (getattr(args, "stream_clusters", "off") or "off").lower()
+    if mode == "off":
+        return True
+    if mode != "auto":
+        return False  # explicit stream window
+    try:
+        return os.path.getsize(args.input) < _STREAM_AUTO_BYTES
+    except OSError:
+        return False  # unreadable input: solo run surfaces the error
+
+
+def config_digest(args, command: str) -> str | None:
+    """Digest of the CONSTRUCTED method (+ QC cosine) config — the
+    portion of a job's argv that must be byte-identical for two jobs to
+    share one device program.  Hashing the built config objects, not the
+    argv, makes the key immune to flag spelling/ordering.  None when the
+    config does not build (the solo run will report the usage error)."""
+    from specpride_tpu import cli
+
+    try:
+        cfg = cli._method_config(args.method, args)
+    except (ValueError, SystemExit):
+        return None
+    parts = [command, args.method, repr(cfg)]
+    if getattr(args, "qc_report", None):
+        parts.append(repr(cli._cosine_config(args)))
+    else:
+        parts.append("noqc")
+    h = hashlib.blake2b("\x00".join(parts).encode(), digest_size=8)
+    return h.hexdigest()
+
+
+def batch_key(args, command: str) -> tuple | None:
+    """The (method, config-digest, backend) compatibility key admission
+    stamps on a batch-eligible job, or None when the job must run solo.
+
+    Conservative by design: everything that carries job-scoped execution
+    semantics beyond the per-cluster compute — multi-host/elastic modes,
+    fault injection, quarantine parsing, streamed or mzML inputs, the
+    whole-file ``--single`` collapse — is ineligible, and stays on the
+    PR 7/10 solo path byte-for-byte."""
+    from specpride_tpu.cli import _is_mzml
+
+    if command not in ("consensus", "select"):
+        return None
+    if getattr(args, "method", None) not in BATCHABLE_METHODS:
+        return None
+    if getattr(args, "backend", "tpu") != "tpu":
+        return None
+    if (
+        getattr(args, "elastic", None)
+        or getattr(args, "coordinator", None)
+        or getattr(args, "mesh", False)
+        or getattr(args, "inject_faults", None)
+        or getattr(args, "single", False)
+        or getattr(args, "on_error", "abort") == "skip"
+    ):
+        return None
+    if _is_mzml(args.input) or not _eager_input(args):
+        return None
+    digest = config_digest(args, command)
+    if digest is None:
+        return None
+    return (command, args.method, digest)
+
+
+def parse_batch_input(args, worker: int):
+    """Parse one batch member's input through the serving ingest-cache
+    residency (the job's own pipeline re-parse then hits the cache, so
+    the batch pays each distinct input's parse once).  Returns the
+    eagerly parsed cluster list; raises whatever the parser raises —
+    the caller then lets the job run solo so the error surfaces through
+    its own lane exactly as without batching."""
+    from specpride_tpu import cli
+
+    args._serve_worker = worker  # the daemon's _execute sets it too
+    clusters = cli._load_clusters_served(args, RunStats(), None)
+    if not isinstance(clusters, list):  # streamed despite eligibility
+        raise TypeError("batch members must parse to an eager list")
+    return clusters
+
+
+@dataclasses.dataclass
+class SharedResults:
+    """One job's slice of a shared dispatch: representatives (and QC
+    cosines when the batch carries QC jobs) keyed by cluster id."""
+
+    reps_by_id: dict
+    cos_by_id: dict | None
+
+
+def compute_shared(backend, args0, entries) -> dict:
+    """Run the batch's ONE shared prepare + dispatch group.
+
+    ``entries`` is ``[(job, clusters), ...]``; jobs whose parsed input
+    is the SAME object (the ingest cache returns one resident list per
+    unchanged file) share a single compute, and distinct inputs merge
+    into one ``run_shared`` pack.  Returns ``{job_id: SharedResults}``.
+    Raises on any failure — the daemon then runs every member solo, so
+    a poisoned batch degrades to exactly the unbatched behavior."""
+    from specpride_tpu import cli
+
+    method = args0.method
+    config = cli._method_config(method, args0)
+    cos_config = (
+        cli._cosine_config(args0)
+        if getattr(args0, "qc_report", None) else None
+    )
+    parts: list = []
+    part_of: dict[int, int] = {}
+    for _, clusters in entries:
+        key = id(clusters)
+        if key not in part_of:
+            part_of[key] = len(parts)
+            parts.append(clusters)
+    results = backend.run_shared(
+        method, parts, config, cos_config=cos_config
+    )
+    out: dict = {}
+    for job, clusters in entries:
+        reps, cosines = results[part_of[id(clusters)]]
+        out[job.job_id] = SharedResults(
+            reps_by_id={
+                c.cluster_id: r for c, r in zip(clusters, reps)
+            },
+            cos_by_id=(
+                None if cosines is None else {
+                    c.cluster_id: float(v)
+                    for c, v in zip(clusters, cosines)
+                }
+            ),
+        )
+    return out
+
+
+class BatchResultBackend:
+    """Per-job read-only view over the worker's resident backend,
+    serving the batch's precomputed per-cluster results.
+
+    The job's ``cli._run_pipeline_command`` runs UNCHANGED — journal,
+    QC finalize, ordered writes, checkpoint manifests, run_end
+    accounting — against this wrapper: the ``run_*`` entry points
+    return the shared dispatch's results for the requested clusters
+    (bit-identical to a solo run by per-cluster independence), and
+    everything else (attributes, state resets, any cluster the shared
+    pass did not cover) forwards to the real resident backend, so a
+    partial or failed share can only cost work, never correctness.
+    ``supports_prepare`` is False: with results precomputed there is
+    nothing for the pack lane to run ahead of, and output stays
+    byte-identical because it is chunk-invariant by contract."""
+
+    def __init__(self, inner, shared: SharedResults):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_reps", shared.reps_by_id)
+        object.__setattr__(self, "_cos", shared.cos_by_id)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_inner"), name)
+
+    def __setattr__(self, name, value):
+        # per-job state resets (stats, journal hook, pack accounting)
+        # must land on the REAL backend the telemetry reads
+        setattr(object.__getattribute__(self, "_inner"), name, value)
+
+    # -- precomputed lookups --------------------------------------------
+
+    def _lookup(self, clusters):
+        reps = object.__getattribute__(self, "_reps")
+        out = []
+        for c in clusters:
+            r = reps.get(c.cluster_id)
+            if r is None:
+                return None
+            out.append(r)
+        return out
+
+    def _cos_lookup(self, clusters):
+        cos = object.__getattribute__(self, "_cos")
+        if cos is None:
+            return None
+        out = np.zeros(len(clusters), dtype=np.float64)
+        for i, c in enumerate(clusters):
+            v = cos.get(c.cluster_id)
+            if v is None:
+                return None
+            out[i] = v
+        return out
+
+    # -- the execution surface cli._run_method / QC consume --------------
+
+    def supports_prepare(self, method: str) -> bool:
+        return False
+
+    def prepare_chunk(self, *args, **kwargs):
+        return None
+
+    def run_prepared(self, prepared):
+        return self._inner.run_prepared(prepared)
+
+    def run_bin_mean(self, clusters, config):
+        got = self._lookup(clusters)
+        if got is not None:
+            return got
+        return self._inner.run_bin_mean(clusters, config)
+
+    def run_bin_mean_with_cosines(self, clusters, config, cos_config):
+        got = self._lookup(clusters)
+        cos = self._cos_lookup(clusters)
+        if got is not None and cos is not None:
+            return got, cos
+        return self._inner.run_bin_mean_with_cosines(
+            clusters, config, cos_config
+        )
+
+    def run_gap_average(self, clusters, config):
+        got = self._lookup(clusters)
+        if got is not None:
+            return got
+        return self._inner.run_gap_average(clusters, config)
+
+    def run_medoid(self, clusters, config):
+        got = self._lookup(clusters)
+        if got is not None:
+            return got
+        return self._inner.run_medoid(clusters, config)
+
+    def run_best_spectrum(self, clusters, scores, config):
+        return self._inner.run_best_spectrum(clusters, scores, config)
+
+    def average_cosines(self, representatives, clusters, config):
+        cos = self._cos_lookup(clusters)
+        if cos is not None:
+            return cos
+        return self._inner.average_cosines(
+            representatives, clusters, config
+        )

@@ -39,6 +39,20 @@ plan-cache scope, the worker's own device registry — never a process
 total), so every job's ``run_end`` reports its own compile/plan-cache
 traffic even with other jobs in flight concurrently.
 
+Cross-job micro-batching (``--batch-window MS`` + ``serve.batcher``):
+a worker that pops a batch-ELIGIBLE job (same-method, config-digest-
+compatible, solo-semantics jobs — admission stamps the key) pulls
+further compatible jobs from the queue — same weighted-fair order,
+same quota/conflict eligibility — for up to the window, merges their
+parsed clusters into ONE shared packed-bucket dispatch on its resident
+backend (``TpuBackend.run_shared``), and then runs each job's ordinary
+pipeline against the precomputed per-cluster results, so every job's
+output bytes, QC report and checkpoint manifest stay byte-identical to
+its solo CLI run.  The shared dispatch's compile/plan/device deltas
+ride the journal's ``batch_dispatch`` event and the
+``specpride_serve_batch_*`` exposition; a window that closes empty (or
+a failed shared pass) degenerates to the solo path untouched.
+
 Robustness: the request loop is guarded by the shared error taxonomy —
 transient socket errors on accept retry with a short backoff instead of
 killing the daemon, execution errors are classified
@@ -84,7 +98,7 @@ class Job:
 
     __slots__ = (
         "job_id", "client", "argv", "args", "command", "conn", "fh",
-        "t_enqueued", "ack",
+        "t_enqueued", "ack", "batch_key",
     )
 
     def __init__(self, job_id, client, argv, args, command, conn, fh):
@@ -100,6 +114,9 @@ class Job:
         # worker (or drain) waits on it before the terminal line, so
         # the two threads can never interleave bytes on one connection
         self.ack = threading.Event()
+        # cross-job micro-batching compatibility key (serve.batcher),
+        # stamped at admission when the daemon batches; None = solo
+        self.batch_key = None
 
 
 def _job_claimed_paths(job: "Job") -> list[str]:
@@ -137,6 +154,8 @@ class ServeDaemon:
         metrics_host: str = "127.0.0.1",
         metrics_out: str | None = None,
         slo: dict | None = None,
+        batch_window: float = 0.0,
+        batch_max_clusters: int = 4096,
     ):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.compile_cache = compile_cache
@@ -171,6 +190,25 @@ class ServeDaemon:
         self.jobs_done = 0
         self.jobs_failed = 0
         self.jobs_rejected = 0
+        # cross-job micro-batching (serve.batcher): a worker that pops a
+        # batch-eligible job pulls further COMPATIBLE queued jobs for up
+        # to batch_window seconds (0 = off) and runs their cluster work
+        # as one shared packed-bucket dispatch, bounded by
+        # batch_max_clusters merged clusters; per-job outputs stay
+        # byte-identical to solo runs (see serve.batcher)
+        self.batch_window = max(float(batch_window), 0.0)
+        self.batch_max_clusters = max(int(batch_max_clusters), 1)
+        self.batches_dispatched = 0
+        self.jobs_batched = 0
+        self._batch_ids = iter(range(1, 1 << 62)).__next__
+        # wid -> jobs collected into its current batch but not yet
+        # executing (the sampler folds them into the in-flight view)
+        self._batch_backlog: dict[int, list] = {}
+        # every client that ever had a job admitted: the drain-time
+        # metrics snapshot renders their queue-depth series at 0 instead
+        # of dropping the rows (live scrapes keep clear-and-set so
+        # departed clients don't linger as stale series forever)
+        self._clients_seen: set[str] = set()
         # done/failed increment on CONCURRENT worker threads now, and
         # jobs_rejected on reader threads (and drain): every
         # read-modify-write needs its lock or bursts undercount
@@ -314,6 +352,9 @@ class ServeDaemon:
             boot_s=round(boot_s, 4),
             workers=len(self.slots),
             placement=[slot.describe() for slot in self.slots],
+            **({"batch_window_s": self.batch_window,
+                "batch_max_clusters": self.batch_max_clusters}
+               if self.batch_window > 0 else {}),
             **({"metrics_port": self.exporter.port}
                if self.exporter is not None else {}),
             **({"slo": self.slo} if self.slo else {}),
@@ -337,15 +378,27 @@ class ServeDaemon:
         not the state at the last job boundary."""
         telemetry.queue_depth.set(len(self.queue))
         # per-client depths are an ephemeral label set: clear-and-set so
-        # departed clients don't linger as stale series forever
+        # departed clients don't linger as stale series forever — EXCEPT
+        # at drain, where the final --metrics-out snapshot renders every
+        # client ever admitted at 0 (clear-and-set alone would silently
+        # drop the rows from the one exposition a drained daemon leaves
+        # behind, hiding which tenants it served)
         telemetry.queue_depth_client.clear()
+        if self._draining:
+            # sorted() snapshots the set in one C-level pass — admission
+            # threads may still be adding concurrently at drain onset
+            for client in sorted(self._clients_seen):
+                telemetry.queue_depth_client.set(0, client=client)
         for client, n in self.queue.depths().items():
             telemetry.queue_depth_client.set(n, client=str(client))
         # in-flight zeroes (not clears): once a (command, method) pair
         # has run, its series stays visible at 0 — scrapers see the drop
         telemetry.inflight.zero_all()
         inflight = dict(self._inflight_by)  # point-in-time lane view
-        telemetry.inflight_total.set(len(inflight))
+        # list() snapshots the values in one C-level pass: workers
+        # insert/pop backlog entries while scrapes render
+        backlog = sum(len(v) for v in list(self._batch_backlog.values()))
+        telemetry.inflight_total.set(len(inflight) + backlog)
         counts: dict[tuple, int] = {}
         for job in inflight.values():
             key = (
@@ -587,6 +640,13 @@ class ServeDaemon:
             )
         job = Job(job_id, client or id(conn), argv, args,
                   argv[0], conn, fh)
+        if self.batch_window > 0:
+            # admission marks batch-eligible jobs: the compatibility key
+            # is computed ONCE here (reader thread) so the worker-side
+            # collector only compares tuples
+            from specpride_tpu.serve import batcher
+
+            job.batch_key = batcher.batch_key(args, job.command)
         try:
             admitted = self.queue.offer(job.client, job)
         except QuotaExceeded as e:
@@ -598,9 +658,12 @@ class ServeDaemon:
             return reject(
                 "draining" if self._draining else "queue_full", True
             )
+        self._clients_seen.add(str(job.client))
         self.journal.emit(
             "job_queued", job_id=job_id, client=str(job.client),
             command=job.command, method=getattr(args, "method", None),
+            **({"batch_eligible": job.batch_key is not None}
+               if self.batch_window > 0 else {}),
         )
         try:
             protocol.write_msg(
@@ -768,103 +831,307 @@ class ServeDaemon:
     # -- execution lane -------------------------------------------------
 
     def _worker_loop(self, wid: int) -> None:
-        from specpride_tpu.warmstart import cache as ws_cache
-
         while True:
             job = self.queue.pop()
             if job is None:
                 return
             self._inflight_by[wid] = job
             self._gate.wait()
-            wait_s = time.perf_counter() - job.t_enqueued
-            self.journal.emit(
-                "job_start", job_id=job.job_id, command=job.command,
-                method=getattr(job.args, "method", None),
-                queue_wait_s=round(wait_s, 4), worker=wid,
-            )
-            t0 = time.perf_counter()
-            # THREAD-scoped compile counters: every compile a job causes
-            # fires on the worker thread that dispatched it, so this
-            # delta is the job's own even with other lanes compiling
-            # concurrently (the process-wide snapshot would cross-
-            # attribute between in-flight jobs)
-            cc0 = ws_cache.thread_counters_snapshot()
-            status, rc, err, retriable, summary = "done", 0, None, False, None
-            try:
-                with self.watchdog.section("serve:job"):
-                    summary = self._execute(job, wid)
-            except SystemExit as e:
-                # CLI-style usage/abort error (bad input file, refused
-                # resume): permanent from the daemon's point of view
-                status, rc = "error", 1
-                err = str(e.code) if not isinstance(e.code, int) else \
-                    f"exit {e.code}"
-            except BaseException as e:  # noqa: BLE001 - reported to client
-                status, rc = "error", 1
-                err = f"{type(e).__name__}: {e}"
-                retriable = rb_errors.is_transient(e)
-            wall = time.perf_counter() - t0
-            cc = ws_cache.thread_counters_delta(cc0)
-            with self._counts_lock:
-                if status == "done":
-                    self.jobs_done += 1
-                else:
-                    self.jobs_failed += 1
-            # fold the finished job into the live metric plane; the SLO
-            # evaluation (objective, measured latency, ok/breach) rides
-            # the journal's job_done so `stats --slo` and /metrics agree
-            slo_fields = self.telemetry.job_done(
-                command=job.command,
-                method=getattr(job.args, "method", None),
-                status=status, wall_s=wall, queue_wait_s=wait_s,
-                summary=summary if isinstance(summary, dict) else None,
-                worker=wid,
-            )
-            self.journal.emit(
-                "job_done", job_id=job.job_id, status=status,
-                wall_s=round(wall, 4), queue_wait_s=round(wait_s, 4),
-                command=job.command,
-                method=getattr(job.args, "method", None),
-                fresh_compiles=cc.get("misses", 0),
-                worker=wid,
-                **slo_fields,
-                **({"error": err} if err else {}),
-            )
-            job.ack.wait(timeout=10.0)  # admission line strictly first
-            try:
-                if status == "done":
-                    protocol.write_msg(
-                        job.fh, ok=True, status="done", job_id=job.job_id,
-                        rc=rc, wall_s=round(wall, 4),
-                        queue_wait_s=round(wait_s, 4), stats=summary,
-                        compile_cache=cc, worker=wid,
-                    )
-                else:
-                    protocol.write_msg(
-                        job.fh, ok=False, status="error", job_id=job.job_id,
-                        error=err, retriable=retriable,
-                    )
-            except (OSError, ValueError):
-                # the client went away while its job ran (ValueError:
-                # the admission path already closed the fh after a
-                # failed accepted-write); the output is on disk
-                # regardless — log, never crash the lane
-                logger.warning(
-                    "job %d: client disconnected before the terminal "
-                    "response", job.job_id,
+            batch, parsed, window_wait = self._collect_batch(job, wid)
+            if parsed is None:
+                # solo: exactly the PR 10 path (batching off, the job
+                # ineligible, or the window closed empty)
+                self._run_job(job, wid)
+            else:
+                self._batch_backlog[wid] = list(batch[1:])
+                shared, batch_info = self._shared_dispatch(
+                    batch, parsed, wid, window_wait
                 )
-            self._close(job.conn, job.fh)
+                for j in batch:
+                    self._inflight_by[wid] = j
+                    backlog = self._batch_backlog.get(wid)
+                    if backlog and j in backlog:
+                        backlog.remove(j)
+                    # only members actually served from the shared
+                    # results carry batch fields: a failed shared pass
+                    # (or a member whose parse failed) runs solo and
+                    # must not report itself as batched — the
+                    # batch_dispatch event still records the attempt
+                    s = (shared or {}).get(j.job_id)
+                    self._run_job(
+                        j, wid, shared=s,
+                        batch_info=batch_info if s is not None else None,
+                    )
+                self._batch_backlog.pop(wid, None)
             self._inflight_by.pop(wid, None)
-            # free the client's inflight-quota slot and the job's
-            # conflict-guard paths only AFTER the terminal write and
-            # close: a same-output successor popping earlier could start
-            # rewriting the file a reader still attributes to this job
-            self.queue.release(job)
 
-    def _execute(self, job: Job, wid: int) -> dict:
+    def _collect_batch(self, leader: Job, wid: int):
+        """Micro-batch collection (the leader lane's window): pull
+        further COMPATIBLE queued jobs — same weighted-fair order, same
+        quota/conflict eligibility as a normal pop — and parse each
+        member's input through the ingest-cache residency, until the
+        merged cluster budget is met or the window closes.  The window
+        bounds the wait for the FIRST companion; once companions are on
+        board an empty queue dispatches immediately (idling a lane past
+        that point only adds latency).  Drain closes the window early:
+        jobs already collected commit, jobs still queued are rejected
+        retriable by the drain as always.
+
+        Returns ``(batch, parsed, window_wait_s)``; ``parsed`` is None
+        for the solo path (batching off / ineligible leader / window
+        closed empty), else ``{job_id: clusters-or-None}`` (None marks
+        a member whose parse failed — it runs solo inside the batch so
+        the error surfaces through its own lane)."""
+        key = leader.batch_key
+        if key is None or self.batch_window <= 0:
+            return [leader], None, 0.0
+        from specpride_tpu.serve import batcher
+
+        t0 = time.perf_counter()
+        parsed: dict[int, list | None] = {}
+        try:
+            parsed[leader.job_id] = batcher.parse_batch_input(
+                leader.args, wid
+            )
+        except BaseException:  # noqa: BLE001 - solo run surfaces it
+            return [leader], None, 0.0
+        batch = [leader]
+        total = len(parsed[leader.job_id])
+        # the companion-wait deadline anchors AFTER the leader's parse:
+        # anchored at t0, a parse >= the window would expire it before
+        # the wait loop ever ran, silently degrading batching to
+        # already-queued jobs in exactly the small-job regime it targets
+        deadline = time.perf_counter() + self.batch_window
+        while total < self.batch_max_clusters:
+            nxt = self.queue.pop_compatible(
+                lambda j: j.batch_key == key
+            )
+            if nxt is not None:
+                batch.append(nxt)
+                try:
+                    clusters = batcher.parse_batch_input(nxt.args, wid)
+                except BaseException:  # noqa: BLE001 - member runs solo
+                    parsed[nxt.job_id] = None
+                else:
+                    parsed[nxt.job_id] = clusters
+                    total += len(clusters)
+                continue
+            if len(batch) > 1:
+                break  # companions on board: dispatch, don't idle
+            if self._stop.is_set() or self._draining:
+                break  # drain: commit what we hold
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            time.sleep(min(0.002, remaining))
+        window_wait = time.perf_counter() - t0
+        if len(batch) == 1:
+            # degenerate path: the window closed empty — run solo (the
+            # leader's parse stays resident in the ingest cache, so
+            # nothing was wasted)
+            return [leader], None, window_wait
+        return batch, parsed, window_wait
+
+    def _shared_dispatch(self, batch, parsed, wid: int, window_wait):
+        """Run the batch's ONE shared prepare + dispatch group on this
+        lane's resident backend and journal the ``batch_dispatch``
+        attribution (jobs, merged clusters, bucket occupancy, window
+        wait, fresh compiles, plan-cache traffic — the deltas no single
+        job's run_end can claim).  Returns ``(shared, batch_info)``;
+        ``shared`` is None when the shared pass failed — every member
+        then runs solo, so a poisoned batch degrades to exactly the
+        unbatched behavior."""
+        from specpride_tpu.data.packed import (
+            PlanCacheScope,
+            set_plan_scope,
+        )
+        from specpride_tpu.observability import device_counters_snapshot
+        from specpride_tpu.serve import batcher
+        from specpride_tpu.warmstart import cache as ws_cache
+
+        leader = batch[0]
+        backend = self.worker_backends[wid]
+        slot = self.slots[wid]
+        bid = self._batch_ids()
+        entries = [
+            (j, parsed[j.job_id]) for j in batch
+            if parsed.get(j.job_id) is not None
+        ]
+        n_clusters = sum(len(c) for _, c in entries)
+        batch_info = {
+            "batch_id": bid,
+            "n_jobs": len(batch),
+            "n_clusters": n_clusters,
+            "window_wait_s": round(window_wait, 4),
+        }
+        # per-batch state reset on the REAL backend, mirroring
+        # _execute's per-job reset (warm state stays resident)
+        backend.stats = RunStats()
+        backend.pack_accounting = False
+        backend._routing_noted.clear()
+        cc0 = ws_cache.thread_counters_snapshot()
+        dev0 = device_counters_snapshot(backend.metrics)
+        scope = PlanCacheScope()
+        prev_scope = set_plan_scope(scope)
+        t0 = time.perf_counter()
+        shared, err = None, None
+        try:
+            with self.watchdog.section("serve:batch"), \
+                    placement.device_scope(slot.device):
+                shared = batcher.compute_shared(
+                    backend, leader.args, entries
+                )
+        except BaseException as e:  # noqa: BLE001 - members run solo
+            err = f"{type(e).__name__}: {e}"
+            logger.warning(
+                "batch %d: shared dispatch failed (%s); %d job(s) run "
+                "solo", bid, e, len(batch),
+            )
+        finally:
+            set_plan_scope(prev_scope)
+        wall = time.perf_counter() - t0
+        cc = ws_cache.thread_counters_delta(cc0)
+        dev = device_summary(backend.metrics, since=dev0)
+        status = "shared" if shared is not None else "fallback_solo"
+        if shared is not None:
+            with self._counts_lock:
+                self.batches_dispatched += 1
+                self.jobs_batched += len(shared)
+        self.journal.emit(
+            "batch_dispatch", batch_id=bid,
+            jobs=[j.job_id for j in batch],
+            clients=sorted({str(j.client) for j in batch}),
+            n_jobs=len(batch), n_clusters=n_clusters,
+            method=getattr(leader.args, "method", None),
+            key=list(leader.batch_key or ()),
+            window_wait_s=round(window_wait, 4),
+            wall_s=round(wall, 4), worker=wid, status=status,
+            fresh_compiles=cc.get("misses", 0),
+            plan_cache=scope.delta(),
+            dispatches=dev["dispatches"],
+            bucket_occupancy_frac=dev["bucket_occupancy_frac"],
+            padding_waste_frac=dev["padding_waste_frac"],
+            **({"error": err} if err else {}),
+        )
+        if shared is not None:
+            # jobs SERVED from the share (a member whose parse failed
+            # runs solo and is excluded), matching the status
+            # snapshot's jobs_batched and the metric's help text
+            self.telemetry.batch_dispatch(
+                n_jobs=len(shared), n_clusters=n_clusters,
+                window_wait_s=window_wait,
+                occupancy_frac=dev["bucket_occupancy_frac"],
+            )
+        return shared, batch_info
+
+    def _run_job(
+        self, job: Job, wid: int, shared=None, batch_info=None,
+    ) -> None:
+        from specpride_tpu.warmstart import cache as ws_cache
+
+        batch_fields = (
+            {"batch_id": batch_info["batch_id"],
+             "batch_jobs": batch_info["n_jobs"]}
+            if batch_info is not None else {}
+        )
+        wait_s = time.perf_counter() - job.t_enqueued
+        self.journal.emit(
+            "job_start", job_id=job.job_id, command=job.command,
+            method=getattr(job.args, "method", None),
+            queue_wait_s=round(wait_s, 4), worker=wid,
+            **batch_fields,
+        )
+        t0 = time.perf_counter()
+        # THREAD-scoped compile counters: every compile a job causes
+        # fires on the worker thread that dispatched it, so this
+        # delta is the job's own even with other lanes compiling
+        # concurrently (the process-wide snapshot would cross-
+        # attribute between in-flight jobs).  A batched job's shared
+        # compiles fired BEFORE this snapshot and ride the
+        # batch_dispatch event instead — per-job deltas stay the work
+        # its own lane performed.
+        cc0 = ws_cache.thread_counters_snapshot()
+        status, rc, err, retriable, summary = "done", 0, None, False, None
+        try:
+            with self.watchdog.section("serve:job"):
+                summary = self._execute(job, wid, shared=shared)
+        except SystemExit as e:
+            # CLI-style usage/abort error (bad input file, refused
+            # resume): permanent from the daemon's point of view
+            status, rc = "error", 1
+            err = str(e.code) if not isinstance(e.code, int) else \
+                f"exit {e.code}"
+        except BaseException as e:  # noqa: BLE001 - reported to client
+            status, rc = "error", 1
+            err = f"{type(e).__name__}: {e}"
+            retriable = rb_errors.is_transient(e)
+        wall = time.perf_counter() - t0
+        cc = ws_cache.thread_counters_delta(cc0)
+        with self._counts_lock:
+            if status == "done":
+                self.jobs_done += 1
+            else:
+                self.jobs_failed += 1
+        # fold the finished job into the live metric plane; the SLO
+        # evaluation (objective, measured latency, ok/breach) rides
+        # the journal's job_done so `stats --slo` and /metrics agree
+        slo_fields = self.telemetry.job_done(
+            command=job.command,
+            method=getattr(job.args, "method", None),
+            status=status, wall_s=wall, queue_wait_s=wait_s,
+            summary=summary if isinstance(summary, dict) else None,
+            worker=wid,
+        )
+        self.journal.emit(
+            "job_done", job_id=job.job_id, status=status,
+            wall_s=round(wall, 4), queue_wait_s=round(wait_s, 4),
+            command=job.command,
+            method=getattr(job.args, "method", None),
+            fresh_compiles=cc.get("misses", 0),
+            worker=wid,
+            **batch_fields,
+            **slo_fields,
+            **({"error": err} if err else {}),
+        )
+        job.ack.wait(timeout=10.0)  # admission line strictly first
+        try:
+            if status == "done":
+                protocol.write_msg(
+                    job.fh, ok=True, status="done", job_id=job.job_id,
+                    rc=rc, wall_s=round(wall, 4),
+                    queue_wait_s=round(wait_s, 4), stats=summary,
+                    compile_cache=cc, worker=wid,
+                    **({"batch": batch_fields} if batch_fields else {}),
+                )
+            else:
+                protocol.write_msg(
+                    job.fh, ok=False, status="error", job_id=job.job_id,
+                    error=err, retriable=retriable,
+                )
+        except (OSError, ValueError):
+            # the client went away while its job ran (ValueError:
+            # the admission path already closed the fh after a
+            # failed accepted-write); the output is on disk
+            # regardless — log, never crash the lane
+            logger.warning(
+                "job %d: client disconnected before the terminal "
+                "response", job.job_id,
+            )
+        self._close(job.conn, job.fh)
+        self._inflight_by.pop(wid, None)
+        # free the client's inflight-quota slot and the job's
+        # conflict-guard paths only AFTER the terminal write and
+        # close: a same-output successor popping earlier could start
+        # rewriting the file a reader still attributes to this job
+        self.queue.release(job)
+
+    def _execute(self, job: Job, wid: int, shared=None) -> dict:
         """Run one job through THE CLI execution body with worker
         ``wid``'s resident backend, pinned to its placement slot,
-        resetting exactly the per-run backend state first."""
+        resetting exactly the per-run backend state first.  ``shared``
+        (a ``batcher.SharedResults``) wraps the backend in the batch's
+        read-only result view — the pipeline body, write lanes and
+        accounting run unchanged."""
         from specpride_tpu import cli
 
         slot = self.slots[wid]
@@ -892,6 +1159,12 @@ class ServeDaemon:
             # resident: per-job AOT re-warming is pure request latency
             # (manifest saving still runs so jobs seed future boots)
             job.args._resident_warm = True
+            if shared is not None:
+                from specpride_tpu.serve.batcher import (
+                    BatchResultBackend,
+                )
+
+                backend = BatchResultBackend(backend, shared)
         with placement.device_scope(slot.device):
             return cli._run_pipeline_command(job.args, job.command,
                                              backend=backend)
@@ -1008,6 +1281,15 @@ class ServeDaemon:
             "placement": [slot.describe() for slot in self.slots],
             "inflight": len(self._inflight_by),
             "uptime_s": round(time.perf_counter() - self._t_boot, 2),
+            **(
+                {"batching": {
+                    "window_s": self.batch_window,
+                    "max_clusters": self.batch_max_clusters,
+                    "batches_dispatched": self.batches_dispatched,
+                    "jobs_batched": self.jobs_batched,
+                }}
+                if self.batch_window > 0 else {}
+            ),
             **({"quota": {c: repr(q) for c, q in self.quotas.items()}}
                if self.quotas else {}),
             **(

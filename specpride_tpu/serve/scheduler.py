@@ -263,6 +263,22 @@ class AdmissionQueue:
             return None
         return best[1], best[2]
 
+    def _pop_locked(self, client, st):
+        """Dequeue ``client``'s head job with the full pop bookkeeping
+        (deficit advance, inflight count, conflict-path claim).  Caller
+        holds the lock and has already checked eligibility."""
+        job = st.queue.popleft()
+        self._total -= 1
+        st.inflight += 1
+        # deficit bookkeeping: serving one job costs 1/weight of
+        # virtual time; the frontier follows
+        st.vtime += 1.0 / st.quota.weight
+        self._vclock = max(self._vclock, st.vtime)
+        tokens = self._claim_tokens(job)
+        self._held.update(tokens)
+        self._popped[id(job)] = (client, tokens)
+        return job
+
     def pop(self, timeout: float | None = None):
         """The next job in weighted-fair order; blocks while nothing is
         runnable (empty, every queued client at its inflight cap, or
@@ -275,21 +291,34 @@ class AdmissionQueue:
                 picked = self._select_locked() if self._total else None
                 if picked is not None:
                     client, st = picked
-                    job = st.queue.popleft()
-                    self._total -= 1
-                    st.inflight += 1
-                    # deficit bookkeeping: serving one job costs
-                    # 1/weight of virtual time; the frontier follows
-                    st.vtime += 1.0 / st.quota.weight
-                    self._vclock = max(self._vclock, st.vtime)
-                    tokens = self._claim_tokens(job)
-                    self._held.update(tokens)
-                    self._popped[id(job)] = (client, tokens)
-                    return job
+                    return self._pop_locked(client, st)
                 if self._closed and self._total == 0:
                     return None
                 if not self._cond.wait(timeout=timeout):
                     return None
+
+    def pop_compatible(self, match):
+        """Non-blocking pop for the micro-batch collector: the next job
+        in weighted-fair order whose client is ELIGIBLE (inflight cap,
+        output-conflict guard — exactly :meth:`pop`'s criteria, so
+        batching changes no scheduling policy) and whose HEAD job
+        satisfies ``match(job)``.  Only heads are considered — per-
+        client FIFO is preserved.  Returns None when no such job is
+        queued right now; the caller MUST :meth:`release` any job
+        returned, like a normal pop."""
+        with self._cond:
+            if self._total == 0:
+                return None
+            best = None
+            for client, st in self._states.items():
+                if not self._eligible(st) or not match(st.queue[0]):
+                    continue
+                rank = (st.vtime, st.entry)
+                if best is None or rank < best[0]:
+                    best = (rank, client, st)
+            if best is None:
+                return None
+            return self._pop_locked(best[1], best[2])
 
     def release(self, job) -> None:
         """Mark a popped job's lane free: drop its client's inflight

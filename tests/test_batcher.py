@@ -1,0 +1,533 @@
+"""Cross-job micro-batching (``specpride serve --batch-window``):
+compatibility-key eligibility, the scheduler's compatible-pop (quota /
+conflict-guard policy unchanged), batched-vs-solo byte + QC parity
+across methods x workers x window x tenants, drain-with-open-window
+commit semantics, shared-dispatch attribution (batch_dispatch journal
+event, batch metrics, per-job deltas), plan-cache cross-job sharing,
+and the drain-snapshot 0-valued series fix."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from specpride_tpu.cli import build_parser, main as cli_main
+from specpride_tpu.io.mgf import write_mgf
+from specpride_tpu.observability.journal import read_events
+from specpride_tpu.serve import batcher, client as sc
+from specpride_tpu.serve.daemon import ServeDaemon
+from specpride_tpu.serve.scheduler import AdmissionQueue, Quota
+
+from conftest import make_cluster
+
+METHODS = [
+    ("bin-mean", "consensus"),
+    ("gap-average", "consensus"),
+    ("medoid", "select"),
+]
+
+
+def _parse(argv):
+    return build_parser().parse_args(argv)
+
+
+def _start(daemon: ServeDaemon) -> threading.Thread:
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    assert sc.wait_for_socket(daemon.socket_path, timeout=120), \
+        "daemon never answered ping"
+    return t
+
+
+def _stop(daemon: ServeDaemon, thread: threading.Thread) -> None:
+    daemon.drain()
+    thread.join(timeout=60)
+    assert not thread.is_alive(), "daemon thread did not exit after drain"
+
+
+@pytest.fixture(scope="module")
+def workloads(tmp_path_factory):
+    """Two DISTINCT tenant inputs (different cluster shapes), so a batch
+    exercises the merged multi-source pack, not just same-input
+    fan-out."""
+    tmp = tmp_path_factory.mktemp("batch_wl")
+    rng = np.random.default_rng(91)
+    a = tmp / "tenant_a.mgf"
+    b = tmp / "tenant_b.mgf"
+    write_mgf(
+        [s for c in (
+            make_cluster(rng, f"a-{i}", n_members=3, n_peaks=25)
+            for i in range(6)
+        ) for s in c.members],
+        a,
+    )
+    write_mgf(
+        [s for c in (
+            make_cluster(rng, f"b-{i}", n_members=4, n_peaks=30)
+            for i in range(5)
+        ) for s in c.members],
+        b,
+    )
+    return str(a), str(b)
+
+
+@pytest.fixture(scope="module")
+def golden(workloads, tmp_path_factory):
+    """Solo one-shot CLI bytes + QC for every (method, input) — the
+    parity bar every batched cell must reproduce."""
+    tmp = tmp_path_factory.mktemp("batch_golden")
+    out = {}
+    for method, command in METHODS:
+        for tag, src in zip(("a", "b"), workloads):
+            o = tmp / f"{method}_{tag}.mgf"
+            qc = tmp / f"{method}_{tag}.qc.json"
+            assert cli_main([
+                command, src, str(o), "--method", method,
+                "--qc-report", str(qc),
+            ]) == 0
+            out[(method, tag)] = (o.read_bytes(), qc.read_text())
+    return out
+
+
+class TestBatchKey:
+    def test_eligible_and_spelling_invariant(self, workloads):
+        src, _ = workloads
+        k1 = batcher.batch_key(
+            _parse(["consensus", src, "/tmp/o1.mgf", "--method",
+                    "bin-mean", "--bin-size", "0.02"]),
+            "consensus",
+        )
+        k2 = batcher.batch_key(
+            _parse(["consensus", src, "/tmp/o2.mgf",
+                    "--bin-size", "0.02", "--method", "bin-mean"]),
+            "consensus",
+        )
+        assert k1 is not None and k1 == k2, \
+            "flag order must not split compatible jobs"
+
+    def test_config_differences_split_the_key(self, workloads):
+        src, _ = workloads
+        base = _parse(["consensus", src, "/tmp/o.mgf", "--method",
+                       "bin-mean"])
+        other = _parse(["consensus", src, "/tmp/o.mgf", "--method",
+                        "bin-mean", "--bin-size", "0.05"])
+        qc = _parse(["consensus", src, "/tmp/o.mgf", "--method",
+                     "bin-mean", "--qc-report", "/tmp/q.json"])
+        kb = batcher.batch_key(base, "consensus")
+        assert kb != batcher.batch_key(other, "consensus")
+        assert kb != batcher.batch_key(qc, "consensus"), \
+            "QC and no-QC jobs must not share a dispatch"
+        gap = _parse(["consensus", src, "/tmp/o.mgf", "--method",
+                      "gap-average"])
+        assert kb != batcher.batch_key(gap, "consensus")
+
+    @pytest.mark.parametrize("argv_extra", [
+        ["--backend", "numpy"],
+        ["--mesh"],
+        ["--elastic", "/tmp/el"],
+        ["--inject-faults", "dispatch:error:1"],
+        ["--single"],
+        ["--on-error", "skip"],
+        ["--stream-clusters", "64"],
+    ])
+    def test_solo_semantics_are_ineligible(self, workloads, argv_extra):
+        src, _ = workloads
+        args = _parse(
+            ["consensus", src, "/tmp/o.mgf", "--method", "bin-mean"]
+            + argv_extra
+        )
+        assert batcher.batch_key(args, "consensus") is None
+
+    def test_best_spectrum_is_ineligible(self, workloads):
+        src, _ = workloads
+        args = _parse(["select", src, "/tmp/o.mgf", "--method", "best",
+                       "--msms", "/tmp/msms.txt"])
+        assert batcher.batch_key(args, "select") is None
+
+
+class _KeyedJob:
+    def __init__(self, name, key, paths=()):
+        self.name = name
+        self.batch_key = key
+        self.paths = tuple(paths)
+
+    def __repr__(self):
+        return self.name
+
+
+class TestPopCompatible:
+    def test_pops_only_matching_heads_in_fair_order(self):
+        q = AdmissionQueue(16)
+        a1 = _KeyedJob("a1", ("k",))
+        b1 = _KeyedJob("b1", ("other",))
+        c1 = _KeyedJob("c1", ("k",))
+        for client, job in (("A", a1), ("B", b1), ("C", c1)):
+            assert q.offer(client, job)
+        match = lambda j: j.batch_key == ("k",)  # noqa: E731
+        assert q.pop_compatible(match) is a1
+        assert q.pop_compatible(match) is c1
+        assert q.pop_compatible(match) is None, \
+            "non-matching heads must stay queued"
+        assert q.pop(timeout=0.1) is b1
+
+    def test_respects_inflight_quota(self):
+        q = AdmissionQueue(16, quotas={"A": Quota(1.0, max_inflight=1)})
+        a1, a2 = _KeyedJob("a1", ("k",)), _KeyedJob("a2", ("k",))
+        assert q.offer("A", a1)
+        assert q.pop(timeout=0.1) is a1  # A at its cap
+        with q._cond:  # inject past the admission check
+            q._states["A"].queue.append(a2)
+            q._total += 1
+        match = lambda j: True  # noqa: E731
+        assert q.pop_compatible(match) is None, \
+            "a capped client must not feed a batch"
+        q.release(a1)
+        assert q.pop_compatible(match) is a2
+
+    def test_respects_conflict_guard(self):
+        q = AdmissionQueue(
+            16, conflict_key=lambda j: j.paths,
+        )
+        a1 = _KeyedJob("a1", ("k",), paths=("/out/x",))
+        b1 = _KeyedJob("b1", ("k",), paths=("/out/x",))
+        q.offer("A", a1)
+        q.offer("B", b1)
+        assert q.pop(timeout=0.1) is a1
+        assert q.pop_compatible(lambda j: True) is None, \
+            "a same-output job must not join a batch mid-write"
+        q.release(a1)
+        assert q.pop_compatible(lambda j: True) is b1
+
+
+def _boot(tmp, *, workers, window_s, cache, **kw):
+    d = ServeDaemon(
+        str(tmp / "serve.sock"),
+        compile_cache=cache,
+        journal_path=str(tmp / "serve.jsonl"),
+        workers=workers,
+        batch_window=window_s,
+        **kw,
+    )
+    d._gate.clear()
+    return d, _start(d)
+
+
+class TestBatchedParity:
+    """The matrix: 3 methods x workers {1,2} x batch-window {0, 50ms}
+    x 2 concurrent tenants with DISTINCT inputs — batched (and
+    degenerate-solo) outputs byte-identical to solo CLI runs, QC
+    reports equal."""
+
+    @pytest.mark.parametrize("method,command", METHODS)
+    @pytest.mark.parametrize("workers", [1, 2])
+    @pytest.mark.parametrize("window_s", [0.0, 0.05])
+    def test_matrix_cell(
+        self, tmp_path, tmp_path_factory, workloads, golden,
+        method, command, workers, window_s,
+    ):
+        cache = str(tmp_path_factory.getbasetemp() / "batch_cache")
+        d, t = _boot(
+            tmp_path, workers=workers, window_s=window_s, cache=cache,
+        )
+        terms = {}
+
+        def _submit(tag, src):
+            out = tmp_path / f"{tag}.mgf"
+            qc = tmp_path / f"{tag}.qc.json"
+            terms[tag] = (
+                sc.submit_wait(
+                    d.socket_path,
+                    [command, src, str(out), "--method", method,
+                     "--qc-report", str(qc)],
+                    client=f"tenant-{tag}",
+                ),
+                out, qc,
+            )
+
+        threads = [
+            threading.Thread(target=_submit, args=(tag, src))
+            for tag, src in zip(("a", "b"), workloads)
+        ]
+        threads[0].start()
+        # both jobs admitted before any executes (the gate holds the
+        # popping worker), so the window>0 single-lane cells batch
+        # deterministically
+        deadline = time.time() + 30
+        while not d._inflight_by and time.time() < deadline:
+            time.sleep(0.01)
+        threads[1].start()
+        while len(d.queue) + len(d._inflight_by) < 2 and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        d._gate.set()
+        for th in threads:
+            th.join(timeout=180)
+            assert not th.is_alive()
+        _stop(d, t)
+        for tag in ("a", "b"):
+            term, out, qc = terms[tag]
+            assert term["status"] == "done", (method, tag, term)
+            want_bytes, want_qc = golden[(method, tag)]
+            assert out.read_bytes() == want_bytes, (method, tag)
+            assert json.loads(qc.read_text()) == json.loads(want_qc), \
+                (method, tag)
+        events, violations = read_events(d.journal_path)
+        assert not violations, violations
+        shared = [
+            e for e in events
+            if e["event"] == "batch_dispatch"
+            and e.get("status") == "shared"
+        ]
+        if window_s > 0 and workers == 1:
+            # single lane + held gate: both jobs were queued when the
+            # collector ran — the shared dispatch MUST have coalesced
+            assert shared and shared[0]["n_jobs"] == 2, shared
+            assert shared[0]["n_clusters"] == 11  # 6 + 5 merged
+            done = [e for e in events if e["event"] == "job_done"]
+            assert all(
+                e.get("batch_id") == shared[0]["batch_id"]
+                for e in done
+            ), done
+            assert terms["a"][0].get("batch", {}).get("batch_jobs") == 2
+        if window_s == 0:
+            assert not shared, "batching off must never share dispatches"
+
+
+class TestDrainWithOpenWindow:
+    def test_drain_closes_the_window_and_commits(
+        self, tmp_path, workloads, golden,
+    ):
+        """A leader sitting in a wide-open window (no companions) must
+        commit its job promptly when drain fires — never wait out the
+        window, never drop the job."""
+        src, _ = workloads
+        d = ServeDaemon(
+            str(tmp_path / "serve.sock"),
+            compile_cache=str(tmp_path / "cache"),
+            journal_path=str(tmp_path / "serve.jsonl"),
+            workers=1,
+            batch_window=30.0,  # far beyond the test timeout
+        )
+        t = _start(d)
+        out = tmp_path / "drained.mgf"
+        term = {}
+
+        def _submit():
+            term["msg"] = sc.submit_wait(d.socket_path, [
+                "consensus", src, str(out), "--method", "bin-mean",
+                "--qc-report", str(tmp_path / "drained.qc.json"),
+            ], client="lonely")
+
+        th = threading.Thread(target=_submit)
+        th.start()
+        deadline = time.time() + 30
+        while not d._inflight_by and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)  # let the leader enter the collection window
+        t0 = time.time()
+        _stop(d, t)
+        assert time.time() - t0 < 20, \
+            "drain must not wait out the 30s batch window"
+        th.join(timeout=60)
+        assert term["msg"]["status"] == "done", term["msg"]
+        assert out.read_bytes() == golden[("bin-mean", "a")][0]
+        events, violations = read_events(d.journal_path)
+        assert not violations, violations
+        done = [e for e in events if e["event"] == "job_done"]
+        assert len(done) == 1 and done[0]["status"] == "done"
+
+
+class TestQuotaAccountingUnderBatching:
+    def test_max_inflight_unchanged(self, tmp_path, workloads):
+        """A tenant at max_inflight=1 gets the same named retriable
+        bounce with batching armed; the batch collector never pulls a
+        capped tenant's second job."""
+        from specpride_tpu.serve.scheduler import parse_quota_spec
+
+        src, _ = workloads
+        d = ServeDaemon(
+            str(tmp_path / "serve.sock"),
+            compile_cache=str(tmp_path / "cache"),
+            journal_path=str(tmp_path / "serve.jsonl"),
+            workers=1,
+            batch_window=0.05,
+            quotas=parse_quota_spec("capped=1:1"),
+        )
+        d._gate.clear()
+        t = _start(d)
+        terms = {}
+
+        def _submit(tag):
+            terms[tag] = sc.submit_wait(d.socket_path, [
+                "consensus", src, str(tmp_path / f"{tag}.mgf"),
+                "--method", "bin-mean",
+            ], client="capped")
+
+        try:
+            t1 = threading.Thread(target=_submit, args=("first",))
+            t1.start()
+            deadline = time.time() + 30
+            while d._inflight is None and time.time() < deadline:
+                time.sleep(0.01)
+            _submit("bounced")
+            term = terms["bounced"]
+            assert term["status"] == "rejected", term
+            assert term["retriable"] is True
+            assert "quota" in term["reason"]
+        finally:
+            d._gate.set()
+            t1.join(timeout=120)
+            _stop(d, t)
+        assert terms["first"]["status"] == "done"
+
+
+class TestPlanCacheCrossJobSharing:
+    def test_second_job_hits_with_correct_per_job_deltas(
+        self, tmp_path, workloads,
+    ):
+        """The bucket-plan cache is shared READ-ONLY across jobs: the
+        first job's pack memoizes the plan (misses > 0), an identical
+        second job reuses it (hits > 0, misses == 0), and each job's
+        run_end reports ITS OWN traffic — the PlanCacheScope deltas."""
+        from specpride_tpu.data.packed import clear_plan_cache
+
+        src, _ = workloads
+        clear_plan_cache()
+        d = ServeDaemon(
+            str(tmp_path / "serve.sock"),
+            compile_cache=str(tmp_path / "cache"),
+            journal_path=str(tmp_path / "serve.jsonl"),
+            workers=1,
+            layout="bucketized",  # the (B, K) packers use the plan cache
+        )
+        t = _start(d)
+        try:
+            deltas = []
+            for tag in ("first", "second"):
+                jp = tmp_path / f"{tag}.jsonl"
+                term = sc.submit_wait(d.socket_path, [
+                    "consensus", src, str(tmp_path / f"{tag}.mgf"),
+                    "--method", "bin-mean", "--journal", str(jp),
+                ])
+                assert term["status"] == "done", term
+                events, violations = read_events(str(jp))
+                assert not violations, violations
+                end = [e for e in events if e["event"] == "run_end"][-1]
+                deltas.append(end["plan_cache"])
+        finally:
+            _stop(d, t)
+        first, second = deltas
+        assert first["misses"] > 0, first
+        assert second["misses"] == 0, \
+            f"identical shape profile must reuse the memoized plan: " \
+            f"{second}"
+        assert second["hits"] > 0, second
+        assert (tmp_path / "first.mgf").read_bytes() == \
+            (tmp_path / "second.mgf").read_bytes()
+
+
+class TestDrainSnapshotSeries:
+    def test_final_snapshot_keeps_client_and_batch_series(
+        self, tmp_path, workloads,
+    ):
+        """The drain-time --metrics-out snapshot renders 0-valued
+        series: per-client queue depth for every tenant ever admitted
+        (clear-and-set alone dropped the rows), and the batch
+        counters/gauge even when batching never coalesced."""
+        from specpride_tpu.observability.exporter import (
+            parse_exposition,
+        )
+
+        src, _ = workloads
+        prom = tmp_path / "final.prom"
+        d = ServeDaemon(
+            str(tmp_path / "serve.sock"),
+            compile_cache=str(tmp_path / "cache"),
+            journal_path=str(tmp_path / "serve.jsonl"),
+            workers=1,
+            batch_window=0.01,
+            metrics_out=str(prom),
+        )
+        t = _start(d)
+        term = sc.submit_wait(d.socket_path, [
+            "consensus", src, str(tmp_path / "o.mgf"),
+            "--method", "bin-mean",
+        ], client="tenant-gone")
+        assert term["status"] == "done", term
+        _stop(d, t)
+        text = prom.read_text()
+        samples, problems = parse_exposition(text)
+        assert not problems, problems
+        assert samples[(
+            "specpride_serve_queue_depth_client",
+            (("client", "tenant-gone"),),
+        )] == 0.0, "departed client must render a 0 row at drain"
+        for name in (
+            "specpride_serve_batch_dispatches_total",
+            "specpride_serve_batch_jobs_total",
+            "specpride_serve_batch_clusters_total",
+            "specpride_serve_batch_occupancy",
+        ):
+            assert (name, ()) in samples, f"missing 0-valued {name}"
+            assert samples[(name, ())] == 0.0
+
+
+class TestSharedBackendUnits:
+    def test_run_shared_scatters_per_source(self):
+        """``TpuBackend.run_shared`` over two distinct sources returns
+        per-source slices identical to per-source solo runs."""
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+        from specpride_tpu.config import BinMeanConfig, CosineConfig
+
+        rng = np.random.default_rng(7)
+        a = [make_cluster(rng, f"sa-{i}", n_members=3, n_peaks=20)
+             for i in range(4)]
+        b = [make_cluster(rng, f"sb-{i}", n_members=2, n_peaks=15)
+             for i in range(3)]
+        backend = TpuBackend()
+        cfg, ccfg = BinMeanConfig(), CosineConfig()
+        shared = backend.run_shared(
+            "bin-mean", [a, b], cfg, cos_config=ccfg
+        )
+        assert len(shared) == 2
+        solo_a, cos_a = backend.run_bin_mean_with_cosines(a, cfg, ccfg)
+        solo_b, cos_b = backend.run_bin_mean_with_cosines(b, cfg, ccfg)
+        for (reps, cos), solo, solo_cos in (
+            (shared[0], solo_a, cos_a), (shared[1], solo_b, cos_b),
+        ):
+            assert len(reps) == len(solo)
+            for r, s in zip(reps, solo):
+                assert r.title == s.title
+                np.testing.assert_array_equal(r.mz, s.mz)
+                np.testing.assert_array_equal(r.intensity, s.intensity)
+                assert r.precursor_mz == s.precursor_mz
+            np.testing.assert_array_equal(
+                np.asarray(cos), np.asarray(solo_cos)
+            )
+
+    def test_batch_result_backend_forwards_unknown_clusters(self):
+        from specpride_tpu.backends.tpu_backend import TpuBackend
+        from specpride_tpu.config import BinMeanConfig
+
+        rng = np.random.default_rng(8)
+        known = [make_cluster(rng, "known", n_members=2, n_peaks=10)]
+        other = [make_cluster(rng, "other", n_members=2, n_peaks=10)]
+        inner = TpuBackend()
+        cfg = BinMeanConfig()
+        [rep] = inner.run_bin_mean(known, cfg)
+        shim = batcher.BatchResultBackend(
+            inner, batcher.SharedResults({"known": rep}, None),
+        )
+        assert shim.supports_prepare("bin-mean") is False
+        assert shim.run_bin_mean(known, cfg) == [rep]
+        # unknown cluster: forwarded to the real backend, never wrong
+        [fresh] = shim.run_bin_mean(other, cfg)
+        [solo] = inner.run_bin_mean(other, cfg)
+        np.testing.assert_array_equal(fresh.mz, solo.mz)
+        # attribute traffic lands on the real backend
+        shim.pack_accounting = True
+        assert inner.pack_accounting is True
+        inner.pack_accounting = False
