@@ -259,14 +259,14 @@ def _sweep_source(clusters, workdir: str) -> str:
     return src
 
 
-def _sweep_run(command: str, method: str, src: str, workdir: str,
-               tag: str, flags: list):
+def _sweep_run_full(command: str, method: str, src: str, workdir: str,
+                    tag: str, flags: list):
     """One CLI run under the pinned executor-sweep protocol — identical
     chunking (``--checkpoint-every 256``) and a journal to read the
-    ``run_end`` summary from.  THE one runner both sweeps share, so the
+    ``run_end`` summary from.  THE one runner every sweep shares, so the
     measurement protocol cannot drift between them.  Returns
-    ``(wall_s, executor_s, pipeline_summary, output_bytes)``; executor_s
-    is the post-parse chunk loop the executor actually changed."""
+    ``(wall_s, executor_s, run_end, output_bytes)``; executor_s is the
+    post-parse chunk loop the executor actually changed."""
     import os
 
     from specpride_tpu.cli import main as cli_main
@@ -285,11 +285,20 @@ def _sweep_run(command: str, method: str, src: str, workdir: str,
     with open(journal) as fh:
         events = [json.loads(line) for line in fh]
     end = [e for e in events if e["event"] == "run_end"][-1]
-    pipe = end.get("pipeline") or {}
     executor_s = end["elapsed_s"] - end["phases_s"].get("parse", 0.0)
     with open(out, "rb") as fh:
         data = fh.read()
-    return wall, executor_s, pipe, data
+    return wall, executor_s, end, data
+
+
+def _sweep_run(command: str, method: str, src: str, workdir: str,
+               tag: str, flags: list):
+    """``_sweep_run_full`` narrowed to the pipeline summary (the
+    executor sweeps' historical signature)."""
+    wall, executor_s, end, data = _sweep_run_full(
+        command, method, src, workdir, tag, flags
+    )
+    return wall, executor_s, end.get("pipeline") or {}, data
 
 
 _SWEEP_METHODS = (
@@ -297,6 +306,186 @@ _SWEEP_METHODS = (
     ("gap-average", "consensus"),
     ("medoid", "select"),
 )
+
+
+def bench_bandwidth(clusters, workdir: str) -> dict:
+    """Memory-bandwidth campaign (``--precision`` x donation x
+    double-buffered H2D), measured end to end through the CLI on the
+    pinned sweep protocol.
+
+    Workload note: m/z is snapped to the bf16 grid before writing the
+    source, so the pack-time exactness probe ships bf16 m/z on the
+    bucketized paths (real full-precision m/z falls back to f32 there —
+    documented; the flat bin-mean path never ships m/z at all).  The
+    QC-cosine tolerance gates still judge every reduced run against the
+    f32 oracle on this same data.
+
+    Primary sweep (flat bin-mean, the H2D-dominant packed path):
+    precision {f32,bf16,int8} x donation {on,off} x h2d-buffer {0,2},
+    reporting bytes moved, executor clusters/sec, overlap efficiency,
+    and the per-cell QC gate.  Secondary: gap-average and medoid
+    precision rows on their bucketized device paths.  Byte-parity
+    audits: every f32 cell byte-identical to the flag-free baseline
+    (donation/double-buffering may never change bytes), and each
+    reduced precision's cells identical across the donation/h2d arms."""
+    import os
+
+    import ml_dtypes
+
+    from specpride_tpu.data.peaks import Cluster, Spectrum
+    from specpride_tpu.io.mgf import write_mgf
+
+    bf16 = ml_dtypes.bfloat16
+    snapped = [
+        Cluster(c.cluster_id, [
+            Spectrum(
+                mz=np.sort(
+                    np.asarray(s.mz, np.float32).astype(bf16)
+                    .astype(np.float64)
+                ),
+                intensity=s.intensity,
+                precursor_mz=s.precursor_mz,
+                precursor_charge=s.precursor_charge,
+                rt=s.rt, title=s.title,
+            )
+            for s in c.members
+        ])
+        for c in clusters
+    ]
+    src = os.path.join(workdir, "bandwidth.mgf")
+    write_mgf([s for c in snapped for s in c.members], src)
+
+    def run(tag, command, method, flags):
+        wall, executor_s, end, data = _sweep_run_full(
+            command, method, src, workdir, tag, flags
+        )
+        dev = end["device"]
+        pipe = end.get("pipeline") or {}
+        return {
+            "wall_s": round(wall, 3),
+            "executor_s": round(executor_s, 3),
+            "clusters_per_sec_executor": round(
+                len(clusters) / executor_s, 2
+            ),
+            "bytes_h2d": dev["bytes_h2d"],
+            "bytes_d2h": dev["bytes_d2h"],
+            "overlap_efficiency": pipe.get("overlap_efficiency"),
+            "h2d_lane": pipe.get("h2d"),
+            "gate": end.get("precision"),
+        }, data
+
+    report: dict = {"rows": []}
+    # flag-free baselines: what a pre-campaign invocation runs per
+    # method (the f32 cells must reproduce these bytes exactly)
+    baselines = {}
+    method_flags = {
+        "bin-mean": ("consensus", ["--layout", "flat"]),
+        "gap-average": (
+            "consensus", ["--layout", "bucketized", "--force-device"]
+        ),
+        "medoid": ("select", ["--layout", "bucketized"]),
+    }
+    for method, (command, flags) in method_flags.items():
+        m = method.replace("-", "_")
+        _, baselines[method] = run(f"bw_{m}_base", command, method, flags)
+
+    parity_ok = True
+    f32_bytes = {}
+    cells_by_prec: dict = {}
+    for prec in ("f32", "bf16", "int8"):
+        for donate in (True, False):
+            for h2d in (0, 2):
+                flags = [
+                    "--layout", "flat", "--precision", prec,
+                    "--prefetch", "4",
+                ]
+                if not donate:
+                    flags.append("--no-donate")
+                if h2d:
+                    flags += ["--h2d-buffer", str(h2d)]
+                tag = (
+                    f"bw_bin_{prec}_{'don' if donate else 'nodon'}_h{h2d}"
+                )
+                row, data = run(tag, "consensus", "bin-mean", flags)
+                row.update(
+                    method="bin-mean", precision=prec, donate=donate,
+                    h2d_buffer=h2d,
+                )
+                if prec == "f32":
+                    row["identical_to_baseline"] = (
+                        data == baselines["bin-mean"]
+                    )
+                    parity_ok &= row["identical_to_baseline"]
+                cells_by_prec.setdefault(prec, []).append(data)
+                if donate and h2d == 0:
+                    f32_bytes[prec] = row["bytes_h2d"]
+                report["rows"].append(row)
+                eprint(
+                    f"[bandwidth:bin-mean {prec} donate={donate} "
+                    f"h2d={h2d}] h2d={row['bytes_h2d']}B executor "
+                    f"{row['clusters_per_sec_executor']} cl/s "
+                    f"overlap={row['overlap_efficiency']}"
+                    + (
+                        f" lane={row['h2d_lane']['overlap_efficiency']}"
+                        if row["h2d_lane"] else ""
+                    )
+                )
+    # donation/double-buffering may never change bytes WITHIN a precision
+    for prec, datas in cells_by_prec.items():
+        parity_ok &= all(d == datas[0] for d in datas)
+
+    for method in ("gap-average", "medoid"):
+        command, flags = method_flags[method]
+        m = method.replace("-", "_")
+        per_prec = {}
+        for prec in ("f32", "bf16", "int8"):
+            row, data = run(
+                f"bw_{m}_{prec}", command, method,
+                flags + ["--precision", prec],
+            )
+            row.update(method=method, precision=prec, donate=True,
+                       h2d_buffer=0)
+            if prec == "f32":
+                row["identical_to_baseline"] = data == baselines[method]
+                parity_ok &= row["identical_to_baseline"]
+            per_prec[prec] = row["bytes_h2d"]
+            report["rows"].append(row)
+            eprint(
+                f"[bandwidth:{method} {prec}] h2d={row['bytes_h2d']}B "
+                f"executor {row['clusters_per_sec_executor']} cl/s"
+            )
+        report[f"{m}_h2d_reduction"] = {
+            p: round(per_prec["f32"] / per_prec[p], 3)
+            for p in ("bf16", "int8")
+        }
+
+    # headline: the flat bin-mean packed path's byte reduction
+    report["bin_mean_h2d_reduction"] = {
+        p: round(f32_bytes["f32"] / f32_bytes[p], 3)
+        for p in ("bf16", "int8")
+    }
+    report["f32_byte_parity"] = parity_ok
+    # wall-clock regression probe: the campaign's default arm (donation
+    # on + double buffer) vs the flag-free baseline, f32
+    base_wall = min(
+        r["wall_s"] for r in report["rows"]
+        if r["method"] == "bin-mean" and r["precision"] == "f32"
+        and not r.get("h2d_buffer") and r["donate"]
+    )
+    armed_wall = min(
+        r["wall_s"] for r in report["rows"]
+        if r["method"] == "bin-mean" and r["precision"] == "f32"
+        and r.get("h2d_buffer") == 2 and r["donate"]
+    )
+    report["f32_armed_vs_plain_wall"] = round(armed_wall / base_wall, 4)
+    gates = [
+        r["gate"] for r in report["rows"]
+        if r["precision"] != "f32" and r.get("gate")
+    ]
+    report["all_gates_ok"] = bool(gates) and all(
+        g.get("ok") for g in gates if g.get("gated")
+    )
+    return report
 
 
 def bench_fault_overhead(clusters, workdir: str, repeats: int = 5) -> dict:
@@ -1944,7 +2133,7 @@ def main() -> None:
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
         "prefetch_sweep,worker_sweep,fault_overhead,warm_start,serving,"
         "serving_concurrency,serving_batching,telemetry,elastic,"
-        "elastic_steal,pallas",
+        "elastic_steal,pallas,bandwidth",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -1970,7 +2159,7 @@ def main() -> None:
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
         "worker_sweep,fault_overhead,warm_start,serving,"
         "serving_concurrency,serving_batching,telemetry,elastic,"
-        "elastic_steal,pallas"
+        "elastic_steal,pallas,bandwidth"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -2093,6 +2282,10 @@ def main() -> None:
             with tempfile.TemporaryDirectory() as workdir:
                 if "end_to_end" in secs:
                     report["end_to_end"] = bench_end_to_end(
+                        clusters, workdir
+                    )
+                if "bandwidth" in secs:
+                    report["bandwidth"] = bench_bandwidth(
                         clusters, workdir
                     )
                 if "prefetch_sweep" in secs:

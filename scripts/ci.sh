@@ -809,6 +809,99 @@ env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     stats "$mb_tmp/serve.jsonl" | grep -q "batching:"
 rm -rf "$mb_tmp"
 
+echo "== memory bandwidth: --precision byte ratios + QC gate + --no-donate parity =="
+# per method: the bf16 run must exit 0 with the QC-cosine gate green
+# (run_end.precision.ok) and journaled h2d_bytes <= 0.55x its f32 run's;
+# int8 on the flat bin-mean path must reach <= 0.35x.  The workload's
+# m/z is snapped to the bf16 grid so the pack-time exactness probe
+# ships bf16 m/z (real noisy data falls back to f32 m/z, documented).
+bw_tmp=$(mktemp -d)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$bw_tmp/in.mgf" <<'EOF'
+import sys
+
+import ml_dtypes
+import numpy as np
+
+from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.io.mgf import write_mgf
+
+rng = np.random.default_rng(29)
+clusters = []
+for i in range(48):
+    m = int(rng.integers(3, 7))
+    base = np.sort(rng.uniform(150, 1500, 90))
+    members = []
+    for k in range(m):
+        mz = (base + rng.normal(0, 0.002, 90)).astype(np.float32)
+        # bf16-exact m/z: the grid the pack-time probe verifies
+        mz = np.sort(mz.astype(ml_dtypes.bfloat16).astype(np.float64))
+        members.append(Spectrum(
+            mz=mz, intensity=rng.uniform(1, 1e4, 90),
+            precursor_mz=420.0, precursor_charge=2, rt=1.0,
+            title=f"b{i:03d};s{k}",
+        ))
+    clusters.append(Cluster(f"b{i:03d}", members))
+write_mgf([s for c in clusters for s in c.members], sys.argv[1])
+EOF
+bw_run() {  # bw_run TAG COMMAND METHOD PRECISION FLAGS...
+    tag=$1; cmd=$2; method=$3; prec=$4; shift 4
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        "$cmd" "$bw_tmp/in.mgf" "$bw_tmp/$tag.mgf" --method "$method" \
+        --precision "$prec" --journal "$bw_tmp/$tag.jsonl" "$@"
+}
+bw_run bin_f32  consensus bin-mean    f32  --layout flat
+bw_run bin_bf16 consensus bin-mean    bf16 --layout flat
+bw_run bin_int8 consensus bin-mean    int8 --layout flat
+bw_run gap_f32  consensus gap-average f32  --layout bucketized --force-device
+bw_run gap_bf16 consensus gap-average bf16 --layout bucketized --force-device
+bw_run med_f32  select    medoid      f32  --layout bucketized
+bw_run med_bf16 select    medoid      bf16 --layout bucketized
+python - "$bw_tmp" <<'EOF'
+import json, sys
+
+tmp = sys.argv[1]
+
+def end(tag):
+    evs = [json.loads(l) for l in open(f"{tmp}/{tag}.jsonl")]
+    return [e for e in evs if e["event"] == "run_end"][-1]
+
+for pair, bound in (
+    (("bin_f32", "bin_bf16"), 0.55),
+    (("bin_f32", "bin_int8"), 0.35),
+    (("gap_f32", "gap_bf16"), 0.55),
+    (("med_f32", "med_bf16"), 0.55),
+):
+    f32, red = (end(t) for t in pair)
+    a, b = f32["device"]["bytes_h2d"], red["device"]["bytes_h2d"]
+    assert b <= bound * a, (pair, a, b, bound)
+    p = red["precision"]
+    assert p["ok"] and p["min_cosine"] >= p["tolerance"], (pair, p)
+    print(f"{pair[1]}: h2d {b}B vs f32 {a}B = {b/a:.3f}x "
+          f"(bound {bound}), gate min_cosine={p['min_cosine']}")
+# medoid integer narrowing is exact: reduced output byte-identical
+assert open(f"{tmp}/med_f32.mgf", "rb").read() == \
+    open(f"{tmp}/med_bf16.mgf", "rb").read(), "medoid i16 not exact"
+print("precision pass OK")
+EOF
+# stats renders the bandwidth + precision lines off the reduced journal
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$bw_tmp/bin_bf16.jsonl" | grep -q "bandwidth:"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$bw_tmp/bin_bf16.jsonl" | grep -q "precision=bf16"
+# --no-donate parity pair (donation may never change bytes), with the
+# double-buffered H2D lane armed on the donating side
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$bw_tmp/in.mgf" "$bw_tmp/don.mgf" --method bin-mean \
+    --layout flat --h2d-buffer 2 \
+    --checkpoint "$bw_tmp/don.ck" --checkpoint-every 12
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$bw_tmp/in.mgf" "$bw_tmp/nodon.mgf" --method bin-mean \
+    --layout flat --no-donate \
+    --checkpoint "$bw_tmp/nodon.ck" --checkpoint-every 12
+cmp "$bw_tmp/don.mgf" "$bw_tmp/nodon.mgf"
+echo "donation parity OK"
+rm -rf "$bw_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

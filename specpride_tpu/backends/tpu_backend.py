@@ -281,18 +281,83 @@ class TpuBackend:
     # (jax.default_device is thread-scoped); the backend reads this only
     # to attribute device-memory telemetry to the right device.
     device: object = None
+    # reduced-precision packed paths (--precision): "f32" (default —
+    # byte-parity with every pre-precision run), "bf16", or "int8".
+    # Non-f32 quantizes the packed intensity channel at pack/ship time
+    # (plus bf16 m/z where the round trip is pack-time-verified exact,
+    # and exact int16 narrowing of index channels), routes the affected
+    # methods onto their DEVICE paths (the host paths ship no bytes to
+    # save), and is validated per run against the f32 oracle by the
+    # CLI's QC-cosine tolerance gate (cli._precision_gate).
+    precision: str = "f32"
+    # buffer donation on the chunk loop (--no-donate disables): every
+    # kernel call donates its packed input buffers — they are consumed
+    # exactly once per dispatch — so XLA may alias them into outputs
+    # instead of holding both live.  No-op on CPU/interpreter backends
+    # (parity-tested); the jit twins live beside each kernel
+    # (ops.jit_util.jit_pair).
+    donate: bool = True
     # (method, path) routing decisions already journaled/logged — a
     # chunked run must not spam one event per chunk
     _routing_noted: set = dataclasses.field(
         default_factory=set, repr=False
     )
+    # (method,) precision encodings already journaled — once per backend
+    _precision_noted: set = dataclasses.field(
+        default_factory=set, repr=False
+    )
 
     def __post_init__(self):
         _ensure_compile_cache()
+        if self.precision not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"precision must be f32|bf16|int8, got {self.precision!r}"
+            )
+        # donation resolves OFF on CPU-only hosts: the CPU backend maps
+        # host numpy arrays zero-copy, so a "donated" input can alias
+        # memory the host frees/reuses right after the call — measured
+        # as denormal garbage in the first dispatches of a run.  On
+        # accelerators the H2D copy makes the device buffer jax-owned
+        # and aliasing it into outputs is the whole point.
+        self._donate_effective = self.donate and not _cpu_only_devices()
+        if self.donate and not self._donate_effective:
+            logger.debug(
+                "buffer donation disabled: cpu-only jax devices map host "
+                "buffers zero-copy (no device memory to reclaim)"
+            )
         if self.routing is None:
             from specpride_tpu.warmstart.routing import RoutingTable
 
             self.routing = RoutingTable.load()
+
+    def _kfn(self, plain, donated):
+        """The kernel callable this backend's donation setting selects —
+        one jit cache per run, so the persistent compile cache never
+        pays for both aliasing specs."""
+        return donated if self._donate_effective else plain
+
+    def _note_precision(self, method: str, **channels) -> None:
+        """Journal/log the packed-channel encodings a reduced-precision
+        run actually shipped for ``method`` — once per DISTINCT
+        encoding set per backend.  The pack-time probes (bf16-exact
+        m/z, int16-fitting grids) decide per batch, so a run whose
+        batches diverge (e.g. one batch's m/z fails the exactness
+        probe) journals each combination it actually sent — the
+        operator must be able to see what was on the wire without
+        diffing byte counters."""
+        key = (method, tuple(sorted(channels.items())))
+        if self.precision == "f32" or key in self._precision_noted:
+            return
+        self._precision_noted.add(key)
+        enc = " ".join(f"{k}={v}" for k, v in sorted(channels.items()))
+        logger.info(
+            "precision %s: %s packed channels: %s",
+            self.precision, method, enc,
+        )
+        self.journal.emit(
+            "precision", method=method, precision=self.precision,
+            **channels,
+        )
 
     # -- telemetry hooks ------------------------------------------------
 
@@ -532,7 +597,14 @@ class TpuBackend:
         outputs at every depth is the executor's contract)."""
         if self.mesh is not None or self.layout == "bucketized":
             return False
-        if method in ("bin-mean", "gap-average"):
+        if method == "bin-mean":
+            return True
+        if self.precision != "f32":
+            # reduced precision routes gap-average and medoid onto their
+            # bucketized device paths (which pack per bucket, one-shot);
+            # only bin-mean's flat path keeps a separable pack stage
+            return False
+        if method == "gap-average":
             return True
         if method == "medoid":
             from specpride_tpu.ops import medoid_native
@@ -599,7 +671,6 @@ class TpuBackend:
         cluster axis — a flat peak axis would split clusters across
         devices."""
         from specpride_tpu.data.packed import pack_bucketize_bin_mean
-        from specpride_tpu.ops.binning import bin_mean_deduped_compact
 
         faults.check("dispatch")
         if self.mesh is None and self.layout != "bucketized":
@@ -613,6 +684,12 @@ class TpuBackend:
         for c in clusters:
             numpy_backend.check_uniform_charge(c.members)
 
+        from specpride_tpu.ops import binning
+
+        kfn = self._kfn(
+            binning.bin_mean_deduped_compact,
+            binning.bin_mean_deduped_compact_donated,
+        )
         out: list[Spectrum | None] = [None] * len(clusters)
         pending = []
         st = self.stats
@@ -620,6 +697,10 @@ class TpuBackend:
             pack_bucketize_bin_mean(clusters, config, self.batch_config)
         ):
             b, k = batch.mz.shape
+            with st.phase("pack"):
+                enc_mz, enc_int, scale, tokens = self._encode_bucketized(
+                    "bin-mean", batch.mz, batch.intensity
+                )
             chunk = max(1, self.max_grid_elements // max(k * 4, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
@@ -634,10 +715,10 @@ class TpuBackend:
                 lcap = _pow2(int(batch.n_members.max(initial=1)))
                 with st.phase("dispatch"):
                     t0 = time.perf_counter()
-                    fused = bin_mean_deduped_compact(
+                    fused = kfn(
                         *self._ship(
-                            _pad_axis0(batch.mz[lo:hi], size),
-                            _pad_axis0(batch.intensity[lo:hi], size),
+                            _pad_axis0(enc_mz[lo:hi], size),
+                            _pad_axis0(enc_int[lo:hi], size),
                             # pad phantom rows with the sentinel so they emit
                             # no output bins
                             _pad_axis0(
@@ -655,7 +736,7 @@ class TpuBackend:
                     # nesting (aggregate_spans, Perfetto) depends on it
                     dt = time.perf_counter() - t0
                 self._note_dispatch(
-                    "bin_mean_bucketized", (size, k, cap, lcap),
+                    "bin_mean_bucketized", (size, k, cap, lcap, *tokens),
                     rows=hi - lo, padded_rows=size,
                     real_elems=lambda lo=lo, hi=hi: (
                         batch.bins[lo:hi] != config.n_bins
@@ -663,18 +744,39 @@ class TpuBackend:
                     padded_elems=size * k,
                     seconds=dt, t_start=t0,
                 )
-                pending.append((batch, lo, hi, cap, fused))
+                pending.append((batch, lo, hi, cap, scale, fused))
 
         fuseds = self._collect([p[-1] for p in pending])
         with st.phase("finalize"):
             self._finalize_bin_mean(pending, fuseds, clusters, out)
         return [s for s in out if s is not None]
 
+    def _encode_bucketized(self, method: str, mz, intensity, **note):
+        """Precision-encode one (B, K) bucketized batch's m/z + intensity
+        channels: ``(enc_mz, enc_int, scale, shape_tokens)``.  f32 is an
+        identity with no tokens, so f32 shape classes (and therefore the
+        jit caches and shape manifests) are byte-identical to pre-
+        precision runs."""
+        if self.precision == "f32":
+            return mz, intensity, None, ()
+        enc_mz, mz_tok = quantize.encode_mz(mz, self.precision)
+        enc_int, scale = quantize.encode_intensity_rows(
+            intensity, self.precision
+        )
+        self._note_precision(
+            method, mz=mz_tok, intensity=self.precision, **note
+        )
+        return enc_mz, enc_int, scale, (self.precision, mz_tok)
+
     def _finalize_bin_mean(self, pending, fuseds, clusters, out) -> None:
-        for (batch, lo, hi, cap, _), fused in zip(pending, fuseds):
+        for (batch, lo, hi, cap, scale, _), fused in zip(pending, fuseds):
             for ci, r_mz, r_int in _iter_compacted(fused, cap, hi - lo):
                 gi = batch.source_indices[lo + ci]
                 members = clusters[gi].members
+                if scale is not None:
+                    # int8 codes were averaged on device; rescale the
+                    # means by the cluster's pack-time scale (linear)
+                    r_int = r_int * float(scale[lo + ci])
                 out[gi] = Spectrum(
                     mz=r_mz,
                     intensity=r_int,
@@ -705,6 +807,15 @@ class TpuBackend:
         for c in clusters:
             numpy_backend.check_uniform_charge(c.members)
         kind = "bin_mean_host" if self.layout == "auto" else "bin_mean_flat"
+        if self.precision != "f32":
+            # reduced precision is a DEVICE-bytes feature: the host path
+            # ships nothing to shrink, so a non-f32 run opts bin-mean
+            # onto the flat device path (journaled once via routing)
+            if kind == "bin_mean_host":
+                self._note_routing(
+                    "bin-mean", "xla", "precision-requested", "precision"
+                )
+            kind = "bin_mean_flat"
         native = False
         if kind == "bin_mean_host" and cos_config is not None:
             from specpride_tpu.ops import cosine_native
@@ -714,7 +825,8 @@ class TpuBackend:
         with st.phase("pack"):
             table = _as_table(clusters)
             data["batches"] = pack_flat_bin_mean(
-                table, config, max_elements=self.max_grid_elements // 4
+                table, config, max_elements=self.max_grid_elements // 4,
+                precision=self.precision,
             )
             if cos_config is not None:
                 if native:
@@ -743,7 +855,9 @@ class TpuBackend:
         batches = prepared.data["batches"]
         st = self.stats
         if prepared.kind == "bin_mean_flat":
-            pending = self._dispatch_flat_batches(batches, config)
+            pending = self._dispatch_flat_batches(
+                batches, config, staged=prepared.data.pop("staged", None)
+            )
             mprep_flat = prepared.data.get("mprep_flat")
             if ccfg is not None and mprep_flat is None:
                 # deferred (serial) member prep: runs while the bin-mean
@@ -796,21 +910,18 @@ class TpuBackend:
             prep = self._prep_cosine_reps(reps, mprep_flat, ccfg)
         return self._dispatch_cosine_flat(prep)
 
-    def _flat_chunk_dispatch(self, batch, config: BinMeanConfig):
-        """One flat chunk: host run pass (counts, oracle-exact quorum,
-        m/z means) + one batched H2D put + the intensity kernel call.
-        Returns ``(device_array, aux)`` where ``aux`` carries the
-        host-computed ``kept_mz`` / ``row_out_offsets`` / ``rows`` that
-        ``_emit_bin_mean_rows`` assembles with the device means.  Shared
-        by the serial flat path and the pipelined native path so the
-        protocol lives once.
+    def _flat_chunk_host_args(self, batch, config: BinMeanConfig):
+        """Host half of one flat chunk dispatch: the run pass (counts,
+        oracle-exact quorum, m/z means), the padded device argument list
+        — precision-encoded when the batch was packed reduced — and the
+        dispatch metadata.  Split from the kernel call so the executor's
+        double-buffered H2D lane (``stage_chunk``) can transfer chunk
+        i+1's arguments while chunk i dispatches.
 
         Input padding uses the half-octave classes like the output caps:
         the measured tunneled H2D link (~90 MB/s with multi-second jitter,
         round-5 profile) makes input bytes the pipeline's largest single
         cost — worth one extra XLA compile class per octave."""
-        from specpride_tpu.ops.binning import bin_mean_flat_intensity
-
         sent = np.int32(2**31 - 1)
         g = batch.gbin
         n = g.size
@@ -828,28 +939,120 @@ class TpuBackend:
         keep_runs = np.zeros(rcap, dtype=bool)
         keep_runs[: aux["keep"].size] = aux["keep"]
 
-        impl = self._impl_for("bin-mean")
-        t0 = time.perf_counter()
-        fused = bin_mean_flat_intensity(
-            *self._put_batch([
+        prec = (
+            batch.precision
+            if getattr(batch, "codes", None) is not None else "f32"
+        )
+        if prec != "f32":
+            # reduced path: the int32 gbin channel collapses to a 1-byte
+            # run-start mask (the kernel only needs boundaries), and
+            # intensity ships as the packer's bf16/int8 codes — the
+            # first padding slot starts the tail run keep_runs drops
+            run_start = np.zeros(n_pad, dtype=bool)
+            run_start[batch.run_starts] = True
+            if n < n_pad:
+                run_start[n] = True
+            if n_pad:
+                run_start[0] = True
+            codes = np.zeros(n_pad, dtype=batch.codes.dtype)
+            codes[:n] = batch.codes
+            args = [codes, run_start, keep_runs]
+            kernel = "bin_mean_flat_q"
+            shape_key = (n_pad, cap, rcap, lcap, prec)
+            self._note_precision(
+                "bin-mean", layout="flat", intensity=prec,
+                gbin="run_mask",
+            )
+        else:
+            args = [
                 np.pad(batch.intensity, (0, n_pad - n)),
                 np.pad(g, (0, n_pad - n), constant_values=sent),
                 keep_runs,
-            ]),
-            total_cap=cap,
-            rcap=rcap,
-            lcap=lcap,
+            ]
+            kernel = "bin_mean_flat_intensity"
+            shape_key = (n_pad, cap, rcap, lcap)
+        meta = dict(
+            kernel=kernel, shape_key=shape_key, n=n, n_pad=n_pad,
+            rows=rows, cap=cap, rcap=rcap, lcap=lcap, precision=prec,
+        )
+        return args, aux, meta
+
+    def _flat_chunk_dispatch(
+        self, batch, config: BinMeanConfig, staged=None
+    ):
+        """One flat chunk: host args (or the H2D lane's pre-staged device
+        arrays) + the intensity kernel call.  Returns ``(device_array,
+        aux)`` where ``aux`` carries the host-computed ``kept_mz`` /
+        ``row_out_offsets`` / ``rows`` that ``_emit_bin_mean_rows``
+        assembles with the device means.  Shared by the serial flat path
+        and the pipelined native path so the protocol lives once."""
+        from specpride_tpu.ops import binning
+
+        impl = self._impl_for("bin-mean")
+        if staged is not None:
+            dev_args, aux, meta = staged
+        else:
+            args, aux, meta = self._flat_chunk_host_args(batch, config)
+            dev_args = self._put_batch(args)
+        if meta["precision"] != "f32":
+            fn = self._kfn(
+                binning.bin_mean_flat_q, binning.bin_mean_flat_q_donated
+            )
+        else:
+            fn = self._kfn(
+                binning.bin_mean_flat_intensity,
+                binning.bin_mean_flat_intensity_donated,
+            )
+        t0 = time.perf_counter()
+        fused = fn(
+            *dev_args,
+            total_cap=meta["cap"],
+            rcap=meta["rcap"],
+            lcap=meta["lcap"],
             impl=impl,
         )
         self._note_dispatch(
-            "bin_mean_flat_intensity" if impl == "scan"
-            else "bin_mean_flat_intensity_pallas",
-            (n_pad, cap, rcap, lcap),
-            rows=rows, padded_rows=rows,
-            real_elems=n, padded_elems=n_pad,
+            meta["kernel"] if impl == "scan"
+            else meta["kernel"] + "_pallas",
+            meta["shape_key"],
+            rows=meta["rows"], padded_rows=meta["rows"],
+            real_elems=meta["n"], padded_elems=meta["n_pad"],
             seconds=time.perf_counter() - t0, t_start=t0,
         )
         return fused, aux
+
+    # -- double-buffered H2D staging (--h2d-buffer) ----------------------
+
+    def supports_h2d_stage(self, prepared) -> bool:
+        """True when ``stage_chunk`` can pre-transfer this prepared
+        chunk's device inputs ahead of dispatch.  Only the flat bin-mean
+        device path stages today: the host paths ship nothing, and the
+        bucketized/mesh layouts interleave packing with per-bucket
+        dispatch (their puts already overlap the previous bucket's
+        kernel)."""
+        return (
+            prepared is not None
+            and getattr(prepared, "kind", None) == "bin_mean_flat"
+        )
+
+    def stage_chunk(self, prepared: "PreparedChunk") -> int:
+        """Double-buffered H2D: transfer a prepared chunk's device
+        arguments NOW, on the executor's transfer lane, so the dispatch
+        lane finds them resident (``pipeline:h2d`` spans wrap the lane's
+        calls).  Returns bytes staged.  The staged device arrays are
+        consumed exactly once by ``_dispatch_flat_batches`` — a retry
+        after a mid-chunk error re-puts from the host numpy the prepared
+        chunk still holds, so donation can never see a buffer twice."""
+        staged = []
+        total = 0
+        for batch in prepared.data["batches"]:
+            args, aux, meta = self._flat_chunk_host_args(
+                batch, prepared.config
+            )
+            total += sum(int(a.nbytes) for a in args)
+            staged.append((self._put_batch(args), aux, meta))
+        prepared.data["staged"] = staged
+        return total
 
     def _host_run_pass(self, batch, config: BinMeanConfig) -> dict:
         """Per-run host pass over one flat chunk's sorted composite:
@@ -917,15 +1120,27 @@ class TpuBackend:
     # sharding changes the economics.  Both now route through
     # ``_prepare_bin_mean`` / ``_finish_bin_mean``.
 
-    def _dispatch_flat_batches(self, batches, config: BinMeanConfig):
+    def _dispatch_flat_batches(
+        self, batches, config: BinMeanConfig, staged=None
+    ):
         """Dispatch prepacked flat chunks asynchronously and start their
         D2H copies; returns the pending list for
-        ``_bin_mean_flat_finish``."""
+        ``_bin_mean_flat_finish``.  ``staged`` (from ``stage_chunk``) is
+        consumed positionally and exactly once — ownership transfers
+        here, so an error mid-list leaves nothing half-donated for a
+        retry to trip over."""
         pending = []
         st = self.stats
-        for batch in batches:
+        for i, batch in enumerate(batches):
             with st.phase("dispatch"):
-                fused, aux = self._flat_chunk_dispatch(batch, config)
+                fused, aux = self._flat_chunk_dispatch(
+                    batch, config,
+                    staged=(
+                        staged[i]
+                        if staged is not None and i < len(staged)
+                        else None
+                    ),
+                )
             # fetch in a background thread now — on the slow device->host
             # link the copy is the critical path, and the caller has host
             # work (the fused pipeline's cosine prep; the next chunk's
@@ -984,6 +1199,16 @@ class TpuBackend:
         Pallas segment-mean kernel — and the decision is journaled,
         unless ``force_device`` pins the requested device kernels."""
         faults.check("dispatch")
+        if self.precision != "f32":
+            # reduced precision is a device-bytes feature: the host path
+            # ships nothing to shrink, so a non-f32 run opts gap-average
+            # onto the bucketized device path (journaled once)
+            if self.mesh is None and self.layout != "bucketized":
+                self._note_routing(
+                    "gap-average", "xla", "precision-requested",
+                    "precision",
+                )
+            return self._run_gap_average_mesh(clusters, config)
         if self.mesh is None and self.layout != "bucketized":
             return self._run_gap_average_host(clusters, config)
         if not self.force_device:
@@ -1232,7 +1457,7 @@ class TpuBackend:
     ) -> list[Spectrum]:
         """Sharded (B, K) bucketized device path (see ``run_gap_average``)."""
         from specpride_tpu.data.packed import pack_bucketize_gap
-        from specpride_tpu.ops.gap_average import gap_average_compact
+        from specpride_tpu.ops import gap_average as ga
 
         _check_no_empty(clusters)
         get_pepmass, get_rt = numpy_backend.resolve_gap_estimators(config)
@@ -1240,6 +1465,9 @@ class TpuBackend:
         kname = (
             "gap_average_compact" if impl == "scan"
             else "gap_average_compact_pallas"
+        )
+        kfn = self._kfn(
+            ga.gap_average_compact, ga.gap_average_compact_donated
         )
 
         out: list[Spectrum | None] = [None] * len(clusters)
@@ -1249,6 +1477,20 @@ class TpuBackend:
             pack_bucketize_gap(clusters, config, self.batch_config)
         ):
             b, k = batch.mz.shape
+            with st.phase("pack"):
+                enc_mz, enc_int, scale, tokens = self._encode_bucketized(
+                    "gap-average", batch.mz, batch.intensity
+                )
+                enc_seg = batch.seg
+                if self.precision != "f32":
+                    # segment ids are < K: exact int16 narrowing when the
+                    # bucket fits (the kernel upcasts; token records it)
+                    seg16 = quantize.narrow_i32_to_i16(
+                        batch.seg, max_valid=k - 1
+                    )
+                    if seg16 is not None:
+                        enc_seg = seg16
+                    tokens = (*tokens, "i16" if seg16 is not None else "i32")
             chunk = max(1, self.max_grid_elements // max(k * 4, 1))
             size = self._dispatch_size(chunk, b)
             for lo, hi in _chunk_ranges(b, chunk):
@@ -1258,11 +1500,11 @@ class TpuBackend:
                 cap = _cap_class(int(batch.n_groups[lo:hi].sum()), floor=1024)
                 with st.phase("dispatch"):
                     t0 = time.perf_counter()
-                    fused = gap_average_compact(
+                    fused = kfn(
                         *self._ship(
-                            _pad_axis0(batch.mz[lo:hi], size),
-                            _pad_axis0(batch.intensity[lo:hi], size),
-                            _pad_axis0(batch.seg[lo:hi], size),
+                            _pad_axis0(enc_mz[lo:hi], size),
+                            _pad_axis0(enc_int[lo:hi], size),
+                            _pad_axis0(enc_seg[lo:hi], size),
                             _pad_axis0(batch.n_valid[lo:hi], size),
                             _pad_axis0(batch.quorum[lo:hi], size),
                             _pad_axis0(batch.n_members[lo:hi], size),
@@ -1273,21 +1515,26 @@ class TpuBackend:
                     )
                     dt = time.perf_counter() - t0  # see bin_mean: span nesting
                 self._note_dispatch(
-                    kname, (size, k, cap),
+                    kname, (size, k, cap, *tokens),
                     rows=hi - lo, padded_rows=size,
                     real_elems=lambda lo=lo, hi=hi: batch.n_valid[lo:hi].sum(),
                     padded_elems=size * k,
                     seconds=dt, t_start=t0,
                 )
-                pending.append((batch, lo, hi, cap, fused))
+                pending.append((batch, lo, hi, cap, scale, fused))
 
         fuseds = self._collect([p[-1] for p in pending])
         with st.phase("finalize"):
-            for (batch, lo, hi, cap, _), fused in zip(pending, fuseds):
+            for (batch, lo, hi, cap, scale, _), fused in zip(
+                pending, fuseds
+            ):
                 for ci, r_mz, r_int in _iter_compacted(fused, cap, hi - lo):
                     gi = batch.source_indices[lo + ci]
                     members = clusters[gi].members
                     pep_mz, pep_z = get_pepmass(members)
+                    if scale is not None:
+                        # int8 codes averaged on device; linear rescale
+                        r_int = r_int * float(scale[lo + ci])
                     out[gi] = Spectrum(
                         mz=r_mz,
                         intensity=r_int,
@@ -1312,13 +1559,13 @@ class TpuBackend:
         path's largest cost on slow links.  ``medoid_device_select=False``
         restores the count fetch + exact float64 host finalize."""
         from specpride_tpu.data.packed import pack_bucketize
-        from specpride_tpu.ops.similarity import (
-            medoid_finalize,
-            medoid_select_packed,
-            shared_bins_packed,
-        )
+        from specpride_tpu.ops import similarity as sim
+        from specpride_tpu.ops.similarity import medoid_finalize
 
-        if self.mesh is None and self.layout == "auto":
+        if (
+            self.mesh is None and self.layout == "auto"
+            and self.precision == "f32"
+        ):
             from specpride_tpu.ops import medoid_native
 
             if medoid_native.available():
@@ -1370,6 +1617,31 @@ class TpuBackend:
                 # bound costs several full host passes over (B, K) int64
                 # to compute — a few extra device scan steps are cheaper
                 lcap = _pow2(k)
+                bin_fill = 2**30
+                tokens: tuple = ()
+                if self.precision != "f32":
+                    # reduced packed path: the medoid ships only integer
+                    # channels, so precision here is EXACT int16
+                    # narrowing of the occupancy grid + member ids when
+                    # the grid fits (outputs bit-identical to f32 runs);
+                    # an oversized grid falls back to int32, journaled
+                    real_max = int(
+                        sbins[sbins < 2**30].max(initial=0)
+                    )
+                    b16 = quantize.narrow_i32_to_i16(sbins, real_max)
+                    if b16 is not None and m < 2**15 - 1:
+                        sbins = b16
+                        smm = smm.astype(np.int16)
+                        bin_fill = 2**15 - 1
+                        tokens = ("i16",)
+                        self._note_precision(
+                            "medoid", bins="i16", member="i16",
+                        )
+                    else:
+                        self._note_precision(
+                            "medoid", bins="i32",
+                            reason="grid-exceeds-int16",
+                        )
             # largest device intermediate is the (K*M,) run×member
             # occupancy; allow it 4x the element budget (1 GB of f32 on a
             # 16 GB chip) — every extra chunk is a dispatch round-trip,
@@ -1380,7 +1652,7 @@ class TpuBackend:
                 with st.phase("dispatch"):
                     t0 = time.perf_counter()
                     args = (
-                        _pad_axis0(sbins[lo:hi], size, fill=2**30),
+                        _pad_axis0(sbins[lo:hi], size, fill=bin_fill),
                         _pad_axis0(smm[lo:hi], size, fill=m),
                     )
                     if self.medoid_device_select:
@@ -1399,16 +1671,22 @@ class TpuBackend:
                         else self._put_batch(list(args))
                     )
                     if self.medoid_device_select:
-                        res = medoid_select_packed(*args, m=m, lcap=lcap)
+                        res = self._kfn(
+                            sim.medoid_select_packed,
+                            sim.medoid_select_packed_donated,
+                        )(*args, m=m, lcap=lcap)
                     else:
-                        res = shared_bins_packed(*args, m=m, lcap=lcap)
+                        res = self._kfn(
+                            sim.shared_bins_packed,
+                            sim.shared_bins_packed_donated,
+                        )(*args, m=m, lcap=lcap)
                     # slice on device first: D2H carries only real rows
                     res = res[: hi - lo]
                     dt = time.perf_counter() - t0  # see bin_mean: span nesting
                 self._note_dispatch(
                     "medoid_select_packed" if self.medoid_device_select
                     else "shared_bins_packed",
-                    (size, k, m, lcap),
+                    (size, k, m, lcap, *tokens),
                     rows=hi - lo, padded_rows=size,
                     real_elems=lambda lo=lo, hi=hi: (smm[lo:hi] != m).sum(),
                     padded_elems=size * k,
@@ -1585,8 +1863,11 @@ class TpuBackend:
         packed peaks + f64-quantized grid bins, returns only the per-member
         cosines (``ops.similarity.cosine_packed``)."""
         from specpride_tpu.data.packed import pack_bucketize
-        from specpride_tpu.ops.similarity import cosine_packed
+        from specpride_tpu.ops import similarity as sim
 
+        cosine_packed = self._kfn(
+            sim.cosine_packed, sim.cosine_packed_donated
+        )
         if len(representatives) != len(clusters):
             raise ValueError("representatives and clusters must align")
         _check_no_empty(clusters)
@@ -1737,8 +2018,15 @@ class TpuBackend:
         (``aux["kept_mz"]``) and the device's compacted intensity means
         (shared by the serial flat finish and the pipelined native path)."""
         flat_int = np.asarray(fused)
-        kept_mz = aux["kept_mz"]
         off = aux["row_out_offsets"]
+        if getattr(batch, "scale", None) is not None:
+            # int8 packed path: the device averaged 7-bit CODES; means
+            # are linear, so the per-cluster scale applies here instead
+            # of ever crossing the link
+            n_tot = int(off[-1])
+            flat_int = flat_int.astype(np.float64, copy=True)
+            flat_int[:n_tot] *= np.repeat(batch.scale, np.diff(off))
+        kept_mz = aux["kept_mz"]
         for ci in range(aux["rows"]):
             o0, o1 = int(off[ci]), int(off[ci + 1])
             gi = batch.source_indices[ci]
@@ -2062,8 +2350,9 @@ class TpuBackend:
         )
 
     def _dispatch_cosine_flat(self, prep: dict) -> np.ndarray:
-        from specpride_tpu.ops.similarity import cosine_flat
+        from specpride_tpu.ops import similarity as sim
 
+        cosine_flat = self._kfn(sim.cosine_flat, sim.cosine_flat_donated)
         st = self.stats
         c = prep["c"]
         sorted_code = prep["sorted_code"]

@@ -107,6 +107,22 @@ def _add_backend(p: argparse.ArgumentParser) -> None:
         "measured static defaults, or the SPECPRIDE_ROUTING env var — "
         "see docs/performance.md)",
     )
+    p.add_argument(
+        "--precision", choices=["f32", "bf16", "int8"], default="f32",
+        help="packed device-channel precision (default f32 = byte-parity "
+        "with every earlier run).  bf16/int8 quantize the packed "
+        "intensity at pack time (plus bf16 m/z where the round trip is "
+        "verified exact, and exact int16 index narrowing), shrinking "
+        "H2D bytes ~2-4x; non-f32 runs are validated against the f32 "
+        "oracle by a QC-cosine tolerance gate (failure aborts; result "
+        "journaled in run_end.precision — see docs/performance.md)",
+    )
+    p.add_argument(
+        "--no-donate", action="store_true",
+        help="disable buffer donation on the chunk loop (default: every "
+        "kernel call donates its packed input buffers so XLA may alias "
+        "them into outputs instead of holding both live; no-op on CPU)",
+    )
 
 
 def _add_execution(p: argparse.ArgumentParser) -> None:
@@ -131,6 +147,16 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
         "bounded reorder buffer so dispatch/checkpoint order is "
         "unchanged (default min(4, cores/4); 0 = the single dedicated "
         "packer thread; active only with --prefetch > 0)",
+    )
+    p.add_argument(
+        "--h2d-buffer", type=int, default=0, metavar="N",
+        help="double-buffered H2D: a dedicated transfer lane device_puts "
+        "the NEXT chunk's packed device inputs (N slots ahead, 2 = "
+        "classic double buffering) while the current chunk dispatches, "
+        "so transfer hides under dispatch (pipeline:h2d spans; overlap "
+        "accounted in run_end.pipeline.h2d).  Active with --prefetch > 0 "
+        "on paths that stage (the flat bin-mean device path); outputs "
+        "are byte-identical either way (default 0 = off)",
     )
     p.add_argument(
         "--async-write", choices=["auto", "on", "off"], default="auto",
@@ -360,6 +386,8 @@ def _get_backend(args):
         mesh=mesh, layout=getattr(args, "layout", "auto"),
         force_device=getattr(args, "force_device", False),
         routing=routing,
+        precision=getattr(args, "precision", "f32") or "f32",
+        donate=not getattr(args, "no_donate", False),
     )
 
 
@@ -964,6 +992,134 @@ def _pooled_chunks(
         for t in threads:
             t.join()
         lanes["reorder_stall_s"] = lanes.get("reorder_stall_s", 0.0) + stall
+
+
+def _h2d_staged_chunks(
+    items, backend, slots: int, lanes: dict,
+):
+    """Double-buffered H2D transfer lane (``--h2d-buffer N``).
+
+    Sits between the pack lane and the dispatch lane: a dedicated
+    transfer thread pulls packed chunks in FIFO order, pre-transfers
+    each stageable chunk's device arguments (``backend.stage_chunk`` —
+    one batched ``device_put`` per flat chunk, under a ``pipeline:h2d``
+    span) into a bounded queue of ``slots`` entries, so while chunk i
+    dispatches, chunk i+1's H2D is already on the wire.  Two slots is
+    classic double buffering; the bound caps device memory at
+    ``slots`` staged chunks.
+
+    Order and error semantics are untouched: items flow FIFO, a staging
+    failure lands on ``item.error`` for the consumer's --on-error
+    policy (the staged buffers are consumed exactly once — a dispatch
+    retry re-puts from host numpy, so buffer donation never sees a
+    stale staged array).  Lane telemetry: busy seconds, staged bytes,
+    and the lane's wait on the pack lane accumulate in ``lanes`` for
+    the run_end ``pipeline.h2d`` summary."""
+    import queue
+    import threading
+    import time as _time
+
+    q: queue.Queue = queue.Queue(maxsize=max(slots, 1))
+    stop = threading.Event()
+    run_ctx = _capture_lane_context()
+    busy = [0.0]
+    staged_bytes = [0]
+    upstream_wait = [0.0]
+    lanes["h2d_busy_s"] = busy
+    lanes["h2d_bytes"] = staged_bytes
+    lanes["h2d_upstream_wait_s"] = upstream_wait
+
+    def _put(obj) -> bool:
+        # same bounded-wait-on-abort protocol as the pack lane
+        while True:
+            if stop.is_set():
+                return False
+            try:
+                q.put(obj, timeout=0.1)
+                return True
+            except queue.Full:
+                if stop.wait(timeout=0.05):
+                    return False
+
+    upstream_error: list = [None]
+
+    def _stager() -> None:
+        _adopt_lane_context(run_ctx)
+        it = iter(items)
+        try:
+            while True:
+                t_wait = _time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                except BaseException as e:  # noqa: BLE001 - re-raised
+                    # an upstream pack-lane failure (e.g. the pool
+                    # exiting without delivering a chunk) must ABORT
+                    # the run on the dispatch lane, exactly as it does
+                    # without the h2d lane — swallowing it here would
+                    # end the stream early and commit a silently
+                    # truncated output
+                    upstream_error[0] = e
+                    return
+                upstream_wait[0] += _time.perf_counter() - t_wait
+                if stop.is_set():
+                    return
+                if (
+                    item.error is None
+                    and item.prepared is not None
+                    and getattr(backend, "supports_h2d_stage", None)
+                    and backend.supports_h2d_stage(item.prepared)
+                ):
+                    t0 = _time.perf_counter()
+                    try:
+                        with tracing.span(
+                            "pipeline:h2d", chunk_index=item.index,
+                        ):
+                            staged_bytes[0] += backend.stage_chunk(
+                                item.prepared
+                            )
+                    except Exception as e:  # noqa: BLE001 - to consumer
+                        item.error = e
+                    busy[0] += _time.perf_counter() - t0
+                if not _put(item):
+                    return
+        finally:
+            _put(None)
+
+    t = threading.Thread(
+        target=_stager, name="specpride-h2d", daemon=True
+    )
+    t.start()
+    try:
+        while True:
+            t0 = _time.perf_counter()
+            item = q.get()
+            waited = _time.perf_counter() - t0
+            if item is None:
+                if upstream_error[0] is not None:
+                    raise upstream_error[0]
+                break
+            item.wait_s = waited
+            if waited >= 1e-3:
+                tracing.current().complete(
+                    "pipeline:idle", t0, waited, chunk_index=item.index
+                )
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join()
+        # the upstream pack generator was being driven by the stager
+        # thread; it is parked now the thread is joined, so closing it
+        # here shuts the pack lanes promptly (not at GC time)
+        close = getattr(items, "close", None)
+        if close is not None:
+            close()
 
 
 class _CommitItem:
@@ -1573,6 +1729,16 @@ def _checkpointed_run_impl(
         )
     else:
         items = _serial_chunks(clusters, worklist)
+    h2d_slots = max(int(getattr(args, "h2d_buffer", 0) or 0), 0)
+    h2d_active = (
+        pipelined and h2d_slots > 0
+        and getattr(backend, "supports_h2d_stage", None) is not None
+        and can_prepare
+    )
+    if h2d_active:
+        # double-buffered H2D: a transfer lane between pack and dispatch
+        # device_puts chunk i+1's arguments while chunk i dispatches
+        items = _h2d_staged_chunks(items, backend, h2d_slots, lanes)
     aw = getattr(args, "async_write", "auto")
     committer = (
         _Committer(
@@ -1801,6 +1967,26 @@ def _checkpointed_run_impl(
             ),
             "reorder_stall_s": round(lanes["reorder_stall_s"], 4),
         }
+        if h2d_active:
+            # H2D transfer-lane summary: staged bytes/busy time, the
+            # dispatch-lane starvation attributable to staging (total
+            # starvation minus what the lane itself spent waiting on
+            # the pack lane), and the hidden-transfer fraction
+            h2d_busy = lanes["h2d_busy_s"][0]
+            h2d_stall = max(
+                0.0, idle_s - lanes["h2d_upstream_wait_s"][0]
+            )
+            stats.pipeline["h2d"] = {
+                "slots": h2d_slots,
+                "busy_s": round(h2d_busy, 4),
+                "bytes": int(lanes["h2d_bytes"][0]),
+                "stall_s": round(h2d_stall, 4),
+                "overlap_efficiency": (
+                    round(
+                        max(0.0, 1.0 - h2d_stall / h2d_busy), 4
+                    ) if h2d_busy > 0 else 1.0
+                ),
+            }
     if failed:
         logger.warning(
             "%d clusters failed and were skipped: %s%s",
@@ -1972,6 +2158,121 @@ def _clusters_from_mzml(path: str, args, stats: RunStats) -> list[Cluster]:
     return group_into_clusters(out)
 
 
+# clusters re-verified against the f32 oracle per reduced-precision run:
+# bounded so the gate stays a fixed cost however large the input is (the
+# per-cluster property being validated — quantization drift under THIS
+# config on THIS data distribution — is i.i.d. across clusters)
+_PRECISION_GATE_SAMPLE = 32
+
+
+def _precision_gate(args, backend, clusters, method, stats, journal):
+    """QC-cosine tolerance gate for reduced-precision runs: recompute a
+    deterministic sample of clusters at the run's precision AND at f32,
+    and require every pair's binned cosine to clear the documented
+    per-(method, precision) tolerance (``ops.quantize.
+    precision_tolerance``).  f32 runs skip — they ARE the oracle.
+
+    The gate runs on twin backends with private telemetry so its
+    dispatches never pollute the run's own byte/compile accounting
+    (the CI precision pass compares journaled h2d_bytes across
+    precisions).  Results land in ``run_end.precision``; a breach
+    journals first, then aborts the run with a nonzero exit — a
+    reduced-precision output that cannot demonstrate fidelity on its
+    own data must not pass silently."""
+    import dataclasses as _dc
+
+    precision = getattr(backend, "precision", "f32")
+    if precision == "f32":
+        return
+    if method not in ("bin-mean", "gap-average", "medoid"):
+        stats.precision = {"precision": precision, "gated": False}
+        return
+    if not _dc.is_dataclass(type(backend)):
+        # a batched member job runs against the batcher's read-only
+        # result view (serve.BatchResultBackend), which forwards the
+        # resident backend's precision but cannot be twinned; fidelity
+        # of the shared dispatch is gated by the daemon's solo jobs on
+        # the same backend — record, don't crash
+        stats.precision = {
+            "precision": precision, "gated": False,
+            "reason": "shared-batch-member",
+        }
+        return
+
+    from specpride_tpu.backends import numpy_backend as _nb
+    from specpride_tpu.ops.quantize import precision_tolerance
+
+    n = min(len(clusters), _PRECISION_GATE_SAMPLE)
+    sample = [
+        c for c in (clusters[i] for i in range(n)) if c.n_members > 0
+    ]
+    tol = precision_tolerance(method, precision)
+    if not sample:
+        stats.precision = {
+            "precision": precision, "gated": False, "tolerance": tol,
+        }
+        return
+
+    def _twin(prec: str):
+        return _dc.replace(
+            backend, precision=prec, stats=RunStats(),
+            metrics=MetricsRegistry(), journal=NullJournal(),
+            _seen_shapes=set(), _routing_noted=set(),
+            _precision_noted=set(),
+        )
+
+    cfg = _method_config(method, args)
+    ccfg = _cosine_config(args)
+    with stats.phase("compute"), tracing.span(
+        "precision_gate", n_clusters=len(sample), precision=precision,
+    ):
+        if method == "medoid":
+            red = _twin(precision).medoid_indices(sample, cfg)
+            ref = _twin("f32").medoid_indices(sample, cfg)
+            cosines = [
+                1.0 if a == b else _nb.binned_cosine(
+                    c.members[a], c.members[b], ccfg
+                )
+                for a, b, c in zip(red, ref, sample)
+            ]
+        elif method == "bin-mean":
+            red = _twin(precision).run_bin_mean(sample, cfg)
+            ref = _twin("f32").run_bin_mean(sample, cfg)
+            cosines = [
+                _nb.binned_cosine(a, b, ccfg) for a, b in zip(red, ref)
+            ]
+        else:
+            red = _twin(precision).run_gap_average(sample, cfg)
+            ref = _twin("f32").run_gap_average(sample, cfg)
+            cosines = [
+                _nb.binned_cosine(a, b, ccfg) for a, b in zip(red, ref)
+            ]
+    min_cos = float(min(cosines)) if cosines else 1.0
+    ok = min_cos >= tol
+    result = {
+        "precision": precision,
+        "gated": True,
+        "checked": len(sample),
+        "min_cosine": round(min_cos, 6),
+        "mean_cosine": round(sum(cosines) / len(cosines), 6),
+        "tolerance": tol,
+        "ok": ok,
+    }
+    stats.precision = result
+    journal.emit("precision", method=method, **result)
+    if not ok:
+        raise SystemExit(
+            f"precision gate FAILED: {method} at --precision {precision} "
+            f"scored min cosine {min_cos:.6f} vs the f32 oracle over "
+            f"{len(sample)} sampled clusters (tolerance {tol}); rerun at "
+            "f32 or a wider tolerance precision"
+        )
+    logger.info(
+        "precision gate: %s %s min_cosine=%.6f >= %.4g over %d clusters",
+        method, precision, min_cos, tol, len(sample),
+    )
+
+
 def _warmup_manifest_path(args) -> str | None:
     """The shape-manifest path this run reads/writes: the explicit
     ``--warmup-manifest``, else the default beside the compile cache
@@ -2063,7 +2364,12 @@ def _run_warmup(args, backend, journal) -> None:
             len(entries), _WARMUP_MAX_ENTRIES, path,
         )
         entries = entries[:_WARMUP_MAX_ENTRIES]
-    warm_entries(entries, journal=journal)
+    warm_entries(
+        entries, journal=journal,
+        # warm the jit twin the run will actually dispatch (donation
+        # resolves off on cpu-only hosts — the backend knows)
+        donate=getattr(backend, "_donate_effective", False),
+    )
 
 
 # concurrent serving lanes finish jobs (and therefore merge shape
@@ -2318,6 +2624,12 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         **({"plan_cache": plan_cache} if plan_cache is not None else {}),
         **({"shape_classes": shape_classes} if shape_classes is not None
            else {}),
+        # reduced-precision summary (absent on f32 runs): the precision,
+        # the sampled QC-cosine gate result vs the f32 oracle, and the
+        # documented tolerance it cleared — see docs/performance.md
+        **({"precision": stats.precision} if getattr(
+            stats, "precision", None
+        ) else {}),
         # which serving worker lane ran this job (absent on one-shot
         # runs): with concurrent lanes sharing one daemon, a job journal
         # must stay attributable to the lane — and backend — that ran it
@@ -2650,7 +2962,17 @@ def _run_pipeline_command(args, command: str, backend=None) -> dict:
         if getattr(args, "elastic", None):
             # dynamic chunk-range distribution with rank-fault tolerance
             # replaces the single checkpointed run below; _run_elastic
-            # owns its (per-rank) journal and run_end
+            # owns its (per-rank) journal and run_end.  The precision
+            # gate runs FIRST — before this rank claims any range — so
+            # a reduced-precision configuration that cannot demonstrate
+            # fidelity on this data aborts before computing anything
+            # (the verdict rides stats.precision into each range's
+            # run_end; the per-rank journal is not open yet, so the
+            # standalone gate event goes unjournaled here)
+            _precision_gate(
+                args, backend, clusters, args.method, stats,
+                NullJournal(),
+            )
             _run_elastic(
                 args, command, clusters, backend, scores, stats,
                 quarantine,
@@ -2669,6 +2991,12 @@ def _run_pipeline_command(args, command: str, backend=None) -> dict:
         if qc is not None:
             _write_qc_report(args, backend, clusters, qc, stats, resumed,
                              failed, qc_failed)
+        # reduced-precision runs must demonstrate fidelity on their own
+        # data before the run may succeed (journals run_end.precision;
+        # a breach aborts here, after the QC report, so the evidence an
+        # operator needs to diagnose it is already on disk)
+        _precision_gate(args, backend, clusters, args.method, stats,
+                        journal)
         _save_shape_manifest(args, backend)
         if command == "consensus":
             logger.info(
@@ -2739,7 +3067,17 @@ def cmd_warmup(args) -> int:
     )
     snapshot = ws_cache.counters_snapshot()
     t0 = _time.perf_counter()
-    results = warm_entries(entries, journal=journal, jobs=args.jobs)
+    from specpride_tpu.backends.tpu_backend import _cpu_only_devices
+
+    results = warm_entries(
+        entries, journal=journal, jobs=args.jobs,
+        # match the twin a run on this host will dispatch: donation
+        # resolves off on cpu-only hosts, off with --no-donate
+        donate=(
+            not getattr(args, "no_donate", False)
+            and not _cpu_only_devices()
+        ),
+    )
     elapsed = _time.perf_counter() - t0
     n_hits = sum(r.cache_hit for r in results)
     n_compiled = sum(r.status == "compiled" for r in results)
@@ -2808,6 +3146,8 @@ def cmd_serve(args) -> int:
         routing_table=args.routing_table,
         layout=args.layout,
         force_device=args.force_device,
+        precision=getattr(args, "precision", "f32") or "f32",
+        donate=not getattr(args, "no_donate", False),
         warmup=args.warmup,
         warmup_manifest=args.warmup_manifest,
         warmup_jobs=args.warmup_jobs,
@@ -3452,6 +3792,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="append warmup events (per-kernel compile-vs-cache-hit, "
         "seconds) to this JSONL journal",
     )
+    pwu.add_argument(
+        "--no-donate", action="store_true",
+        help="warm the NON-donating jit twins (match runs that use "
+        "--no-donate: the aliasing spec is part of the compiled "
+        "executable, so warming the wrong twin populates the wrong "
+        "persistent-cache entry)",
+    )
     pwu.set_defaults(fn=cmd_warmup)
 
     psv = sub.add_parser(
@@ -3526,6 +3873,17 @@ def build_parser() -> argparse.ArgumentParser:
     psv.add_argument(
         "--force-device", action="store_true",
         help="pin device kernels on CPU-only jax (see consensus --help)",
+    )
+    psv.add_argument(
+        "--precision", choices=["f32", "bf16", "int8"], default="f32",
+        help="packed device-channel precision for every lane's resident "
+        "backend (see consensus --help; boot-owned — jobs cannot "
+        "override it)",
+    )
+    psv.add_argument(
+        "--no-donate", action="store_true",
+        help="disable buffer donation on the resident backends (see "
+        "consensus --help; boot-owned)",
     )
     psv.add_argument(
         "--warmup", choices=["auto", "manifest", "off"], default="auto",

@@ -611,6 +611,15 @@ class FlatBinBatch:
     run_starts: np.ndarray  # (R,) i64 run-start positions within the chunk
     cluster_ids: list[str]
     source_indices: list[int]
+    # reduced-precision packed path (--precision {f32,bf16,int8}): the
+    # encoded intensity channel the DEVICE flat path ships instead of
+    # f32 — bf16 codes, or int8 codes against a per-cluster ``scale``
+    # the host applies to the fetched means (scale never crosses the
+    # link).  f32 runs leave all three at their defaults; the f32
+    # ``intensity`` stays for the host paths and byte-parity oracle.
+    precision: str = "f32"
+    codes: np.ndarray | None = None  # (N,) bf16 | int8
+    scale: np.ndarray | None = None  # (rows,) f32, int8 only
 
 
 @tracing.traced("pack:flat_bin_mean")
@@ -618,11 +627,17 @@ def pack_flat_bin_mean(
     clusters_or_table,
     bin_config,
     max_elements: int = 16 * 1024 * 1024,
+    precision: str = "f32",
 ) -> list[FlatBinBatch]:
     """Quantize (f64), dedup, and lay out ALL kept peaks flat, sorted by
     (cluster, bin) — one vectorized pass, no buckets, no per-row padding.
     Chunked so each batch holds <= ``max_elements`` peaks and the (row, bin)
-    composite stays inside int32."""
+    composite stays inside int32.
+
+    ``precision`` != "f32" additionally quantizes the intensity channel AT
+    PACK TIME (``ops.quantize.encode_intensity_flat``) into per-chunk
+    ``codes`` (+ per-cluster int8 ``scale``) for the reduced-precision
+    device flat path; f32 is a strict identity — byte-parity guaranteed."""
     table = _as_table(clusters_or_table)
     idx = table.cluster_order()
     n_bins = bin_config.n_bins
@@ -692,6 +707,13 @@ def pack_flat_bin_mean(
         # chunk boundaries are row boundaries, so first[p0] is always a
         # run start — chunk-local positions need no fixup
         run_starts = np.flatnonzero(first[p0:p1])
+        codes = scale = None
+        if precision != "f32":
+            from specpride_tpu.ops import quantize
+
+            codes, scale = quantize.encode_intensity_flat(
+                s_int[p0:p1], row_peak_offsets[lo : hi + 1] - p0, precision
+            )
         batches.append(
             FlatBinBatch(
                 mz=s_mz[p0:p1],
@@ -702,6 +724,9 @@ def pack_flat_bin_mean(
                 run_starts=run_starts,
                 cluster_ids=[table.cluster_names[i] for i in range(lo, hi)],
                 source_indices=list(range(lo, hi)),
+                precision=precision,
+                codes=codes,
+                scale=scale,
             )
         )
         lo = hi

@@ -261,6 +261,18 @@ class ServeTelemetry:
         self.batch_jobs.inc(0)
         self.batch_clusters.inc(0)
         self.batch_occupancy.set(0.0)
+        # device transfer rollups (memory-bandwidth campaign): summed
+        # across worker-lane backend registries by delta at scrape time
+        # (sync_singletons); pre-registered at 0 so a daemon that never
+        # dispatched still exposes auditable byte series
+        r.counter(
+            "specpride_h2d_bytes_total",
+            "bytes shipped host->device across all worker lanes",
+        ).inc(0)
+        r.counter(
+            "specpride_d2h_bytes_total",
+            "bytes fetched device->host across all worker lanes",
+        ).inc(0)
 
     # -- event hooks (worker / reader threads) -------------------------
 
@@ -376,6 +388,28 @@ class ServeTelemetry:
                 ic["misses"], "served eager parses that populated the "
                 "ingest cache"),
         }
+        # device transfer totals: the per-lane backend registries each
+        # count H2D/D2H bytes (specpride_bytes_*_total); mirror their
+        # SUM as serve-level counters by delta, exactly like the
+        # compile-cache series — backend registries stay resident and
+        # monotone in a daemon, so the sum is too
+        byte_srcs = list(self.extra_registries) + list(
+            self.worker_registries.values()
+        )
+        totals["specpride_h2d_bytes_total"] = (
+            sum(
+                r.sum_counter("specpride_bytes_h2d_total")
+                for r in byte_srcs
+            ),
+            "bytes shipped host->device across all worker lanes",
+        )
+        totals["specpride_d2h_bytes_total"] = (
+            sum(
+                r.sum_counter("specpride_bytes_d2h_total")
+                for r in byte_srcs
+            ),
+            "bytes fetched device->host across all worker lanes",
+        )
         with self._lock:
             for name, (total, help_) in totals.items():
                 last = self._singletons_last.get(name, 0.0)

@@ -51,6 +51,11 @@ EVENT_FIELDS: dict[str, frozenset] = {
     # execution path for the requested layout (e.g. gap-average on a
     # CPU-only host) — emitted once per backend per decision
     "routing": frozenset({"method", "path", "reason"}),
+    # reduced-precision packed paths (--precision): emitted once per
+    # backend per method with the channel encodings a run actually
+    # shipped (the pack-time probes decide per workload), and once per
+    # run by the CLI's QC-cosine tolerance gate with its verdict
+    "precision": frozenset({"method", "precision"}),
     # robustness layer (specpride_tpu.robustness): an injected fault
     # fired at a named site; each must pair with a later recovery event
     # (retry / degrade / resume_repair / quarantine / skipped_clusters)

@@ -283,6 +283,21 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
                 "device_peak_bytes_in_use", 0
             ),
         )
+        # derived transfer bandwidth: per-run H2D/D2H byte totals over
+        # the run wall (PR1 journaled the bytes; never rendered as a
+        # rate until the memory-bandwidth campaign made it the headline)
+        elapsed = end.get("elapsed_s") or 0
+        if elapsed and (run["bytes_h2d"] or run["bytes_d2h"]):
+            mb = 1024.0 * 1024.0
+            run["bandwidth"] = {
+                "h2d_mb": round(run["bytes_h2d"] / mb, 3),
+                "d2h_mb": round(run["bytes_d2h"] / mb, 3),
+                "h2d_mb_per_s": round(run["bytes_h2d"] / mb / elapsed, 3),
+                "d2h_mb_per_s": round(run["bytes_d2h"] / mb / elapsed, 3),
+            }
+        prec = end.get("precision")
+        if prec:
+            run["precision"] = prec
         pipeline = end.get("pipeline")
         if pipeline:
             # multi-lane chunk executor (--prefetch / --pack-workers /
@@ -293,7 +308,7 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
             run["overlap_efficiency"] = pipeline.get("overlap_efficiency")
             for key in (
                 "pack_workers", "async_write", "wall_s", "pack_busy_s",
-                "write_busy_s", "reorder_stall_s",
+                "write_busy_s", "reorder_stall_s", "h2d",
             ):
                 if pipeline.get(key) is not None:
                     run[key] = pipeline[key]
@@ -568,6 +583,30 @@ def _render_run(run: dict, out, slo: bool = False) -> None:
         f"h2d={run['bytes_h2d']}B d2h={run['bytes_d2h']}B "
         f"peak_device_mem={run['device_peak_bytes_in_use']}B", file=out,
     )
+    bw = run.get("bandwidth")
+    if bw:
+        bits = [
+            f"h2d={bw['h2d_mb']}MB ({bw['h2d_mb_per_s']}MB/s)",
+            f"d2h={bw['d2h_mb']}MB ({bw['d2h_mb_per_s']}MB/s)",
+        ]
+        h2d_lane = run.get("h2d")
+        if h2d_lane:
+            bits.append(
+                f"staged={h2d_lane.get('bytes', 0)}B "
+                f"overlap={h2d_lane.get('overlap_efficiency')}"
+            )
+        print(f"  bandwidth: {' '.join(bits)}", file=out)
+    prec = run.get("precision")
+    if prec:
+        bits = [f"precision={prec.get('precision')}"]
+        if prec.get("gated"):
+            bits.append(
+                f"gate={'ok' if prec.get('ok') else 'FAILED'} "
+                f"min_cosine={prec.get('min_cosine')} "
+                f"tolerance={prec.get('tolerance')} "
+                f"checked={prec.get('checked')}"
+            )
+        print(f"  precision: {' '.join(bits)}", file=out)
 
 
 def _read_new_events(path: str, offset: int) -> tuple[list[dict], int]:

@@ -16,12 +16,11 @@ and no sort runs on device (TPU sorts were the dominant kernel cost).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
 from specpride_tpu.config import BinMeanConfig
+from specpride_tpu.ops.jit_util import jit_pair
 
 
 def _bin_mean_deduped_stats(
@@ -44,6 +43,13 @@ def _bin_mean_deduped_stats(
     row's member count; K is always safe — the padding run may exceed
     lcap, but its windowed sums are masked out by ``valid``)."""
     from specpride_tpu.ops import segments as sg
+
+    # reduced-precision packed inputs (--precision): upcast to the f32
+    # compute dtype at entry — exact for bf16-exact m/z and for int8
+    # intensity codes (the host rescales fetched means by the row scale)
+    mz = mz.astype(jnp.float32)
+    intensity = intensity.astype(jnp.float32)
+    bins = bins.astype(jnp.int32)
 
     k = bins.shape[1]
     n_bins = config.n_bins
@@ -68,10 +74,7 @@ def _bin_mean_deduped_stats(
     return mz_sum / safe, inten_sum / safe, keep_bin
 
 
-@functools.partial(
-    jax.jit, static_argnames=("total_cap", "rcap", "lcap", "impl")
-)
-def bin_mean_flat_intensity(
+def _bin_mean_flat_intensity(
     intensity: jax.Array,  # (N,) f32, sorted by (row, bin); tail padding
     gbin: jax.Array,  # (N,) i32 row*(n_bins+1)+bin, sentinel 2**31-1
     keep_runs: jax.Array,  # (rcap,) bool HOST-computed quorum keep, in run
@@ -132,10 +135,73 @@ def bin_mean_flat_intensity(
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("config", "total_cap", "lcap")
+bin_mean_flat_intensity, bin_mean_flat_intensity_donated = jit_pair(
+    _bin_mean_flat_intensity,
+    static_argnames=("total_cap", "rcap", "lcap", "impl"),
+    donate_argnums=(0, 1, 2),
 )
-def bin_mean_deduped_compact(
+
+
+def _bin_mean_flat_q(
+    codes: jax.Array,  # (N,) bf16 | int8 intensity codes, (row, bin) order
+    run_start: jax.Array,  # (N,) bool — True at every (row, bin) run start
+    #   AND at the first padding slot, so the tail is its own dropped run
+    keep_runs: jax.Array,  # (rcap,) bool HOST-computed quorum keep
+    total_cap: int,
+    rcap: int,  # pow2 >= run count incl. the padding tail run
+    lcap: int,  # pow2 >= longest real run (the tail run may exceed it —
+    #   its windowed sums are garbage but keep_runs never selects it)
+    impl: str = "scan",  # "scan" | "pallas" | "pallas_interpret"
+):
+    """Reduced-precision twin of ``bin_mean_flat_intensity``: the
+    composite int32 ``gbin`` channel (4 B/peak) is replaced by a 1-byte
+    run-start mask — the kernel only ever used gbin for run boundaries
+    and padding detection, both of which the host's sorted pack pass
+    already knows — and intensity ships as bf16/int8 codes (2/1 B/peak).
+    H2D per peak drops 8 B -> 3 B (bf16) / 2 B (int8); int8 means are
+    rescaled by the per-cluster scale on the HOST (means are linear, so
+    the scale never crosses the link).
+
+    Padding needs no weight mask: the first padding slot is marked as a
+    run start, so the tail forms one run whose (garbage) mean is never
+    selected by ``keep_runs`` — real runs are exactly the host's."""
+    from specpride_tpu.ops import segments as sg
+
+    x = codes.astype(jnp.float32)
+    w = jnp.ones_like(x)
+    starts = run_start
+    if impl == "scan":
+        (counts, s), _ = sg.run_sums(starts, (w, x), rcap, lcap)
+        inten_mean = s / jnp.maximum(counts, 1.0)
+    else:
+        from specpride_tpu.ops import pallas_kernels as pk
+
+        n = x.shape[0]
+        pad = pk.pad_to_block(n) - n
+        # run ids from the start mask make the 1-D keyed kernel work
+        # without a key channel ever crossing the link
+        key = jnp.cumsum(starts.astype(jnp.int32)) - 1
+        inten_mean = pk.seg_mean_pallas(
+            jnp.pad(key, (0, pad), constant_values=-1),
+            jnp.pad(w, (0, pad)),
+            jnp.pad(x, (0, pad)),
+            interpret=(impl == "pallas_interpret"),
+        )[1][sg.run_end_positions(starts, rcap)]
+    (idx,) = jnp.nonzero(keep_runs, size=total_cap, fill_value=rcap)
+    ok = idx < rcap
+    return jnp.where(
+        ok, inten_mean.at[idx].get(mode="fill", fill_value=0.0), 0.0
+    )
+
+
+bin_mean_flat_q, bin_mean_flat_q_donated = jit_pair(
+    _bin_mean_flat_q,
+    static_argnames=("total_cap", "rcap", "lcap", "impl"),
+    donate_argnums=(0, 1, 2),
+)
+
+
+def _bin_mean_deduped_compact(
     mz: jax.Array,  # (B, K) f32
     intensity: jax.Array,  # (B, K) f32
     bins: jax.Array,  # (B, K) i32
@@ -172,5 +238,12 @@ def bin_mean_deduped_compact(
         0.0,
     )
     return jnp.concatenate([flat_mz, flat_int, n_out])
+
+
+bin_mean_deduped_compact, bin_mean_deduped_compact_donated = jit_pair(
+    _bin_mean_deduped_compact,
+    static_argnames=("config", "total_cap", "lcap"),
+    donate_argnums=(0, 1, 2, 3),
+)
 
 

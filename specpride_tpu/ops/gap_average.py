@@ -42,12 +42,11 @@ data-dependent and measure-zero for measured intensities.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
 from specpride_tpu.config import GapAverageConfig
+from specpride_tpu.ops.jit_util import jit_pair
 
 
 def _gap_average_segment_stats(
@@ -73,6 +72,14 @@ def _gap_average_segment_stats(
     so the 1-D kernel respects row boundaries by construction); the
     routing table in the tpu backend picks per platform."""
     from specpride_tpu.ops import segments as sg
+
+    # reduced-precision packed inputs (--precision): upcast at entry —
+    # exact for bf16-exact m/z, int8 intensity codes (host rescales the
+    # fetched means by the per-cluster scale; the dyn-range keep compare
+    # is scale-invariant within a row), and int16-narrowed segment ids
+    mz = mz.astype(jnp.float32)
+    intensity = intensity.astype(jnp.float32)
+    seg = seg.astype(jnp.int32)
 
     b, k = mz.shape
     valid = jnp.arange(k)[None, :] < n_valid[:, None]
@@ -129,10 +136,7 @@ def _gap_average_segment_stats(
     return group_mz, group_int, keep
 
 
-@functools.partial(
-    jax.jit, static_argnames=("config", "total_cap", "impl")
-)
-def gap_average_compact(
+def _gap_average_compact(
     mz: jax.Array,  # (B, K) f32
     intensity: jax.Array,  # (B, K) f32
     seg: jax.Array,  # (B, K) i32
@@ -170,3 +174,10 @@ def gap_average_compact(
         0.0,
     )
     return jnp.concatenate([flat_mz, flat_int, n_out])
+
+
+gap_average_compact, gap_average_compact_donated = jit_pair(
+    _gap_average_compact,
+    static_argnames=("config", "total_cap", "impl"),
+    donate_argnums=(0, 1, 2, 3, 4, 5),
+)

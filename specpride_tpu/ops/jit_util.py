@@ -1,0 +1,44 @@
+"""Shared jit construction helpers for the device kernels.
+
+Buffer donation (ROADMAP item 5): every packed input array a dispatch
+ships is consumed exactly once — the chunk loop never reads a shipped
+buffer again after the kernel call — so donating the inputs lets XLA
+reuse their device memory for the kernel's outputs instead of keeping
+both resident across the dispatch.  On accelerators that honor
+input-output aliasing this halves the chunk loop's peak device
+footprint for the big (B, K)/(N,) channels; on CPU (and for host numpy
+inputs jax transfers implicitly) donation is a documented no-op — jax
+warns "Some donated buffers were not usable", which would fire once per
+dispatch, so the filter below silences exactly that message.
+
+``jit_pair`` builds the plain and donating twins of one kernel from the
+same underlying function, so the two can never drift semantically: the
+backend picks per call via its ``donate`` field (``--no-donate`` is the
+escape hatch), and the warmup registry rebuilds whichever variant the
+run will dispatch.
+
+jax's "Some donated buffers were not usable" warning is deliberately
+NOT filtered here: the backend already resolves donation off on
+CPU-only hosts (where it would always fire), so on accelerator hosts
+the warning is the one signal that a donated buffer silently stopped
+aliasing — exactly the regression an operator must see.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def jit_pair(fn, static_argnames, donate_argnums):
+    """``(plain, donated)`` jitted twins of ``fn``.
+
+    ``donate_argnums`` must cover only the array arguments (the static
+    ones are keyword-bound via ``static_argnames`` at every call site).
+    Each twin owns its own jit cache; call sites must pick ONE per run
+    (the persistent compile cache keys include the aliasing spec, so
+    mixing would double the compile bill for nothing)."""
+    plain = jax.jit(fn, static_argnames=static_argnames)
+    donated = jax.jit(
+        fn, static_argnames=static_argnames, donate_argnums=donate_argnums
+    )
+    return plain, donated

@@ -141,6 +141,149 @@ def cosine_edge_count(last_mz, space):
     return np.where(np.isfinite(n), np.maximum(n, 0), 0).astype(np.int32)
 
 
+# ---------------------------------------------------------------------------
+# Reduced-precision packed encodings (--precision {f32,bf16,int8})
+# ---------------------------------------------------------------------------
+#
+# Representation precision is a tunable quality/cost axis (arXiv:2502.10851;
+# SpecHD shows low-precision packed encodings keep MS similarity quality).
+# These helpers quantize the PACKED device channels at pack/ship time so the
+# H2D link carries fewer bytes; the QC-cosine kernels always run at full
+# precision (they are the judge side of the tolerance gate, never the
+# defendant).  f32 is the byte-parity default: every encoder is an exact
+# identity there.
+
+PRECISIONS = ("f32", "bf16", "int8")
+
+# minimum rep-vs-f32-oracle cosine the per-run gate enforces for a reduced
+# run (sampled clusters; see cli._precision_gate).  The documented
+# tolerance table — docs/performance.md "Memory bandwidth & precision".
+# int8 stores intensity as 7-bit codes against a per-cluster scale
+# (relative error <= 1/254 of the row max), bf16 keeps 8 mantissa bits
+# (<= 2^-9 relative); cosine is intensity-weighted, so the bounds below
+# leave an order of magnitude of slack over the worst measured drift.
+PRECISION_MIN_COSINE: dict[tuple[str, str], float] = {
+    ("bin-mean", "bf16"): 0.9995,
+    ("bin-mean", "int8"): 0.995,
+    ("gap-average", "bf16"): 0.9995,
+    ("gap-average", "int8"): 0.995,
+    # medoid picks an INDEX: narrowing its integer channels is exact when
+    # the grid fits int16, so any divergence means a genuine near-tie —
+    # gate on the two chosen members being near-identical spectra
+    ("medoid", "bf16"): 0.999,
+    ("medoid", "int8"): 0.999,
+}
+
+
+def precision_tolerance(method: str, precision: str) -> float:
+    """Minimum gate cosine for (method, precision); f32 demands exact."""
+    if precision == "f32":
+        return 1.0
+    return PRECISION_MIN_COSINE.get((method, precision), 0.995)
+
+
+def _bf16():
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+def bf16_exact(arr: np.ndarray) -> bool:
+    """True when every value round-trips f32 -> bf16 -> f32 exactly.
+
+    The pack-time "bf16 m/z where exact" probe: synthetic/coarse-grid m/z
+    (and instrument exports with truncated precision) survive the cast
+    bit-exactly, so the device's f32 upcast reproduces the f32 sums
+    byte-identically; noisy full-precision m/z fails the probe and ships
+    f32 — the m/z channel never silently degrades."""
+    a = np.asarray(arr, dtype=np.float32)
+    return bool(np.array_equal(a.astype(_bf16()).astype(np.float32), a))
+
+
+def encode_mz(mz: np.ndarray, precision: str) -> tuple[np.ndarray, str]:
+    """``(encoded, token)`` for a packed m/z channel: bf16 only when the
+    round trip is exact (token "bf16"), else the f32 input unchanged
+    (token "f32").  f32 precision is an identity."""
+    if precision == "f32" or not bf16_exact(mz):
+        return np.asarray(mz, dtype=np.float32), "f32"
+    return np.asarray(mz, dtype=np.float32).astype(_bf16()), "bf16"
+
+
+def encode_intensity_rows(
+    intensity: np.ndarray, precision: str
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Encode a (B, K) packed intensity channel.  Returns
+    ``(codes, scale)``:
+
+    * f32: identity, scale None
+    * bf16: bf16 cast, scale None (device upcasts; means stay f32 math)
+    * int8: per-ROW symmetric 7-bit codes ``round(x / scale)`` with
+      ``scale = rowmax / 127`` (f32, per cluster row).  The scale never
+      ships: segment means are linear, so the HOST rescales the fetched
+      means by the row scale instead (``scale`` is returned for that).
+    """
+    x = np.asarray(intensity, dtype=np.float32)
+    if precision == "f32":
+        return x, None
+    if precision == "bf16":
+        return x.astype(_bf16()), None
+    if precision != "int8":
+        raise ValueError(f"unknown precision {precision!r}")
+    rowmax = np.abs(x).max(axis=-1)
+    scale = np.where(rowmax > 0, rowmax / 127.0, 1.0).astype(np.float32)
+    codes = np.clip(
+        np.round(x / scale[..., None]), -127, 127
+    ).astype(np.int8)
+    return codes, scale
+
+
+def encode_intensity_flat(
+    intensity: np.ndarray, row_offsets: np.ndarray, precision: str
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Flat-layout twin of :func:`encode_intensity_rows`: ``intensity``
+    is (N,) with cluster rows at ``row_offsets`` (len rows+1) slices.
+    int8 scales are per ROW (cluster) — one f32 per cluster, recovered
+    host-side after the device mean."""
+    x = np.asarray(intensity, dtype=np.float32)
+    if precision == "f32":
+        return x, None
+    if precision == "bf16":
+        return x.astype(_bf16()), None
+    if precision != "int8":
+        raise ValueError(f"unknown precision {precision!r}")
+    rows = row_offsets.size - 1
+    if x.size:
+        rowmax = np.maximum.reduceat(
+            np.abs(np.append(x, np.float32(0.0))),
+            np.minimum(row_offsets[:-1], x.size),
+        )[:rows]
+        # empty rows repeat a neighbour's start; force their max to 0
+        rowmax = np.where(np.diff(row_offsets) > 0, rowmax, 0.0)
+    else:
+        rowmax = np.zeros(rows, dtype=np.float32)
+    scale = np.where(rowmax > 0, rowmax / 127.0, 1.0).astype(np.float32)
+    per_elem = np.repeat(scale, np.diff(row_offsets))
+    codes = np.clip(np.round(x / per_elem), -127, 127).astype(np.int8)
+    return codes, scale
+
+
+def narrow_i32_to_i16(
+    arr: np.ndarray, max_valid: int, sentinel: int | None = None
+) -> np.ndarray | None:
+    """int16 view of an int32 index channel, or None when it cannot
+    narrow losslessly.  ``max_valid`` is the largest REAL value the
+    channel may carry; values above it (the old int32 sentinel) map to
+    ``sentinel`` (default int16 max).  Narrowing is exact — reduced
+    medoid/segment channels are bit-equivalent after the device upcast —
+    so the only failure mode is a grid too large for int16, and the
+    caller falls back to int32 (journaled, never silent)."""
+    if max_valid >= 2**15 - 1:
+        return None
+    a = np.asarray(arr)
+    sent = np.int16(2**15 - 1 if sentinel is None else sentinel)
+    return np.where(a > max_valid, sent, a).astype(np.int16)
+
+
 def cosine_bins(
     mz: np.ndarray, valid: np.ndarray, config: CosineConfig
 ) -> tuple[np.ndarray, np.ndarray]:

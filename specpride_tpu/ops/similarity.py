@@ -25,12 +25,11 @@ grid vectors because bins occupied by only one channel contribute zero.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
 from specpride_tpu.config import CosineConfig, MedoidConfig
+from specpride_tpu.ops.jit_util import jit_pair
 
 
 # ---------------------------------------------------------------------------
@@ -40,8 +39,7 @@ from specpride_tpu.config import CosineConfig, MedoidConfig
 _SENT = jnp.int32(2**30)  # padding sentinel for global bin ids
 
 
-@functools.partial(jax.jit, static_argnames=("m", "lcap"))
-def shared_bins_packed(
+def _shared_bins_packed(
     bins: jax.Array,  # (B, K) i32 GLOBAL bins, PRE-SORTED (bin, member)
     member_id: jax.Array,  # (B, K) i32 in [0, m], same order, padding = m
     m: int,
@@ -65,6 +63,12 @@ def shared_bins_packed(
     bytes are the bottleneck on tunneled hosts, and counts are bounded by
     per-member peak counts (the driver asserts < 2**16)."""
     from specpride_tpu.ops import segments as sg
+
+    # reduced-precision packed inputs (--precision): int16-narrowed bin /
+    # member channels upcast at entry — exact (pure integer narrowing),
+    # and the in-kernel composites/shifts stay int32 math
+    bins = bins.astype(jnp.int32)
+    member_id = member_id.astype(jnp.int32)
 
     b, k = bins.shape
     if lcap is None:
@@ -107,8 +111,14 @@ def shared_bins_packed(
     return jnp.einsum("bkm,bkn->bmn", v, v).astype(jnp.uint16)
 
 
-@functools.partial(jax.jit, static_argnames=("m", "lcap"))
-def medoid_select_packed(
+shared_bins_packed, shared_bins_packed_donated = jit_pair(
+    _shared_bins_packed,
+    static_argnames=("m", "lcap"),
+    donate_argnums=(0, 1),
+)
+
+
+def _medoid_select_packed(
     bins: jax.Array,  # (B, K) i32 GLOBAL bins, PRE-SORTED (bin, member)
     member_id: jax.Array,  # (B, K) i32 in [0, m], same order, padding = m
     n_peaks: jax.Array,  # (B, M) i32 raw per-member peak counts
@@ -133,7 +143,9 @@ def medoid_select_packed(
     f32 rounding can flip a winner only when two members' mean distances
     agree to ~1e-6 relative.  ``TpuBackend(medoid_device_select=False)``
     restores the host-f64 finalize if that margin ever matters."""
-    shared = shared_bins_packed(bins, member_id, m, lcap).astype(jnp.float32)
+    shared = _shared_bins_packed(bins, member_id, m, lcap).astype(
+        jnp.float32
+    )
     n = n_peaks.astype(jnp.float32)
     min_n = jnp.minimum(n[:, :, None], n[:, None, :])
     prescore = jnp.where(
@@ -148,6 +160,13 @@ def medoid_select_packed(
     )
     total = jnp.where(member_mask, total, jnp.inf)
     return jnp.argmin(total, axis=1).astype(jnp.int32)
+
+
+medoid_select_packed, medoid_select_packed_donated = jit_pair(
+    _medoid_select_packed,
+    static_argnames=("m", "lcap"),
+    donate_argnums=(0, 1, 2, 3, 4),
+)
 
 
 def medoid_finalize(
@@ -286,11 +305,7 @@ def _cosine_packed_cluster(
     return mean, cos
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("shift", "l_rep", "l_row", "l_spec", "l_mem", "l_members"),
-)
-def cosine_flat(
+def _cosine_flat(
     rkey: jax.Array,  # (Nr,) i32 row*shift+bin, ascending; sentinel tail
     rint: jax.Array,  # (Nr,) f32, same order
     mkey: jax.Array,  # (N,) i32 row*shift+bin per member peak, sorted by
@@ -412,8 +427,16 @@ def cosine_flat(
     return row_sum / jnp.maximum(n_members.astype(jnp.float32), 1.0)
 
 
-@functools.partial(jax.jit, static_argnames=("m",))
-def cosine_packed(
+cosine_flat, cosine_flat_donated = jit_pair(
+    _cosine_flat,
+    static_argnames=(
+        "shift", "l_rep", "l_row", "l_spec", "l_mem", "l_members"
+    ),
+    donate_argnums=tuple(range(12)),
+)
+
+
+def _cosine_packed(
     rep_bins: jax.Array,  # (B, Pr) i32
     rep_int: jax.Array,  # (B, Pr) f32
     rep_edges: jax.Array,  # (B,) i32
@@ -438,3 +461,10 @@ def cosine_packed(
         rep_bins, rep_int, rep_edges, mem_bins, mem_int, mem_member,
         mem_edges, member_mask, n_members,
     )
+
+
+cosine_packed, cosine_packed_donated = jit_pair(
+    _cosine_packed,
+    static_argnames=("m",),
+    donate_argnums=tuple(range(9)),
+)

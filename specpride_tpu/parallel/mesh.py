@@ -96,6 +96,10 @@ def shard_batch_arrays(mesh: Mesh, *arrays: np.ndarray) -> tuple[jax.Array, ...]
     with tracing.span(
         "h2d:shard", n_arrays=len(arrays),
         bytes=int(sum(int(a.nbytes) for a in arrays)),
+        # per-channel dtypes: with --precision the packed channels ship
+        # narrowed (bf16/int8/int16), and an operator reading H2D spans
+        # in a Chrome trace must see WHAT was on the wire, not just size
+        dtypes=",".join(str(a.dtype) for a in arrays),
     ):
         # ONE device_put over the argument list, like the mesh-less
         # _put_batch: per-array puts each pay a full transfer round trip

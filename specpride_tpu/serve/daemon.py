@@ -145,6 +145,8 @@ class ServeDaemon:
         routing_table: str | None = None,
         layout: str = "auto",
         force_device: bool = False,
+        precision: str = "f32",
+        donate: bool = True,
         warmup: str = "auto",
         warmup_manifest: str | None = None,
         warmup_jobs: int = 0,
@@ -162,6 +164,8 @@ class ServeDaemon:
         self.routing_table = routing_table
         self.layout = layout
         self.force_device = force_device
+        self.precision = precision
+        self.donate = donate
         self.warmup = warmup
         self.warmup_manifest = warmup_manifest
         self.warmup_jobs = warmup_jobs
@@ -275,6 +279,7 @@ class ServeDaemon:
             TpuBackend(
                 layout=self.layout, force_device=self.force_device,
                 routing=routing, device=slot.device,
+                precision=self.precision, donate=self.donate,
             )
             for slot in self.slots
         ]
@@ -468,7 +473,15 @@ class ServeDaemon:
             logger.warning("ignoring shape manifest %s (%s)", path, e)
             return
         results = warm_entries(
-            entries, journal=self.journal, jobs=self.warmup_jobs
+            entries, journal=self.journal, jobs=self.warmup_jobs,
+            # warm the jit twin the lanes will actually dispatch: the
+            # resident backends resolve donation (off on cpu-only
+            # hosts / --no-donate), and the aliasing spec is part of
+            # the compiled executable — warming the wrong twin would
+            # populate the wrong persistent-cache entry
+            donate=getattr(
+                self.worker_backends[0], "_donate_effective", False
+            ),
         )
         self.warmed_kernels = len(results)
 

@@ -83,6 +83,11 @@ DAEMON_ONLY_FLAGS = (
     "--routing-table",
     "--layout",
     "--force-device",
+    # packed-channel precision and buffer donation configure the
+    # resident backends' kernel variants at boot — a per-job value
+    # could not apply to the already-constructed lanes
+    "--precision",
+    "--no-donate",
     "--mesh",
     "--coordinator",
     "--num-processes",
@@ -158,6 +163,7 @@ def forbidden_flags(argv: list[str]) -> list[str]:
 # like --layou, which the token scan above cannot see)
 _DAEMON_OWNED_DESTS = (
     "compile_cache", "routing_table", "layout", "force_device",
+    "precision", "no_donate",
     "mesh", "coordinator", "num_processes", "process_id", "metrics_out",
     "elastic", "elastic_steal", "elastic_local", "metrics_port",
     "trace_dir",

@@ -16,6 +16,20 @@ with a journal note rather than failing the run.  Mesh-sharded
 dispatches compile against sharded avals and are NOT reproduced here —
 warmup covers the single-host paths (the manifest from a mesh run still
 warms the unsharded variants, which is harmless but unused).
+
+Reduced-precision shape classes (--precision): dispatch sites append
+string dtype TOKENS to the shape key when a channel ships narrowed —
+("bf16"|"int8") for the intensity codes, the m/z channel's actual dtype
+("f32"|"bf16" from the pack-time exactness probe), "i16"/"i32" for
+narrowed index channels — because input dtype is part of the jit
+signature, i.e. a distinct XLA compile.  The builders here parse those
+tokens back into dtype-exact avals; keys without tokens rebuild the f32
+classes byte-identically to pre-precision manifests.
+
+Buffer donation: ``build(entry, donate=...)`` returns the jitted twin
+matching the run's donation setting (donation changes the executable's
+aliasing spec, so warming the wrong twin would populate the wrong
+persistent-cache entry).
 """
 
 from __future__ import annotations
@@ -56,8 +70,38 @@ def _sds(shape, dtype):
     return jax.ShapeDtypeStruct(tuple(shape), dtype)
 
 
-def _bin_mean_flat(entry: ShapeEntry, impl: str):
-    from specpride_tpu.ops.binning import bin_mean_flat_intensity
+def _bf16():
+    # the ONE bf16 dtype accessor — the registry's rebuilt avals must
+    # match the dtypes the dispatch sites actually ship, or warm reruns
+    # stop hitting the cache
+    from specpride_tpu.ops.quantize import _bf16 as q_bf16
+
+    return q_bf16()
+
+
+def _split_tokens(shape_key):
+    """``(ints, tokens)``: the numeric prefix and the trailing dtype
+    tokens a reduced-precision dispatch appended."""
+    ints = []
+    tokens = []
+    for v in shape_key:
+        if isinstance(v, str):
+            tokens.append(v)
+        else:
+            ints.append(v)
+    return tuple(ints), tuple(tokens)
+
+
+def _code_dtype(token: str):
+    return _bf16() if token == "bf16" else jnp.int8
+
+
+def _mz_dtype(token: str):
+    return _bf16() if token == "bf16" else jnp.float32
+
+
+def _bin_mean_flat(entry: ShapeEntry, impl: str, donate: bool):
+    from specpride_tpu.ops import binning
 
     n_pad, cap, rcap, lcap = entry.shape_key
     avals = (
@@ -66,33 +110,66 @@ def _bin_mean_flat(entry: ShapeEntry, impl: str):
         _sds((rcap,), jnp.bool_),  # keep_runs
     )
     statics = dict(total_cap=cap, rcap=rcap, lcap=lcap, impl=impl)
-    return bin_mean_flat_intensity, avals, statics
+    fn = (
+        binning.bin_mean_flat_intensity_donated if donate
+        else binning.bin_mean_flat_intensity
+    )
+    return fn, avals, statics
 
 
-def _bin_mean_bucketized(entry: ShapeEntry):
-    from specpride_tpu.ops.binning import bin_mean_deduped_compact
+def _bin_mean_flat_q(entry: ShapeEntry, impl: str, donate: bool):
+    from specpride_tpu.ops import binning
 
-    size, k, cap, lcap = entry.shape_key
+    (n_pad, cap, rcap, lcap), tokens = _split_tokens(entry.shape_key)
+    prec = tokens[0] if tokens else "bf16"
     avals = (
-        _sds((size, k), jnp.float32),  # mz
-        _sds((size, k), jnp.float32),  # intensity
+        _sds((n_pad,), _code_dtype(prec)),  # intensity codes
+        _sds((n_pad,), jnp.bool_),  # run_start
+        _sds((rcap,), jnp.bool_),  # keep_runs
+    )
+    statics = dict(total_cap=cap, rcap=rcap, lcap=lcap, impl=impl)
+    fn = (
+        binning.bin_mean_flat_q_donated if donate
+        else binning.bin_mean_flat_q
+    )
+    return fn, avals, statics
+
+
+def _bin_mean_bucketized(entry: ShapeEntry, donate: bool):
+    from specpride_tpu.ops import binning
+
+    (size, k, cap, lcap), tokens = _split_tokens(entry.shape_key)
+    int_dt = _code_dtype(tokens[0]) if tokens else jnp.float32
+    mz_dt = _mz_dtype(tokens[1]) if len(tokens) > 1 else jnp.float32
+    avals = (
+        _sds((size, k), mz_dt),  # mz
+        _sds((size, k), int_dt),  # intensity
         _sds((size, k), jnp.int32),  # bins
         _sds((size,), jnp.int32),  # n_members
     )
     statics = dict(
         config=_rebuild_config(entry.config), total_cap=cap, lcap=lcap
     )
-    return bin_mean_deduped_compact, avals, statics
+    fn = (
+        binning.bin_mean_deduped_compact_donated if donate
+        else binning.bin_mean_deduped_compact
+    )
+    return fn, avals, statics
 
 
-def _gap_average_compact(entry: ShapeEntry, impl: str):
-    from specpride_tpu.ops.gap_average import gap_average_compact
+def _gap_average_compact(entry: ShapeEntry, impl: str, donate: bool):
+    from specpride_tpu.ops import gap_average as ga
 
-    size, k, cap = entry.shape_key
+    (size, k, cap), tokens = _split_tokens(entry.shape_key)
+    int_dt = _code_dtype(tokens[0]) if tokens else jnp.float32
+    mz_dt = _mz_dtype(tokens[1]) if len(tokens) > 1 else jnp.float32
+    seg_dt = (
+        jnp.int16 if len(tokens) > 2 and tokens[2] == "i16" else jnp.int32
+    )
     avals = (
-        _sds((size, k), jnp.float32),  # mz
-        _sds((size, k), jnp.float32),  # intensity
-        _sds((size, k), jnp.int32),  # seg
+        _sds((size, k), mz_dt),  # mz
+        _sds((size, k), int_dt),  # intensity
+        _sds((size, k), seg_dt),  # seg
         _sds((size,), jnp.int32),  # n_valid
         _sds((size,), jnp.int32),  # quorum
         _sds((size,), jnp.int32),  # n_members
@@ -100,13 +177,16 @@ def _gap_average_compact(entry: ShapeEntry, impl: str):
     statics = dict(
         config=_rebuild_config(entry.config), total_cap=cap, impl=impl
     )
-    return gap_average_compact, avals, statics
+    fn = (
+        ga.gap_average_compact_donated if donate else ga.gap_average_compact
+    )
+    return fn, avals, statics
 
 
-def _medoid_args(size, k, m):
+def _medoid_args(size, k, m, idx_dt):
     return (
-        _sds((size, k), jnp.int32),  # bins, pre-sorted (bin, member)
-        _sds((size, k), jnp.int32),  # member_id, padding = m
+        _sds((size, k), idx_dt),  # bins, pre-sorted (bin, member)
+        _sds((size, k), idx_dt),  # member_id, padding = m
     ), (
         _sds((size, m), jnp.int32),  # n_peaks
         _sds((size, m), jnp.bool_),  # member_mask
@@ -114,24 +194,38 @@ def _medoid_args(size, k, m):
     )
 
 
-def _medoid_select(entry: ShapeEntry):
-    from specpride_tpu.ops.similarity import medoid_select_packed
+def _medoid_select(entry: ShapeEntry, donate: bool):
+    from specpride_tpu.ops import similarity as sim
 
-    size, k, m, lcap = entry.shape_key
-    core, finalize = _medoid_args(size, k, m)
-    return medoid_select_packed, core + finalize, dict(m=m, lcap=lcap)
-
-
-def _shared_bins(entry: ShapeEntry):
-    from specpride_tpu.ops.similarity import shared_bins_packed
-
-    size, k, m, lcap = entry.shape_key
-    core, _ = _medoid_args(size, k, m)
-    return shared_bins_packed, core, dict(m=m, lcap=lcap)
+    (size, k, m, lcap), tokens = _split_tokens(entry.shape_key)
+    idx_dt = jnp.int16 if "i16" in tokens else jnp.int32
+    core, finalize = _medoid_args(size, k, m, idx_dt)
+    fn = (
+        sim.medoid_select_packed_donated if donate
+        else sim.medoid_select_packed
+    )
+    return fn, core + finalize, dict(m=m, lcap=lcap)
 
 
-def _cosine_packed(entry: ShapeEntry):
-    from specpride_tpu.ops.similarity import cosine_packed
+def _shared_bins(entry: ShapeEntry, donate: bool):
+    from specpride_tpu.ops import similarity as sim
+
+    (size, k, m, lcap), tokens = _split_tokens(entry.shape_key)
+    idx_dt = jnp.int16 if "i16" in tokens else jnp.int32
+    core, _ = _medoid_args(size, k, m, idx_dt)
+    fn = (
+        sim.shared_bins_packed_donated if donate
+        else sim.shared_bins_packed
+    )
+    return fn, core, dict(m=m, lcap=lcap)
+
+
+def _cosine_packed(entry: ShapeEntry, donate: bool):
+    from specpride_tpu.ops import similarity as sim
+
+    cosine_packed = (
+        sim.cosine_packed_donated if donate else sim.cosine_packed
+    )
 
     size, k, pr, m = entry.shape_key
     avals = (
@@ -148,8 +242,10 @@ def _cosine_packed(entry: ShapeEntry):
     return cosine_packed, avals, dict(m=m)
 
 
-def _cosine_flat(entry: ShapeEntry):
-    from specpride_tpu.ops.similarity import cosine_flat
+def _cosine_flat(entry: ShapeEntry, donate: bool):
+    from specpride_tpu.ops import similarity as sim
+
+    cosine_flat = sim.cosine_flat_donated if donate else sim.cosine_flat
 
     (
         n_pad, nr_pad, rows_cap, s_pad,
@@ -177,12 +273,20 @@ def _cosine_flat(entry: ShapeEntry):
 
 
 _BUILDERS = {
-    "bin_mean_flat_intensity": lambda e: _bin_mean_flat(e, "scan"),
-    "bin_mean_flat_intensity_pallas": lambda e: _bin_mean_flat(e, "pallas"),
+    "bin_mean_flat_intensity": lambda e, d: _bin_mean_flat(e, "scan", d),
+    "bin_mean_flat_intensity_pallas": lambda e, d: _bin_mean_flat(
+        e, "pallas", d
+    ),
+    "bin_mean_flat_q": lambda e, d: _bin_mean_flat_q(e, "scan", d),
+    "bin_mean_flat_q_pallas": lambda e, d: _bin_mean_flat_q(
+        e, "pallas", d
+    ),
     "bin_mean_bucketized": _bin_mean_bucketized,
-    "gap_average_compact": lambda e: _gap_average_compact(e, "scan"),
-    "gap_average_compact_pallas": lambda e: _gap_average_compact(
-        e, "pallas"
+    "gap_average_compact": lambda e, d: _gap_average_compact(
+        e, "scan", d
+    ),
+    "gap_average_compact_pallas": lambda e, d: _gap_average_compact(
+        e, "pallas", d
     ),
     "medoid_select_packed": _medoid_select,
     "shared_bins_packed": _shared_bins,
@@ -195,10 +299,12 @@ def known_kernels() -> tuple[str, ...]:
     return tuple(sorted(_BUILDERS))
 
 
-def build(entry: ShapeEntry):
+def build(entry: ShapeEntry, donate: bool = True):
     """``(jitted_fn, avals, static_kwargs)`` for a manifest entry, or
-    None for a kernel this registry cannot rebuild."""
+    None for a kernel this registry cannot rebuild.  ``donate`` selects
+    the jit twin matching the run's donation setting (the backend
+    default; ``--no-donate`` runs warm the plain twin)."""
     builder = _BUILDERS.get(entry.kernel)
     if builder is None:
         return None
-    return builder(entry)
+    return builder(entry, donate)

@@ -66,6 +66,7 @@ def _compile_one(item) -> tuple[int, WarmResult]:
 
 def warm_entries(
     entries: list[ShapeEntry], journal=None, jobs: int = 0,
+    donate: bool = True,
 ) -> list[WarmResult]:
     """Warm every entry — tracing/lowering SEQUENTIAL, XLA compiles
     concurrent; journal one ``warmup`` event per entry and return the
@@ -98,7 +99,7 @@ def warm_entries(
         for i, entry in enumerate(entries):
             t_start = time.perf_counter()
             try:
-                built = registry.build(entry)
+                built = registry.build(entry, donate=donate)
             except (ValueError, TypeError) as e:
                 results[i] = WarmResult(
                     entry, "skipped", 0.0, f"bad entry: {e}"

@@ -1,0 +1,461 @@
+"""Memory-bandwidth campaign: reduced-precision packed paths
+(--precision {f32,bf16,int8}), buffer donation on the chunk loop
+(--no-donate), and the double-buffered H2D transfer lane
+(--h2d-buffer) — plus the byte-accounting satellites (journal ratios,
+stats bandwidth rendering, warm-start manifests with non-f32 dtypes).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from specpride_tpu.backends import numpy_backend as nb
+from specpride_tpu.backends.tpu_backend import TpuBackend
+from specpride_tpu.cli import main as cli_main
+from specpride_tpu.config import (
+    BinMeanConfig,
+    GapAverageConfig,
+    MedoidConfig,
+)
+from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.io.mgf import write_mgf
+from specpride_tpu.ops import quantize
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(17)
+
+
+def _workload(rng, n=14, peaks=70):
+    clusters = []
+    for i in range(n):
+        m = int(rng.integers(2, 6))
+        base = np.sort(rng.uniform(150, 1500, peaks))
+        members = [
+            Spectrum(
+                mz=np.sort(base + rng.normal(0, 0.002, peaks)),
+                intensity=rng.uniform(1, 1e4, peaks),
+                precursor_mz=420.0, precursor_charge=2, rt=1.0,
+                title=f"p{i};s{k}",
+            )
+            for k in range(m)
+        ]
+        clusters.append(Cluster(f"p{i}", members))
+    return clusters
+
+
+def _write(tmp_path, clusters, name="in.mgf"):
+    path = tmp_path / name
+    write_mgf([s for c in clusters for s in c.members], str(path))
+    return str(path)
+
+
+def _events(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def _run_end(path):
+    return [e for e in _events(path) if e["event"] == "run_end"][-1]
+
+
+# method -> (command, device-layout flags that actually ship bytes on a
+# CPU host; reduced precision routes onto these same device paths)
+_METHOD_FLAGS = {
+    "bin-mean": ("consensus", ["--layout", "flat"]),
+    "gap-average": ("consensus", ["--layout", "bucketized",
+                                  "--force-device"]),
+    "medoid": ("select", ["--layout", "bucketized"]),
+}
+
+
+class TestEncodeHelpers:
+    def test_bf16_exact_probe(self):
+        exact = np.array([1.0, 2.5, 0.125, 384.0], dtype=np.float32)
+        assert quantize.bf16_exact(exact)
+        noisy = np.array([1.0000001, 2.5], dtype=np.float32)
+        assert not quantize.bf16_exact(noisy)
+
+    def test_encode_mz_falls_back_to_f32(self):
+        noisy = np.array([[123.456789, 1000.000123]], dtype=np.float32)
+        enc, tok = quantize.encode_mz(noisy, "bf16")
+        assert tok == "f32" and enc.dtype == np.float32
+
+    def test_int8_rows_error_bound(self, rng):
+        x = rng.uniform(0, 1e4, (8, 64)).astype(np.float32)
+        codes, scale = quantize.encode_intensity_rows(x, "int8")
+        assert codes.dtype == np.int8 and scale.shape == (8,)
+        back = codes.astype(np.float32) * scale[:, None]
+        # error <= scale/2 = rowmax/254 per element
+        assert np.all(
+            np.abs(back - x) <= x.max(axis=1)[:, None] / 253.9
+        )
+
+    def test_int8_flat_per_row_scales(self, rng):
+        offs = np.array([0, 5, 5, 12], dtype=np.int64)  # empty middle row
+        x = rng.uniform(0, 100, 12).astype(np.float32)
+        codes, scale = quantize.encode_intensity_flat(x, offs, "int8")
+        assert scale.shape == (3,)
+        assert scale[1] == 1.0  # empty row forces the guard scale
+        back = codes[:5].astype(np.float32) * scale[0]
+        assert np.all(np.abs(back - x[:5]) <= x[:5].max() / 253.9)
+
+    def test_narrow_i16(self):
+        a = np.array([0, 5, 2**30], dtype=np.int32)
+        got = quantize.narrow_i32_to_i16(a, max_valid=5)
+        assert got.dtype == np.int16
+        assert got.tolist() == [0, 5, 2**15 - 1]
+        assert quantize.narrow_i32_to_i16(a, max_valid=2**15) is None
+
+    def test_tolerance_table(self):
+        assert quantize.precision_tolerance("bin-mean", "f32") == 1.0
+        assert quantize.precision_tolerance("bin-mean", "bf16") >= 0.999
+        assert quantize.precision_tolerance("gap-average", "int8") > 0.99
+
+
+class TestPrecisionMatrix:
+    """3 methods x {f32, bf16, int8}: f32 byte parity, reduced within
+    the documented cosine tolerance vs the f32 oracle."""
+
+    @pytest.mark.parametrize("method", list(_METHOD_FLAGS))
+    def test_f32_flag_is_byte_parity(self, tmp_path, rng, method):
+        src = _write(tmp_path, _workload(rng))
+        command, flags = _METHOD_FLAGS[method]
+        outs = []
+        for tag, extra in (("bare", []), ("f32", ["--precision", "f32"])):
+            out = str(tmp_path / f"{tag}.mgf")
+            assert cli_main(
+                [command, src, out, "--method", method] + flags + extra
+            ) == 0
+            outs.append(open(out, "rb").read())
+        assert outs[0] == outs[1]
+
+    @pytest.mark.parametrize("method", list(_METHOD_FLAGS))
+    @pytest.mark.parametrize("precision", ["bf16", "int8"])
+    def test_reduced_within_tolerance(self, rng, method, precision):
+        clusters = _workload(rng)
+        tol = quantize.precision_tolerance(method, precision)
+        kw = (
+            dict(layout="flat") if method == "bin-mean"
+            else dict(layout="bucketized", force_device=True)
+        )
+        ref_b = TpuBackend(**kw)
+        red_b = TpuBackend(precision=precision, **kw)
+        if method == "bin-mean":
+            ref = ref_b.run_bin_mean(clusters, BinMeanConfig())
+            red = red_b.run_bin_mean(clusters, BinMeanConfig())
+        elif method == "gap-average":
+            ref = ref_b.run_gap_average(clusters, GapAverageConfig())
+            red = red_b.run_gap_average(clusters, GapAverageConfig())
+        else:
+            iref = ref_b.medoid_indices(clusters, MedoidConfig())
+            ired = red_b.medoid_indices(clusters, MedoidConfig())
+            # integer narrowing is exact: identical winners
+            assert iref == ired
+            return
+        cosines = [nb.binned_cosine(a, b) for a, b in zip(ref, red)]
+        assert min(cosines) >= tol, (method, precision, min(cosines))
+
+    def test_h2d_bytes_shrink_and_gate_journaled(self, tmp_path, rng):
+        """The acceptance ratios on the flat bin-mean path: bf16 <=
+        0.55x f32 H2D bytes, int8 <= 0.30x, QC gate green + journaled
+        in run_end.precision."""
+        src = _write(tmp_path, _workload(rng, n=20))
+        bytes_by_prec = {}
+        for prec in ("f32", "bf16", "int8"):
+            out = str(tmp_path / f"{prec}.mgf")
+            journal = str(tmp_path / f"{prec}.jsonl")
+            assert cli_main([
+                "consensus", src, out, "--method", "bin-mean",
+                "--layout", "flat", "--precision", prec,
+                "--journal", journal,
+            ]) == 0
+            end = _run_end(journal)
+            bytes_by_prec[prec] = end["device"]["bytes_h2d"]
+            if prec != "f32":
+                p = end["precision"]
+                assert p["ok"] and p["gated"]
+                assert p["min_cosine"] >= p["tolerance"]
+                assert [
+                    e for e in _events(journal)
+                    if e["event"] == "precision"
+                    and e.get("intensity") == prec
+                ]
+        assert bytes_by_prec["bf16"] <= 0.55 * bytes_by_prec["f32"]
+        assert bytes_by_prec["int8"] <= 0.30 * bytes_by_prec["f32"]
+
+    def test_gate_failure_aborts(self, tmp_path, rng, monkeypatch):
+        src = _write(tmp_path, _workload(rng))
+        monkeypatch.setitem(
+            quantize.PRECISION_MIN_COSINE, ("bin-mean", "bf16"), 1.1
+        )
+        with pytest.raises(SystemExit, match="precision gate FAILED"):
+            cli_main([
+                "consensus", src, str(tmp_path / "o.mgf"),
+                "--method", "bin-mean", "--layout", "flat",
+                "--precision", "bf16",
+            ])
+
+
+class TestGateEdgeCases:
+    def test_gate_skips_wrapper_backends(self):
+        """A batched member job runs against the batcher's read-only
+        result view (not a dataclass); the gate must record and skip,
+        never attempt to twin it."""
+        import argparse
+
+        from specpride_tpu import cli
+        from specpride_tpu.observability import NullJournal, RunStats
+
+        class Wrapper:  # forwards the resident backend's precision
+            precision = "bf16"
+
+        stats = RunStats()
+        cli._precision_gate(
+            argparse.Namespace(), Wrapper(), [], "bin-mean", stats,
+            NullJournal(),
+        )
+        assert stats.precision["gated"] is False
+        assert stats.precision["reason"] == "shared-batch-member"
+
+    def test_elastic_runs_are_gated(self, tmp_path, rng, monkeypatch):
+        """--elastic must not bypass the gate: a breach aborts before
+        the rank claims any range."""
+        src = _write(tmp_path, _workload(rng))
+        monkeypatch.setitem(
+            quantize.PRECISION_MIN_COSINE, ("bin-mean", "bf16"), 1.1
+        )
+        with pytest.raises(SystemExit, match="precision gate FAILED"):
+            cli_main([
+                "consensus", src, str(tmp_path / "e.mgf"),
+                "--method", "bin-mean", "--layout", "flat",
+                "--precision", "bf16",
+                "--elastic", str(tmp_path / "coord"),
+                "--elastic-range", "4", "--checkpoint-every", "2",
+            ])
+
+
+class TestH2dLaneErrors:
+    def test_upstream_pack_failure_propagates(self):
+        """An exception raised by the pack generator itself (e.g. the
+        pool exiting without delivering a chunk) must abort the
+        dispatch lane, not end the stream as a clean-looking truncated
+        run."""
+        from specpride_tpu import cli
+
+        class NoStageBackend:
+            def supports_h2d_stage(self, prepared):
+                return False
+
+        def items():
+            it = cli._ChunkItem(0, [0])
+            it.part = []
+            yield it
+            raise RuntimeError("pack worker pool exited")
+
+        got = []
+        with pytest.raises(RuntimeError, match="pool exited"):
+            for item in cli._h2d_staged_chunks(
+                items(), NoStageBackend(), 2, {}
+            ):
+                got.append(item)
+        assert len(got) == 1  # the delivered chunk still flowed through
+
+
+class TestDonation:
+    def test_cpu_resolves_donation_off(self):
+        """CPU-only jax maps host buffers zero-copy, so donation must
+        resolve to a no-op there (the donated twin would alias output
+        into memory the host reuses)."""
+        assert TpuBackend()._donate_effective is False
+        assert TpuBackend(donate=False)._donate_effective is False
+
+    def test_donated_twin_numeric_parity(self, rng):
+        """The donated jit twins compute the same values as the plain
+        ones (inputs held alive across the call — the caller contract
+        donation relies on)."""
+        from specpride_tpu.ops import binning
+
+        n = 700
+        n_pad, rcap, cap = 1024, 1024, 1024
+        inten = np.pad(
+            rng.uniform(1, 1e4, n).astype(np.float32), (0, n_pad - n)
+        )
+        g = np.pad(
+            np.sort(rng.integers(0, 400, n)).astype(np.int32),
+            (0, n_pad - n), constant_values=2**31 - 1,
+        )
+        keep = np.zeros(rcap, bool)
+        keep[:50] = True
+        kw = dict(total_cap=cap, rcap=rcap, lcap=16, impl="scan")
+        a = np.asarray(
+            binning.bin_mean_flat_intensity(inten, g, keep, **kw)
+        )
+        b = np.asarray(
+            binning.bin_mean_flat_intensity_donated(
+                inten.copy(), g.copy(), keep.copy(), **kw
+            )
+        )
+        np.testing.assert_array_equal(a, b)
+
+    def test_no_donate_cli_byte_parity(self, tmp_path, rng):
+        src = _write(tmp_path, _workload(rng))
+        outs = []
+        for tag, extra in (("on", []), ("off", ["--no-donate"])):
+            out = str(tmp_path / f"d{tag}.mgf")
+            assert cli_main([
+                "consensus", src, out, "--method", "bin-mean",
+                "--layout", "flat",
+            ] + extra) == 0
+            outs.append(open(out, "rb").read())
+        assert outs[0] == outs[1]
+
+
+class TestH2dBuffer:
+    @pytest.mark.parametrize("precision", ["f32", "int8"])
+    def test_double_buffer_byte_parity_and_overlap(
+        self, tmp_path, rng, precision
+    ):
+        src = _write(tmp_path, _workload(rng, n=24))
+        outs = {}
+        for slots in (0, 2):
+            out = str(tmp_path / f"h{slots}.mgf")
+            journal = str(tmp_path / f"h{slots}.jsonl")
+            assert cli_main([
+                "consensus", src, out, "--method", "bin-mean",
+                "--layout", "flat", "--precision", precision,
+                "--h2d-buffer", str(slots),
+                "--checkpoint", str(tmp_path / f"h{slots}.ck"),
+                "--checkpoint-every", "6", "--journal", journal,
+            ]) == 0
+            outs[slots] = open(out, "rb").read()
+            end = _run_end(journal)
+            pipe = end.get("pipeline") or {}
+            if slots:
+                h2d = pipe["h2d"]
+                assert h2d["slots"] == 2
+                assert h2d["bytes"] > 0
+                assert 0.0 <= h2d["overlap_efficiency"] <= 1.0
+            else:
+                assert "h2d" not in pipe
+        assert outs[0] == outs[2]
+
+    def test_staged_pipeline_spans_present(self, tmp_path, rng):
+        src = _write(tmp_path, _workload(rng, n=24))
+        journal = str(tmp_path / "spans.jsonl")
+        assert cli_main([
+            "consensus", src, str(tmp_path / "s.mgf"), "--method",
+            "bin-mean", "--layout", "flat", "--h2d-buffer", "2",
+            "--checkpoint", str(tmp_path / "s.ck"),
+            "--checkpoint-every", "6", "--journal", journal,
+        ]) == 0
+        spans = [
+            e for e in _events(journal)
+            if e["event"] == "span" and e["name"] == "pipeline:h2d"
+        ]
+        assert spans, "h2d lane never traced"
+
+
+class TestWarmstartRoundTrip:
+    @pytest.mark.parametrize("precision", ["bf16", "int8"])
+    def test_manifest_round_trip_no_spurious_recompiles(
+        self, tmp_path, rng, precision
+    ):
+        """Non-f32 dtype tokens survive the shape-manifest round trip:
+        the cold reduced run seeds the manifest, warmup rebuilds the
+        exact reduced kernels, and the warm rerun journals ZERO fresh
+        compiles."""
+        import jax
+
+        from specpride_tpu.warmstart.manifest import load_manifest
+        from specpride_tpu.warmstart import registry
+
+        src = _write(tmp_path, _workload(rng))
+        cache = str(tmp_path / "cache")
+
+        def run(tag):
+            jax.clear_caches()
+            journal = tmp_path / f"{tag}.jsonl"
+            assert cli_main([
+                "consensus", src, str(tmp_path / f"{tag}.mgf"),
+                "--method", "bin-mean", "--layout", "flat",
+                "--precision", precision, "--compile-cache", cache,
+                "--journal", str(journal),
+            ]) == 0
+            return _run_end(str(journal))
+
+        cold = run("cold")
+        assert cold["compile_cache"]["misses"] > 0
+        manifest = os.path.join(cache, "shape_manifest.json")
+        entries = [
+            e for e in load_manifest(manifest)
+            if e.kernel == "bin_mean_flat_q"
+        ]
+        assert entries, "reduced kernel missing from manifest"
+        assert all(precision in e.shape_key for e in entries)
+        # the registry rebuilds the reduced variant dtype-exact
+        for e in entries:
+            built = registry.build(e, donate=False)
+            assert built is not None
+            fn, avals, statics = built
+            assert str(avals[0].dtype) in ("bfloat16", "int8")
+            fn.lower(*avals, **statics)  # traces without error
+
+        warm = run("warm")
+        assert warm["compile_cache"]["misses"] == 0
+        assert warm["compile_cache"]["hits"] > 0
+        assert (tmp_path / "cold.mgf").read_bytes() == (
+            tmp_path / "warm.mgf"
+        ).read_bytes()
+
+
+class TestStatsRendering:
+    def test_bandwidth_and_precision_lines(self, tmp_path, rng, capsys):
+        from specpride_tpu.observability.stats_cli import run_stats
+
+        src = _write(tmp_path, _workload(rng))
+        journal = str(tmp_path / "r.jsonl")
+        assert cli_main([
+            "consensus", src, str(tmp_path / "r.mgf"), "--method",
+            "bin-mean", "--layout", "flat", "--precision", "bf16",
+            "--h2d-buffer", "2", "--journal", journal,
+        ]) == 0
+        json_out = str(tmp_path / "stats.json")
+        assert run_stats([journal], json_out=json_out) == 0
+        rendered = capsys.readouterr().out
+        assert "bandwidth:" in rendered
+        assert "MB/s" in rendered
+        assert "precision=bf16" in rendered and "gate=ok" in rendered
+        doc = json.loads(open(json_out).read())
+        run = doc["runs"][0]
+        assert run["bandwidth"]["h2d_mb"] > 0
+        assert run["bandwidth"]["h2d_mb_per_s"] > 0
+        assert run["precision"]["ok"] is True
+
+
+class TestExporterBytes:
+    def test_byte_counters_mirror_backend_registries(self):
+        from specpride_tpu.observability import MetricsRegistry
+        from specpride_tpu.observability.exporter import (
+            ServeTelemetry,
+            validate_exposition,
+        )
+
+        w0 = MetricsRegistry()
+        w1 = MetricsRegistry()
+        tele = ServeTelemetry(worker_registries={"0": w0, "1": w1})
+        text = tele.exposition()
+        assert "specpride_h2d_bytes_total 0" in text
+        assert "specpride_d2h_bytes_total 0" in text
+        w0.counter("specpride_bytes_h2d_total", "h").inc(1000)
+        w1.counter("specpride_bytes_h2d_total", "h").inc(500)
+        w1.counter("specpride_bytes_d2h_total", "h").inc(70)
+        text = tele.exposition()
+        assert "specpride_h2d_bytes_total 1500" in text
+        assert "specpride_d2h_bytes_total 70" in text
+        # monotone mirror: a second scrape with no new traffic holds
+        text = tele.exposition()
+        assert "specpride_h2d_bytes_total 1500" in text
+        assert validate_exposition(text) == []
