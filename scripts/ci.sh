@@ -9,6 +9,44 @@
 set -eu
 cd "$(dirname "$0")/.."
 
+echo "== static analysis: specpride lint =="
+# the project-invariant analyzer (docs/static-analysis.md) must (a)
+# still enumerate every checker — deleting one would silently drop its
+# invariant from CI — and (b) report ZERO findings beyond the committed
+# baseline (lint exits 1 on any new/unjustified finding)
+lint_tmp=$(mktemp -d)
+python -m specpride_tpu lint --list | tee "$lint_tmp/list.txt"
+for check in lane-safety jit-hygiene journal-schema \
+        metrics-conformance cli-flags fault-sites; do
+    grep -q "^$check " "$lint_tmp/list.txt" || {
+        echo "lint checker '$check' missing from --list"; exit 1; }
+done
+# human-readable pass first so a red build SHOWS its findings (the
+# --json run suppresses the per-finding lines), then the JSON gate
+python -m specpride_tpu lint
+python -m specpride_tpu lint --json "$lint_tmp/lint.json"
+python - "$lint_tmp/lint.json" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert len(report["checks"]) >= 6, report["checks"]
+assert report["summary"]["new"] == 0, report["findings"]
+assert report["summary"]["baseline_entries_missing_reason"] == 0
+print(f"lint OK: {len(report['checks'])} checkers, "
+      f"{report['summary']['baselined']} baselined finding(s)")
+EOF
+rm -rf "$lint_tmp"
+
+echo "== generic lint: ruff (pyflakes-equivalent) =="
+# config lives in pyproject.toml ([tool.ruff]); the container may not
+# ship ruff — skip with a notice rather than fail on the toolchain
+if command -v ruff >/dev/null 2>&1; then
+    ruff check specpride_tpu/ tests/
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check specpride_tpu/ tests/
+else
+    echo "ruff not installed; skipping generic lint pass"
+fi
+
 echo "== pytest =="
 python -m pytest tests/ -x -q
 

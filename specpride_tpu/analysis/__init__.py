@@ -1,0 +1,43 @@
+"""Project-invariant static analysis (``specpride lint``).
+
+Thirteen PRs of cross-cutting contracts — jit statics mirrored into
+shape keys and warmup builders, lane-shared state behind locks, journal
+events / metric names / CLI flags kept in sync with ``docs/`` and their
+renderers — were enforced only by convention and review.  This package
+enforces them by machine at the SOURCE level: an AST + cross-artifact
+analyzer with one checker per invariant family, a committed baseline
+for legacy findings, and a CI gate (``scripts/ci.sh``) that fails on
+any new finding.
+
+Checkers (``specpride lint --list``):
+
+* ``lane-safety`` — call-graph lane inference from the thread entry
+  points; flags attributes mutated from >= 2 lanes without a lock.
+* ``jit-hygiene`` — jit statics vs warmup-registry builders, donation
+  twins via ``jit_pair``, no host syncs inside jitted bodies.
+* ``journal-schema`` — ``EVENT_FIELDS`` vs emit sites vs the
+  ``docs/observability.md`` event table vs renderer literals.
+* ``metrics-conformance`` — registered metric names vs the strict
+  exposition grammar, the docs catalog, and pre-register-at-0.
+* ``cli-flags`` — ``DAEMON_ONLY_FLAGS`` vs the parser, and every flag
+  documented under ``docs/``.
+* ``fault-sites`` — ``FAULT_SITES`` vs actual harness visit sites.
+
+See ``docs/static-analysis.md`` for the full catalog, known limits and
+suppression syntax.
+"""
+
+from specpride_tpu.analysis.core import Finding, Project
+from specpride_tpu.analysis.runner import (
+    CHECKERS,
+    checker_ids,
+    run_checks,
+)
+
+__all__ = [
+    "CHECKERS",
+    "Finding",
+    "Project",
+    "checker_ids",
+    "run_checks",
+]

@@ -1,0 +1,133 @@
+"""``cli-flags``: the parser, the daemon-ownership tables, and the
+docs must agree on every flag.
+
+Anchors: all ``add_argument("--flag", ...)`` calls (the parser), the
+``DAEMON_ONLY_FLAGS`` tuple and its ``_DAEMON_OWNED_DESTS`` mirror
+(``serve/protocol.py``), and the ``docs/``/README markdown.
+
+Rules:
+
+1. every ``DAEMON_ONLY_FLAGS`` entry is a real parser flag — a stale
+   entry silently stops protecting the daemon boot config;
+2. ``DAEMON_ONLY_FLAGS`` and ``_DAEMON_OWNED_DESTS`` are exact mirrors
+   under argparse dest derivation (the prefix-spelling scan and the
+   parsed-namespace scan must cover the same set);
+3. every long flag the parser defines appears literally (as
+   ``--flag``) somewhere in ``docs/*.md`` or ``README.md``;
+4. no flag is defined twice with the same spelling on one subparser
+   (argparse raises at runtime — catch it at lint time).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from specpride_tpu.analysis.core import (
+    Finding,
+    Project,
+    flag_to_dest,
+    str_seq_resolved,
+)
+
+CHECK = "cli-flags"
+
+
+def _parser_flags(project: Project):
+    """Every literal ``--flag`` passed to an ``add_argument`` call:
+    flag -> (module, first line)."""
+    flags: dict[str, tuple] = {}
+    per_parser: dict[tuple, list] = {}
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+            ):
+                continue
+            receiver = ast.unparse(node.func.value)
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ) and arg.value.startswith("--"):
+                    flags.setdefault(arg.value, (mod, node.lineno))
+                    per_parser.setdefault(
+                        (mod.name, receiver, arg.value), []
+                    ).append((mod, node.lineno))
+    return flags, per_parser
+
+
+def run(project: Project) -> list[Finding]:
+    flags, per_parser = _parser_flags(project)
+    if not flags:
+        return []
+    findings: list[Finding] = []
+    for (mod_name, receiver, flag), sites in sorted(per_parser.items()):
+        if len(sites) > 1:
+            mod, line = sites[1]
+            findings.append(Finding(
+                check=CHECK, path=mod.rel, line=line,
+                symbol=f"{flag}:duplicate",
+                message=(
+                    f"`{flag}` is added twice to parser `{receiver}` "
+                    f"— argparse raises at runtime"
+                ),
+            ))
+
+    daemon_hit = project.one_constant("DAEMON_ONLY_FLAGS")
+    dests_hit = project.one_constant("_DAEMON_OWNED_DESTS")
+    if daemon_hit is not None:
+        dmod, dnode, dline = daemon_hit
+        daemon_flags = str_seq_resolved(dnode, {}) or []
+        for flag in daemon_flags:
+            if flag not in flags:
+                findings.append(Finding(
+                    check=CHECK, path=dmod.rel, line=dline,
+                    symbol=f"{flag}:unknown",
+                    message=(
+                        f"DAEMON_ONLY_FLAGS lists `{flag}` but no "
+                        f"parser defines it — stale protection"
+                    ),
+                ))
+        if dests_hit is not None:
+            omod, onode, oline = dests_hit
+            dests = set(str_seq_resolved(onode, {}) or [])
+            want = {flag_to_dest(f) for f in daemon_flags}
+            for dest in sorted(want - dests):
+                findings.append(Finding(
+                    check=CHECK, path=omod.rel, line=oline,
+                    symbol=f"{dest}:dest-missing",
+                    message=(
+                        f"_DAEMON_OWNED_DESTS is missing `{dest}` "
+                        f"(from DAEMON_ONLY_FLAGS) — prefix spellings "
+                        f"like `--layou` would slip past the scan"
+                    ),
+                ))
+            for dest in sorted(dests - want):
+                findings.append(Finding(
+                    check=CHECK, path=omod.rel, line=oline,
+                    symbol=f"{dest}:dest-stale",
+                    message=(
+                        f"_DAEMON_OWNED_DESTS lists `{dest}` with no "
+                        f"matching DAEMON_ONLY_FLAGS entry"
+                    ),
+                ))
+
+    # docs coverage: every long flag documented somewhere.  Token
+    # match, not substring — docs naming only `--poll-interval` must
+    # not count as documenting a `--poll` flag.
+    if project.docs:
+        corpus = "\n".join(text for _rel, text in project.docs)
+        documented = set(re.findall(r"--[a-zA-Z][\w-]*", corpus))
+        for flag, (mod, line) in sorted(flags.items()):
+            if flag not in documented:
+                findings.append(Finding(
+                    check=CHECK, path=mod.rel, line=line,
+                    symbol=f"{flag}:undocumented",
+                    message=(
+                        f"flag `{flag}` is not documented anywhere "
+                        f"under docs/ or README.md"
+                    ),
+                ))
+    return findings

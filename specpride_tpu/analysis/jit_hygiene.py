@@ -1,0 +1,314 @@
+"""``jit-hygiene``: device-kernel construction invariants.
+
+Four rules, all rooted in real regressions:
+
+1. every device kernel is built as a donation twin pair via
+   ``jit_pair`` (bare ``jax.jit`` in an ops module has no ``--no-
+   donate`` escape hatch and no warmup twin selection);
+2. every ``jit_pair`` kernel has a warmup-registry builder (the
+   ``_BUILDERS`` table) that references it — a kernel absent from the
+   registry silently re-compiles on every warmed rerun;
+3. each registry builder's static kwargs must exactly equal the
+   kernel's ``static_argnames`` — the PR 6 ``cosine_flat`` bug class: a
+   static missing from the builder (or the shape key it decodes) warms
+   the WRONG executable;
+4. no host syncs inside jitted bodies: ``float(...)``, ``.item()``,
+   ``np.asarray``/``np.array``/``jax.device_get`` force a device
+   round-trip per trace and break async dispatch.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from specpride_tpu.analysis.core import (
+    Finding,
+    Project,
+    call_name,
+    str_seq,
+)
+
+CHECK = "jit-hygiene"
+
+_HOST_SYNC_NP = {"asarray", "array", "device_get"}
+
+
+class _JitKernel:
+    def __init__(self, module, name: str, donated: str | None,
+                 statics: tuple, line: int, fn_name: str | None):
+        self.module = module
+        self.name = name
+        self.donated = donated
+        self.statics = statics
+        self.line = line
+        self.fn_name = fn_name  # underlying python fn, when a Name
+
+
+def _collect_jit_pairs(project: Project) -> list[_JitKernel]:
+    out = []
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and call_name(node.value) == "jit_pair"
+            ):
+                continue
+            call = node.value
+            statics_node = None
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    statics_node = kw.value
+            if statics_node is None and len(call.args) >= 2:
+                statics_node = call.args[1]
+            statics = tuple(str_seq(statics_node) or ())
+            fn_name = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                fn_name = call.args[0].id
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Tuple) and len(tgt.elts) == 2 and all(
+                isinstance(e, ast.Name) for e in tgt.elts
+            ):
+                plain, donated = tgt.elts[0].id, tgt.elts[1].id
+            elif isinstance(tgt, ast.Name):
+                plain, donated = tgt.id, None
+            else:
+                continue
+            out.append(_JitKernel(
+                mod, plain, donated, statics, node.lineno, fn_name
+            ))
+    return out
+
+
+def _jitted_function_defs(project: Project, kernels) -> list:
+    """(module, FunctionDef) for every function that runs under jit:
+    the underlying fns of jit_pair kernels plus anything decorated with
+    ``jax.jit`` / ``partial(jax.jit, ...)``."""
+    by_mod_fn = {}
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_mod_fn.setdefault(mod.name, {})[node.name] = (
+                    mod, node
+                )
+    out = []
+    seen = set()
+    for k in kernels:
+        if k.fn_name:
+            hit = by_mod_fn.get(k.module.name, {}).get(k.fn_name)
+            if hit and id(hit[1]) not in seen:
+                seen.add(id(hit[1]))
+                out.append(hit)
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for dec in node.decorator_list:
+                src = ast.unparse(dec)
+                if "jax.jit" in src or src == "jit" or src.startswith(
+                    "jit("
+                ):
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        out.append((mod, node))
+    return out
+
+
+def _host_sync_findings(project: Project, kernels) -> list[Finding]:
+    findings = []
+    for mod, fn in _jitted_function_defs(project, kernels):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            bad = None
+            if isinstance(f, ast.Name) and f.id == "float" and (
+                node.args
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                bad = "float(...)"
+            elif isinstance(f, ast.Attribute) and f.attr == "item":
+                bad = ".item()"
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr in _HOST_SYNC_NP
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("np", "numpy", "onp", "jax")
+            ):
+                bad = f"{f.value.id}.{f.attr}(...)"
+            if bad:
+                findings.append(Finding(
+                    check=CHECK, path=mod.rel, line=node.lineno,
+                    symbol=f"{fn.name}:host-sync",
+                    message=(
+                        f"host sync `{bad}` inside jitted body "
+                        f"`{fn.name}` — forces a device round-trip "
+                        f"per trace"
+                    ),
+                ))
+    return findings
+
+
+def _bare_jit_findings(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if ".ops." not in f".{mod.name}." or mod.name.endswith(
+            "jit_util"
+        ):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr == "jit" and isinstance(
+                node.func.value, ast.Name
+            ) and node.func.value.id == "jax":
+                findings.append(Finding(
+                    check=CHECK, path=mod.rel, line=node.lineno,
+                    symbol="bare-jax-jit",
+                    message=(
+                        "bare `jax.jit` in an ops module — build the "
+                        "kernel with `jit_pair` so it has a donation "
+                        "twin and the warmup registry can select it"
+                    ),
+                ))
+    return findings
+
+
+def _builder_map(project: Project):
+    """Parse the warmup registry: ``_BUILDERS`` keys -> the builder
+    function def each resolves to (through one lambda hop)."""
+    hit = project.one_constant("_BUILDERS")
+    if hit is None:
+        return None
+    mod, node, _line = hit
+    if not isinstance(node, ast.Dict):
+        return None
+    mod_fns = {
+        n.name: n for n in ast.walk(mod.tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    out = {}
+    for k, v in zip(node.keys, node.values):
+        key = k.value if isinstance(k, ast.Constant) else None
+        if not isinstance(key, str):
+            continue
+        target = None
+        if isinstance(v, ast.Name):
+            target = mod_fns.get(v.id)
+        elif isinstance(v, ast.Lambda) and isinstance(
+            v.body, ast.Call
+        ) and isinstance(v.body.func, ast.Name):
+            target = mod_fns.get(v.body.func.id)
+        out[key] = (target, v.lineno if hasattr(v, "lineno") else 0)
+    return mod, out
+
+
+def _builder_refs_and_statics(mod_fns: dict, fn: ast.FunctionDef,
+                              kernel_names: set,
+                              _depth: int = 0) -> tuple[set, set]:
+    """Kernel names a builder references, and the static kwarg keys of
+    its ``dict(...)`` statics literal (following one helper-call hop)."""
+    refs: set = set()
+    statics: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        else:
+            continue
+        base = (
+            name[: -len("_donated")] if name.endswith("_donated")
+            else name
+        )
+        if base in kernel_names:
+            refs.add(base)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Name
+        ) and node.func.id == "dict":
+            keys = {kw.arg for kw in node.keywords if kw.arg}
+            if keys:
+                statics |= keys
+    if (not refs or not statics) and _depth < 2:
+        # helper hop: `core, finalize = _medoid_args(...)` style builders
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name
+            ) and node.func.id in mod_fns and node.func.id != fn.name:
+                r2, s2 = _builder_refs_and_statics(
+                    mod_fns, mod_fns[node.func.id], kernel_names,
+                    _depth + 1,
+                )
+                refs |= r2
+                if not statics:
+                    statics |= s2
+    return refs, statics
+
+
+def run(project: Project) -> list[Finding]:
+    kernels = _collect_jit_pairs(project)
+    findings = _bare_jit_findings(project)
+    findings += _host_sync_findings(project, kernels)
+
+    for k in kernels:
+        if k.donated is None or k.donated != f"{k.name}_donated":
+            findings.append(Finding(
+                check=CHECK, path=k.module.rel, line=k.line,
+                symbol=f"{k.name}:twin",
+                message=(
+                    f"`jit_pair` targets for `{k.name}` must unpack as "
+                    f"`(plain, plain_donated)` so call sites and the "
+                    f"warmup registry can select the twin by name"
+                ),
+            ))
+
+    reg = _builder_map(project)
+    if reg is None or not kernels:
+        return findings
+    reg_mod, builders = reg
+    mod_fns = {
+        n.name: n for n in ast.walk(reg_mod.tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    kernel_by_name = {k.name: k for k in kernels}
+    covered: set = set()
+    for key, (builder_fn, line) in sorted(builders.items()):
+        if builder_fn is None:
+            continue
+        refs, statics = _builder_refs_and_statics(
+            mod_fns, builder_fn, set(kernel_by_name)
+        )
+        covered |= refs
+        for ref in sorted(refs):
+            want = set(kernel_by_name[ref].statics)
+            if statics and statics != want:
+                missing = sorted(want - statics)
+                extra = sorted(statics - want)
+                detail = []
+                if missing:
+                    detail.append(f"missing {missing}")
+                if extra:
+                    detail.append(f"extra {extra}")
+                findings.append(Finding(
+                    check=CHECK, path=reg_mod.rel,
+                    line=builder_fn.lineno,
+                    symbol=f"{key}:statics",
+                    message=(
+                        f"registry builder `{builder_fn.name}` statics "
+                        f"disagree with `{ref}` static_argnames "
+                        f"({'; '.join(detail)}) — it would warm the "
+                        f"wrong executable"
+                    ),
+                ))
+    for name, k in sorted(kernel_by_name.items()):
+        if name not in covered:
+            findings.append(Finding(
+                check=CHECK, path=k.module.rel, line=k.line,
+                symbol=f"{name}:registry",
+                message=(
+                    f"kernel `{name}` has no warmup-registry builder "
+                    f"(_BUILDERS) — warmed reruns will re-compile it"
+                ),
+            ))
+    return findings

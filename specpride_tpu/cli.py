@@ -3525,6 +3525,15 @@ def cmd_merge_parts(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Static analysis over the project tree (`specpride lint`).  The
+    analyzer is pure stdlib AST work — imported lazily so the compute
+    CLI never pays for it."""
+    from specpride_tpu.analysis import runner as lint_runner
+
+    return lint_runner.main(args)
+
+
 def cmd_convert(args) -> int:
     from specpride_tpu import convert
 
@@ -4131,6 +4140,47 @@ def build_parser() -> argparse.ArgumentParser:
     pt.add_argument("-o", "--out", default="trace.json",
                     help="trace-event JSON output path (default trace.json)")
     pt.set_defaults(fn=cmd_trace)
+
+    pl = sub.add_parser(
+        "lint",
+        help="project-invariant static analysis: lane-safety, "
+        "jit-hygiene, journal/metrics/flag/fault-site conformance "
+        "(docs/static-analysis.md); exits non-zero on any finding not "
+        "in the committed baseline",
+    )
+    pl.add_argument(
+        "root", nargs="?", default=".",
+        help="project root to analyze (default: current directory; CI "
+        "runs from the repo root)",
+    )
+    pl.add_argument(
+        "--select", metavar="ID[,ID...]",
+        help="run only these checkers (see --list for ids)",
+    )
+    pl.add_argument(
+        "--list", action="store_true",
+        help="enumerate checkers with one-line descriptions and exit",
+    )
+    pl.add_argument(
+        "--json", metavar="FILE",
+        help="write the machine-readable report here ('-' = stdout)",
+    )
+    pl.add_argument(
+        "--baseline", metavar="FILE",
+        help="baseline/suppression file (default: <root>/"
+        "lint-baseline.json); findings matching an entry don't fail "
+        "the run",
+    )
+    pl.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline file: report every finding as new",
+    )
+    pl.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from the current findings (every "
+        "entry then needs a written 'reason' before CI accepts it)",
+    )
+    pl.set_defaults(fn=cmd_lint)
 
     pp = sub.add_parser("plot", help="mirror plots for one cluster")
     pp.add_argument("clustered",

@@ -379,7 +379,10 @@ class StreamedClusters:
     def drain_malformed(self, malformed) -> int:
         """Hand every scan-detected truncated block to ``malformed(raw,
         reason)`` and forget them.  Returns the count drained."""
-        spans, self.malformed_spans = self.malformed_spans, []
+        with self._cache_lock:
+            # pack-pool workers window-parse under the same lock; the
+            # drain swap must not race a concurrent scan's appends
+            spans, self.malformed_spans = self.malformed_spans, []
         with open(self.path, "rb") as fh:
             for begin, end in spans:
                 fh.seek(begin)

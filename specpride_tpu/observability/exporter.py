@@ -92,6 +92,21 @@ def slo_objective(slo: dict[str, float], method: str | None) -> float | None:
 # -- the daemon's live registry -----------------------------------------
 
 
+# the pre-register-at-0 contract, machine-checked by `specpride lint`
+# (metrics-conformance): every counter/gauge registered in a telemetry
+# __init__ in this module whose name matches one of these families must
+# be zero-initialized there, so the series exist from the first scrape
+# through the final --metrics-out drain snapshot — a 0-valued row beats
+# an absent one for rate() queries and for auditing that a feature
+# never fired.  Histograms are exempt (they appear with the first
+# observe by design).
+PRE_REGISTERED_FAMILIES = (
+    "specpride_serve_batch_*",
+    "specpride_h2d_bytes_total",
+    "specpride_d2h_bytes_total",
+)
+
+
 class ServeTelemetry:
     """Resident metric state for one serving daemon.
 

@@ -247,7 +247,11 @@ def set_current(tracer: Tracer | None) -> Tracer | NullTracer:
     returns the previous one so callers can restore it."""
     global _current
     prev = _current
-    _current = tracer if tracer is not None else _NULL_TRACER
+    # serving lanes never call this — they scope tracers per thread via
+    # set_thread_current; the process-wide install happens only on the
+    # one-shot CLI path, and the swap itself is a single GIL-atomic
+    # store either way
+    _current = tracer if tracer is not None else _NULL_TRACER  # lint: ok[lane-safety] one-shot CLI installs process-wide; serve lanes use the tls override
     return prev
 
 

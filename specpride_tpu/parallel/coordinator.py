@@ -470,7 +470,10 @@ class Coordinator:
         the claim loop re-scan.  Journaled as a ``retry`` at the
         ``cas`` site so the chaos audit pairs the fault with its
         recovery."""
-        self.cas_conflicts += 1
+        with self._lock:
+            # the claim loop and the heartbeat lane's renewals can both
+            # lose a CAS race; unguarded += would drop conflicts
+            self.cas_conflicts += 1
         if self.journal is not None:
             self.journal.emit(
                 "retry", site="cas", attempt=0, backoff_s=0.0,
@@ -519,8 +522,9 @@ class Coordinator:
             # NOTHING here — a lease_expire with no paired
             # chunk_reassign would fail the audit over zero lost work
             return None
-        self.lease_expires_observed += 1
-        self.reassignments += 1
+        with self._lock:
+            self.lease_expires_observed += 1
+            self.reassignments += 1
         if self.journal is not None:
             self.journal.emit(
                 "lease_expire", rank=dead_rank, range=k,
@@ -544,7 +548,7 @@ class Coordinator:
         k = claim.range.range_id
         with self._lock:
             self._held[k] = claim
-        self.ranges_run += 1
+            self.ranges_run += 1
         if self.journal is not None:
             self.journal.emit(
                 "lease_claim", rank=self.rank, range=k,
@@ -559,7 +563,8 @@ class Coordinator:
             # that pairs with the donor's lease_split in the audit —
             # whoever wins the claim (the proposing stealer usually,
             # any idle rank legitimately) emits it
-            self.steals += 1
+            with self._lock:
+                self.steals += 1
             if self.journal is not None:
                 self.journal.emit(
                     "chunk_reassign", range=k,
@@ -809,7 +814,8 @@ class Coordinator:
              "parent": k, "donor_rank": self.rank},
         )
         self._apply_cut(k, cut_global)
-        self.lease_splits += 1
+        with self._lock:
+            self.lease_splits += 1
         if self.journal is not None:
             self.journal.emit(
                 "lease_split", range=k, new_range=new_id,

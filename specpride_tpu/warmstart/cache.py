@@ -46,7 +46,10 @@ class CacheState:
     source: str  # "flag" | "env" | "jax-config" | "default" | "off"
 
 
-def _install_listeners() -> None:
+def _install_listeners_locked() -> None:
+    """Caller holds ``_lock`` — an unguarded check-then-set here could
+    register the jax.monitoring listeners twice when worker lanes build
+    their resident backends concurrently, double-counting every event."""
     global _listeners_installed
     if _listeners_installed:
         return
@@ -174,7 +177,7 @@ def configure_compile_cache(spec: str | None) -> CacheState:
     """
     global _state
     with _lock:
-        _install_listeners()
+        _install_listeners_locked()
         if spec is None:
             if _state is None:
                 _state = _resolve_default()

@@ -1,0 +1,716 @@
+"""``specpride lint`` (specpride_tpu.analysis): one seeded violation
+per checker must be caught, a clean fixture must report nothing, the
+--json report round-trips, baseline/suppression semantics hold, and
+the real repository lints clean (the CI gate's contract)."""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from specpride_tpu.analysis import checker_ids, run_checks
+from specpride_tpu.analysis.baseline import Baseline
+from specpride_tpu.analysis.core import Finding, Project
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_tree(root, files: dict) -> str:
+    for rel, text in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(textwrap.dedent(text))
+    return str(root)
+
+
+# -- fixture sources ----------------------------------------------------
+
+LANE_BAD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.hits = 0
+            self._t = threading.Thread(
+                target=self._run, name="fix-worker", daemon=True
+            )
+
+        def _run(self):
+            while True:
+                self.hits += 1  # unguarded, also written from main
+
+        def bump(self):
+            self.hits += 1
+
+    def cmd_main():
+        c = Counter()
+        c.bump()
+"""
+
+LANE_GOOD = LANE_BAD.replace(
+    """\
+        def _run(self):
+            while True:
+                self.hits += 1  # unguarded, also written from main
+
+        def bump(self):
+            self.hits += 1
+""",
+    """\
+        def _run(self):
+            while True:
+                with self._lock:
+                    self.hits += 1
+
+        def bump(self):
+            with self._lock:
+                self.hits += 1
+""",
+)
+
+JIT_OPS = """
+    from fix.ops.jit_util import jit_pair
+
+    def _kernel(x, *, cap, impl):
+        return x
+
+    kernel_packed, kernel_packed_donated = jit_pair(
+        _kernel, static_argnames=("cap", "impl"), donate_argnums=(0,)
+    )
+"""
+
+JIT_UTIL = """
+    import jax
+
+    def jit_pair(fn, static_argnames, donate_argnums):
+        plain = jax.jit(fn, static_argnames=static_argnames)
+        donated = jax.jit(
+            fn, static_argnames=static_argnames,
+            donate_argnums=donate_argnums,
+        )
+        return plain, donated
+"""
+
+# builder statics drop "impl" -> the PR 6 bug class
+JIT_REGISTRY_BAD = """
+    from fix.ops import kernels
+
+    def _kernel_packed(entry, donate):
+        avals = ()
+        statics = dict(cap=entry.shape_key[0])
+        fn = (
+            kernels.kernel_packed_donated if donate
+            else kernels.kernel_packed
+        )
+        return fn, avals, statics
+
+    _BUILDERS = {
+        "kernel_packed": _kernel_packed,
+    }
+"""
+
+JIT_REGISTRY_GOOD = JIT_REGISTRY_BAD.replace(
+    "statics = dict(cap=entry.shape_key[0])",
+    "statics = dict(cap=entry.shape_key[0], impl='scan')",
+)
+
+JOURNAL_MOD = """
+    EVENT_FIELDS = {
+        "run_start": frozenset({"command"}),
+        "run_end": frozenset({"elapsed_s"}),
+    }
+
+    class Journal:
+        def emit(self, event, **fields):
+            return {}
+"""
+
+JOURNAL_EMIT_BAD = """
+    def go(journal):
+        journal.emit("run_start", command="x")
+        journal.emit("run_stop")  # unknown event
+        journal.emit("run_end")   # missing elapsed_s
+"""
+
+JOURNAL_EMIT_GOOD = """
+    def go(journal):
+        journal.emit("run_start", command="x")
+        journal.emit("run_end", elapsed_s=1.0)
+
+    def render(events):
+        return [e for e in events if e["event"] == "run_end"]
+"""
+
+DOC_EVENTS_GOOD = """
+    # Events
+
+    | event | payload (required) | meaning |
+    |---|---|---|
+    | `run_start` | `command` | run began |
+    | `run_end` | `elapsed_s` (plus `counters`) | run finished |
+"""
+
+DOC_EVENTS_BAD = """
+    # Events
+
+    | event | payload (required) | meaning |
+    |---|---|---|
+    | `run_start` | `command`, `n_clusters` | run began |
+    | `run_finish` | `elapsed_s` | stale row |
+"""
+
+METRICS_BAD = """
+    def build(r):
+        r.counter("specpride_fix_jobs", "no _total suffix")
+        r.gauge("specpride_fix_depth_total", "gauge with _total")
+"""
+
+METRICS_GOOD = """
+    def build(r):
+        r.counter("specpride_fix_jobs_total", "jobs")
+        r.gauge("specpride_fix_depth", "depth")
+"""
+
+DOC_METRICS_GOOD = """
+    # Metrics
+
+    - `specpride_fix_jobs_total` — jobs
+    - `specpride_fix_depth` — queue depth
+"""
+
+FLAGS_MOD_BAD = """
+    DAEMON_ONLY_FLAGS = ("--layout", "--vanished")
+    _DAEMON_OWNED_DESTS = ("layout", "stale_dest")
+"""
+
+FLAGS_MOD_GOOD = """
+    DAEMON_ONLY_FLAGS = ("--layout",)
+    _DAEMON_OWNED_DESTS = ("layout",)
+"""
+
+FLAGS_PARSER = """
+    import argparse
+
+    def build():
+        ap = argparse.ArgumentParser()
+        ap.add_argument("--layout", choices=["auto", "flat"])
+        return ap
+"""
+
+DOC_FLAGS = """
+    # Flags
+
+    - `--layout` — device layout
+"""
+
+FAULTS_MOD = """
+    EXECUTOR_FAULT_SITES = ("parse", "write")
+    FAULT_SITES = EXECUTOR_FAULT_SITES + ("cas",)
+
+    def check(site):
+        pass
+"""
+
+FAULTS_VISITS_BAD = """
+    from fix.robustness import faults
+
+    def run():
+        faults.check("parse")
+        faults.check("wrong_site")
+        # "write" and "cas" never visited
+"""
+
+FAULTS_VISITS_GOOD = """
+    from fix.robustness import faults
+
+    def run():
+        faults.check("parse")
+        faults.check("write")
+        faults.check("cas")
+"""
+
+
+def base_fixture(good: bool) -> dict:
+    """A miniature project exercising every checker's anchors; ``good``
+    selects the violation-free variant of each artifact."""
+    return {
+        "fix/__init__.py": "",
+        "fix/lanes.py": LANE_GOOD if good else LANE_BAD,
+        "fix/ops/__init__.py": "",
+        "fix/ops/jit_util.py": JIT_UTIL,
+        "fix/ops/kernels.py": JIT_OPS,
+        "fix/registry.py": (
+            JIT_REGISTRY_GOOD if good else JIT_REGISTRY_BAD
+        ),
+        "fix/journal.py": JOURNAL_MOD,
+        "fix/emitter.py": (
+            JOURNAL_EMIT_GOOD if good else JOURNAL_EMIT_BAD
+        ),
+        "fix/metrics.py": METRICS_GOOD if good else METRICS_BAD,
+        "fix/protocol.py": FLAGS_MOD_GOOD if good else FLAGS_MOD_BAD,
+        "fix/parser.py": FLAGS_PARSER,
+        "fix/robustness/__init__.py": "",
+        "fix/robustness/faults.py": FAULTS_MOD,
+        "fix/visits.py": (
+            FAULTS_VISITS_GOOD if good else FAULTS_VISITS_BAD
+        ),
+        "docs/observability.md": (
+            DOC_EVENTS_GOOD if good else DOC_EVENTS_BAD
+        ) + DOC_METRICS_GOOD,
+        "docs/cli.md": DOC_FLAGS,
+    }
+
+
+@pytest.fixture
+def bad_root(tmp_path):
+    return write_tree(tmp_path, base_fixture(good=False))
+
+
+@pytest.fixture
+def clean_root(tmp_path):
+    return write_tree(tmp_path, base_fixture(good=True))
+
+
+def by_check(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.check, []).append(f)
+    return out
+
+
+# -- every checker catches its seeded violation -------------------------
+
+
+def test_lane_safety_catches_unlocked_multi_lane_write(bad_root):
+    found = by_check(run_checks(bad_root, select=["lane-safety"]))
+    hits = found.get("lane-safety", [])
+    assert any(
+        "Counter.hits" in f.symbol or f.symbol.endswith("hits")
+        for f in hits
+    ), hits
+    assert all(f.path == "fix/lanes.py" for f in hits)
+
+
+def test_jit_hygiene_catches_builder_statics_drift(bad_root):
+    hits = run_checks(bad_root, select=["jit-hygiene"])
+    assert any(
+        "statics" in f.symbol and "impl" in f.message for f in hits
+    ), hits
+
+
+def test_jit_hygiene_catches_host_sync_and_missing_registry(tmp_path):
+    files = base_fixture(good=True)
+    files["fix/ops/kernels.py"] = textwrap.dedent(JIT_OPS) + (
+        textwrap.dedent("""
+        import numpy as np
+        from fix.ops.jit_util import jit_pair
+
+        def _orphan(x, *, cap):
+            return float(np.asarray(x).sum())
+
+        orphan_kernel, orphan_kernel_donated = jit_pair(
+            _orphan, static_argnames=("cap",), donate_argnums=(0,)
+        )
+        """)
+    )
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["jit-hygiene"])
+    symbols = {f.symbol for f in hits}
+    assert "orphan_kernel:registry" in symbols, hits
+    assert any(s.startswith("_orphan:host-sync") for s in symbols), hits
+
+
+def test_journal_schema_catches_all_directions(bad_root):
+    hits = run_checks(bad_root, select=["journal-schema"])
+    symbols = {f.symbol for f in hits}
+    assert "emit:run_stop" in symbols  # unknown event emitted
+    assert "emit:run_end:fields" in symbols  # missing required field
+    assert "doc:run_start:fields" in symbols  # docs row drift
+    assert "doc:run_finish:unknown" in symbols  # stale docs row
+    assert "doc:run_end" in symbols  # schema event missing a row
+
+
+def test_journal_schema_catches_stale_renderer_literal(tmp_path):
+    files = base_fixture(good=True)
+    files["fix/emitter.py"] = textwrap.dedent(
+        files["fix/emitter.py"]
+    ) + textwrap.dedent("""
+        def render_stale(events):
+            return [e for e in events if e.get("event") == "gone"]
+    """)
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["journal-schema"])
+    assert any(f.symbol == "render:gone" for f in hits), hits
+
+
+def test_metrics_conformance_catches_suffix_and_doc_drift(bad_root):
+    hits = run_checks(bad_root, select=["metrics-conformance"])
+    symbols = {f.symbol for f in hits}
+    assert "specpride_fix_jobs:suffix" in symbols
+    assert "specpride_fix_depth_total:suffix" in symbols
+    # the good docs list the GOOD names; the bad code registers others
+    assert any(s.endswith(":undocumented") for s in symbols)
+    assert any(s.endswith(":stale-doc") for s in symbols)
+
+
+def test_metrics_pre_register_contract(tmp_path):
+    files = base_fixture(good=True)
+    files["fix/exporter.py"] = textwrap.dedent("""
+        PRE_REGISTERED_FAMILIES = ("specpride_fix_batch_*",)
+
+        class Telemetry:
+            def __init__(self, r):
+                self.batch = r.counter(
+                    "specpride_fix_batch_total", "batched work"
+                )
+
+            def sync_singletons(self):
+                pass
+    """)
+    files["docs/observability.md"] += (
+        "\n- `specpride_fix_batch_total` — batch counter\n"
+    )
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["metrics-conformance"])
+    assert any(
+        f.symbol == "specpride_fix_batch_total:pre-register"
+        for f in hits
+    ), hits
+    # zero-init satisfies the contract
+    files["fix/exporter.py"] = textwrap.dedent("""
+        PRE_REGISTERED_FAMILIES = ("specpride_fix_batch_*",)
+
+        class Telemetry:
+            def __init__(self, r):
+                self.batch = r.counter(
+                    "specpride_fix_batch_total", "batched work"
+                )
+                self.batch.inc(0)
+
+            def sync_singletons(self):
+                pass
+    """)
+    root2 = tmp_path / "ok"
+    os.makedirs(root2, exist_ok=True)
+    write_tree(root2, files)
+    hits2 = run_checks(str(root2), select=["metrics-conformance"])
+    assert not any("pre-register" in f.symbol for f in hits2), hits2
+
+
+def test_cli_flags_catches_stale_daemon_flag_and_dest(bad_root):
+    hits = run_checks(bad_root, select=["cli-flags"])
+    symbols = {f.symbol for f in hits}
+    assert "--vanished:unknown" in symbols
+    assert "stale_dest:dest-stale" in symbols
+    assert "vanished:dest-missing" in symbols
+
+
+def test_cli_flags_catches_undocumented_flag(tmp_path):
+    files = base_fixture(good=True)
+    files["fix/parser.py"] = FLAGS_PARSER.replace(
+        'ap.add_argument("--layout", choices=["auto", "flat"])',
+        'ap.add_argument("--layout", choices=["auto", "flat"])\n'
+        '        ap.add_argument("--mystery", type=int)',
+    )
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["cli-flags"])
+    assert any(
+        f.symbol == "--mystery:undocumented" for f in hits
+    ), hits
+
+
+def test_fault_sites_both_directions(bad_root):
+    hits = run_checks(bad_root, select=["fault-sites"])
+    symbols = {f.symbol for f in hits}
+    assert "wrong_site:undeclared" in symbols
+    assert "write:unvisited" in symbols
+    assert "cas:unvisited" in symbols
+
+
+# -- clean fixture ------------------------------------------------------
+
+
+def test_clean_fixture_has_zero_findings(clean_root):
+    findings = run_checks(clean_root)
+    assert findings == [], [f.to_json() for f in findings]
+
+
+# -- report / baseline / suppression semantics --------------------------
+
+
+def test_json_report_round_trip(bad_root, tmp_path):
+    from specpride_tpu.cli import main as cli_main
+
+    out = tmp_path / "report.json"
+    rc = cli_main([
+        "lint", str(bad_root), "--json", str(out),
+    ])
+    assert rc == 1  # seeded violations, no baseline
+    report = json.loads(out.read_text())
+    assert report["version"] == 1
+    assert {c["id"] for c in report["checks"]} == set(checker_ids())
+    assert report["summary"]["new"] == len(report["findings"]) > 0
+    for rec in report["findings"]:
+        f = Finding.from_json(rec)
+        assert f.to_json() == rec
+        assert f.check in set(checker_ids())
+
+
+def test_baseline_suppresses_and_reports_stale(bad_root, tmp_path):
+    findings = run_checks(bad_root)
+    assert findings
+    bl_path = str(tmp_path / "baseline.json")
+    Baseline.write(bl_path, findings)
+    payload = json.loads(open(bl_path).read())
+    # an un-justified baseline entry is itself a failure
+    bl = Baseline.load(bl_path)
+    new, baselined, stale, bad = bl.split(findings)
+    assert new == [] and len(baselined) == len(findings)
+    assert len(bad) == len(payload["suppressions"])  # reasons empty
+    # justify every entry -> green
+    for e in payload["suppressions"]:
+        e["reason"] = "legacy, tracked in ISSUE 14"
+    with open(bl_path, "w") as fh:
+        json.dump(payload, fh)
+    new, baselined, stale, bad = Baseline.load(bl_path).split(findings)
+    assert new == [] and bad == [] and stale == []
+    # a paid-off finding leaves its entry stale (reported, not fatal)
+    new, _baselined, stale, _bad = Baseline.load(bl_path).split(
+        findings[1:]
+    )
+    assert new == [] and len(stale) == 1
+
+
+def test_baseline_cli_gate(bad_root, tmp_path):
+    from specpride_tpu.cli import main as cli_main
+
+    bl = tmp_path / "bl.json"
+    assert cli_main([
+        "lint", str(bad_root), "--update-baseline",
+        "--baseline", str(bl),
+    ]) == 0
+    payload = json.loads(bl.read_text())
+    for e in payload["suppressions"]:
+        e["reason"] = "seeded fixture violation"
+    bl.write_text(json.dumps(payload))
+    assert cli_main([
+        "lint", str(bad_root), "--baseline", str(bl),
+    ]) == 0
+    assert cli_main([
+        "lint", str(bad_root), "--baseline", str(bl), "--no-baseline",
+    ]) == 1
+
+
+def test_inline_suppression(tmp_path):
+    files = base_fixture(good=False)
+    files["fix/lanes.py"] = LANE_BAD.replace(
+        "self.hits += 1  # unguarded, also written from main",
+        "self.hits += 1  # lint: ok[lane-safety] fixture proves "
+        "suppression",
+    ).replace(
+        "            self.hits += 1\n\n    def cmd_main",
+        "            self.hits += 1  # lint: ok[lane-safety] fixture\n"
+        "\n    def cmd_main",
+    )
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["lane-safety"])
+    assert hits == [], [f.to_json() for f in hits]
+
+
+def test_select_unknown_checker_is_an_error(bad_root):
+    from specpride_tpu.cli import main as cli_main
+
+    assert cli_main([
+        "lint", str(bad_root), "--select", "no-such-check",
+    ]) == 2
+
+
+def test_list_enumerates_all_checkers(capsys):
+    from specpride_tpu.cli import main as cli_main
+
+    assert cli_main(["lint", "--list"]) == 0
+    out = capsys.readouterr().out
+    for cid in checker_ids():
+        assert cid in out
+    assert len(checker_ids()) >= 6
+
+
+# -- the real repository ------------------------------------------------
+
+
+def test_repository_lints_clean():
+    """The CI gate's contract: the tree as committed has no findings
+    beyond the committed baseline (which must itself be justified)."""
+    project = Project(REPO_ROOT)
+    assert project.errors == []
+    findings = run_checks(REPO_ROOT, project=project)
+    bl_path = os.path.join(REPO_ROOT, "lint-baseline.json")
+    bl = Baseline.load(bl_path)
+    new, _baselined, stale, bad = bl.split(findings)
+    assert new == [], [f.to_json() for f in new]
+    assert bad == [], "baseline entries need a written reason"
+    assert stale == [], "remove paid-off baseline entries"
+
+
+def test_project_scans_package_data_subdir():
+    """Root-level `data/`/`docs/` prune; a package's OWN data
+    subpackage must still be analyzed (specpride_tpu/data holds the
+    packed layouts — blinding the checkers to it defeats the point)."""
+    project = Project(REPO_ROOT)
+    rels = {m.rel for m in project.modules}
+    assert "specpride_tpu/data/packed.py" in rels
+    assert not any(r.startswith("tests/") for r in rels)
+
+
+def test_update_baseline_with_select_preserves_other_checkers(
+    bad_root, tmp_path
+):
+    findings = run_checks(bad_root)
+    lane = [f for f in findings if f.check == "lane-safety"]
+    other = [f for f in findings if f.check != "lane-safety"]
+    assert lane and other
+    bl_path = str(tmp_path / "bl.json")
+    Baseline.write(bl_path, findings)
+    payload = json.loads(open(bl_path).read())
+    for e in payload["suppressions"]:
+        e["reason"] = "justified"
+    with open(bl_path, "w") as fh:
+        json.dump(payload, fh)
+    # a one-checker refresh must keep the other checkers' entries AND
+    # carry forward the written reasons on re-emitted fingerprints
+    Baseline.write(
+        bl_path, lane, existing=Baseline.load(bl_path),
+        select=["lane-safety"],
+    )
+    bl = Baseline.load(bl_path)
+    assert len(bl.entries) == len({f.fingerprint for f in findings})
+    assert all(e["reason"] == "justified" for e in bl.entries)
+    new, _b, stale, bad = bl.split(findings)
+    assert new == [] and stale == [] and bad == []
+
+
+def test_pre_register_rejects_bare_inc(tmp_path):
+    files = base_fixture(good=True)
+    files["fix/exporter.py"] = """
+        PRE_REGISTERED_FAMILIES = ("specpride_fix_batch_*",)
+
+        class Telemetry:
+            def __init__(self, r):
+                self.batch = r.counter(
+                    "specpride_fix_batch_total", "batched work"
+                )
+                self.batch.inc()  # increments by 1: NOT a zero-init
+
+            def sync_singletons(self):
+                pass
+    """
+    files["docs/observability.md"] += (
+        "\\n- `specpride_fix_batch_total` — batch counter\\n"
+    )
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["metrics-conformance"])
+    assert any("pre-register" in f.symbol for f in hits), hits
+
+
+def test_cli_flags_docs_match_is_token_not_substring(tmp_path):
+    files = base_fixture(good=True)
+    files["fix/parser.py"] = FLAGS_PARSER.replace(
+        'ap.add_argument("--layout", choices=["auto", "flat"])',
+        'ap.add_argument("--layout", choices=["auto", "flat"])\n'
+        '        ap.add_argument("--poll", type=float)',
+    )
+    files["docs/cli.md"] = DOC_FLAGS + (
+        "\n- `--poll-interval` — a LONGER flag must not count as"
+        " documenting `--poll-interval`'s prefix\n"
+    )
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["cli-flags"])
+    assert any(
+        f.symbol == "--poll:undocumented" for f in hits
+    ), hits
+
+
+def test_lane_safety_sees_nested_thread_bodies(tmp_path):
+    """The dominant concurrency pattern here is a nested closure
+    handed to Thread(target=...) — its body (and everything it calls)
+    must be walked, or lane propagation dies at the entry point."""
+    files = base_fixture(good=True)
+    files["fix/lanes.py"] = """
+        import threading
+
+        class Shared:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                self.count += 1
+
+        def cmd_pipeline(shared):
+            def _worker():
+                while True:
+                    shared.bump()
+
+            t = threading.Thread(
+                target=_worker, name="fix-nested-worker", daemon=True
+            )
+            t.start()
+            shared.bump()
+    """
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["lane-safety"])
+    assert any(f.symbol.endswith("count") for f in hits), hits
+
+
+def test_select_does_not_report_other_checkers_entries_stale(
+    bad_root, tmp_path
+):
+    findings = run_checks(bad_root)
+    bl_path = str(tmp_path / "bl.json")
+    Baseline.write(bl_path, findings)
+    payload = json.loads(open(bl_path).read())
+    for e in payload["suppressions"]:
+        e["reason"] = "justified"
+    with open(bl_path, "w") as fh:
+        json.dump(payload, fh)
+    lane_only = run_checks(bad_root, select=["lane-safety"])
+    bl = Baseline.load(bl_path)
+    new, _b, stale, bad = bl.split(lane_only, select=["lane-safety"])
+    assert new == [] and stale == [] and bad == []
+    # without select the unmatched entries ARE stale (full-run truth)
+    _n, _b2, stale_full, _bad2 = bl.split(lane_only)
+    assert stale_full
+
+
+def test_metrics_prefix_rule(tmp_path):
+    files = base_fixture(good=True)
+    files["fix/metrics.py"] = METRICS_GOOD.replace(
+        'r.gauge("specpride_fix_depth", "depth")',
+        'r.gauge("specpride_fix_depth", "depth")\n'
+        '    r.counter("h2d_bytes_total", "missing project prefix")',
+    )
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["metrics-conformance"])
+    assert any(
+        f.symbol == "h2d_bytes_total:prefix" for f in hits
+    ), hits
+
+
+def test_repository_anchor_discovery():
+    """The cross-artifact anchors must actually resolve on the real
+    tree — a silently-skipped checker would pass vacuously."""
+    project = Project(REPO_ROOT)
+    assert project.one_constant("EVENT_FIELDS") is not None
+    assert project.one_constant("FAULT_SITES") is not None
+    assert project.one_constant("DAEMON_ONLY_FLAGS") is not None
+    assert project.one_constant("_BUILDERS") is not None
+    assert project.one_constant("PRE_REGISTERED_FAMILIES") is not None
+    from specpride_tpu.analysis import jit_hygiene
+
+    kernels = jit_hygiene._collect_jit_pairs(project)
+    assert len(kernels) >= 8  # every packed device kernel
