@@ -847,6 +847,170 @@ env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     stats "$mb_tmp/serve.jsonl" | grep -q "batching:"
 rm -rf "$mb_tmp"
 
+echo "== distributed tracing: trace-context plane end to end =="
+# the v4 acceptance bar: ONE `specpride trace --job` invocation over a
+# batched served job AND a 2-rank elastic run (joined to the same
+# trace via the SPECPRIDE_TRACE env handoff) yields a single
+# schema-valid Perfetto trace whose spans cover client submit, daemon
+# queue/dispatch, the shared batch dispatch, and rank-side chunk
+# commits on one clock-anchored axis, with flow arrows across process
+# tracks; every job_done's trace_id resolves; the latency histograms
+# carry trace exemplars (strict validator); the rotating daemon
+# journal reads across segments; /healthz answers ok; and tracing
+# on/off outputs are byte-identical
+dt_tmp=$(mktemp -d)
+DT_IN=tests/data/golden_clustered.mgf
+DTSOCK="$dt_tmp/serve.sock"
+# tracing on vs off: byte-identical outputs (the causal envelope is
+# observability-only)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$DT_IN" "$dt_tmp/plain.mgf" --method bin-mean
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$DT_IN" "$dt_tmp/traced.mgf" --method bin-mean \
+    --journal "$dt_tmp/traced.jsonl"
+cmp "$dt_tmp/plain.mgf" "$dt_tmp/traced.mgf"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    serve --socket "$DTSOCK" --compile-cache "$dt_tmp/cache" \
+    --journal "$dt_tmp/serve.jsonl" --journal-rotate-mb 0.01 \
+    --workers 2 --max-queue 32 --batch-window 25 \
+    --watchdog-timeout 120 --metrics-port 0 \
+    --metrics-out "$dt_tmp/serve.prom" &
+DT_PID=$!
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$DTSOCK" "$dt_tmp" <<'EOF'
+import json, sys, threading, urllib.request
+from specpride_tpu.serve import client as sc
+sock, tmp = sys.argv[1:3]
+assert sc.wait_for_socket(sock, timeout=180), "trace daemon never came up"
+# /healthz: a real readiness probe now (200 ok while lanes are healthy)
+status = sc.request(sock, {"op": "status"})
+url = status["metrics_url"].replace("/metrics", "/healthz")
+with urllib.request.urlopen(url, timeout=10) as resp:
+    body = resp.read().decode()
+    assert resp.status == 200 and body.startswith("ok"), (resp.status, body)
+# two-tenant 6-job burst: each submit writes its CLIENT journal shard
+# and every job its own job journal — the trace merger's inputs
+src = "tests/data/golden_clustered.mgf"
+terms = {}
+def submit(i):
+    tenant = "tenantA" if i % 2 == 0 else "tenantB"
+    terms[i] = sc.submit_wait(
+        sock,
+        ["consensus", src, f"{tmp}/burst_{i}.mgf", "--method",
+         "bin-mean", "--journal", f"{tmp}/job_{i}.jsonl"],
+        client=tenant, timeout=600, journal=f"{tmp}/client_{i}.jsonl",
+    )
+threads = [threading.Thread(target=submit, args=(i,)) for i in range(6)]
+for t in threads: t.start()
+for t in threads: t.join()
+bad = {i: t for i, t in terms.items() if t.get("status") != "done"}
+assert not bad, bad
+assert all(t.get("trace_id") for t in terms.values()), terms
+batched = {i: t for i, t in terms.items() if t.get("batch")}
+assert batched, "the 6-job burst must coalesce at least one batch"
+lead = min(batched)
+json.dump({"job_id": terms[lead]["job_id"],
+           "trace_id": terms[lead]["trace_id"]},
+          open(f"{tmp}/lead.json", "w"))
+print(f"burst OK: 6 traced jobs, {len(batched)} batched, "
+      f"lead job {terms[lead]['job_id']}")
+EOF
+# a healthy 2-rank elastic run JOINED to the served job's trace via the
+# SPECPRIDE_TRACE env handoff (the fleet-supervisor hop, exercised
+# directly): both ranks' journals then carry the same trace_id
+DT_TRACE=$(env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c "
+import json,sys; print(json.load(open(sys.argv[1]))['trace_id'])
+" "$dt_tmp/lead.json")
+dt_rank() {
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        SPECPRIDE_TRACE="$DT_TRACE:ffffffffffffffff" \
+        python -m specpride_tpu \
+        consensus "$DT_IN" "$dt_tmp/el.mgf" --method bin-mean \
+        --backend tpu --elastic "$dt_tmp/coord" --process-id "$1" \
+        --elastic-range 2 --checkpoint-every 1 \
+        --journal "$dt_tmp/el.jsonl"
+}
+dt_rank 0 & DT_R0=$!
+dt_rank 1 & DT_R1=$!
+wait $DT_R0; wait $DT_R1
+kill -TERM $DT_PID
+DT_RC=0; wait $DT_PID || DT_RC=$?
+test "$DT_RC" -eq 0
+# ONE trace --job invocation over daemon + client + job + rank shards
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$dt_tmp" <<'EOF'
+import glob, json, os, subprocess, sys
+tmp = sys.argv[1]
+lead = json.load(open(os.path.join(tmp, "lead.json")))
+shards = ([os.path.join(tmp, "serve.jsonl"),
+           os.path.join(tmp, "el.jsonl")]
+          + sorted(glob.glob(os.path.join(tmp, "client_*.jsonl")))
+          + sorted(glob.glob(os.path.join(tmp, "job_*.jsonl"))))
+out = os.path.join(tmp, "causal.json")
+subprocess.run(
+    [sys.executable, "-m", "specpride_tpu", "trace",
+     *shards, "--job", str(lead["job_id"]), "-o", out],
+    check=True,
+)
+trace = json.load(open(out))
+evs = trace["traceEvents"]
+# schema-valid Perfetto: every non-meta event has ph/ts/pid
+for e in evs:
+    assert "ph" in e and "pid" in e and ("ts" in e or e["ph"] == "M"), e
+spans = [e for e in evs if e.get("ph") == "X"]
+names = {e["name"] for e in spans}
+pids = {e["pid"] for e in spans}
+assert len(pids) >= 3, f"expected >=3 process tracks, got {pids}"
+for need in ("submit", "serve:queue", "serve:job", "serve:batch",
+             "chunk", "checkpoint_write"):
+    assert need in names, f"span {need!r} missing from {sorted(names)}"
+# flow arrows connect client -> worker -> batch across tracks
+flows = [e for e in evs if e.get("cat") == "flow"]
+assert flows and {f["ph"] for f in flows} >= {"s", "f"}, flows
+by_name_pid = {}
+for e in spans:
+    by_name_pid.setdefault(e["name"], set()).add(e["pid"])
+assert by_name_pid["submit"] != by_name_pid["serve:job"], \
+    "client and daemon spans must live on different tracks"
+# the elastic ranks joined the SAME trace (env handoff): their chunk
+# commits render on their own tracks in this one file
+assert by_name_pid["chunk"] - by_name_pid["serve:job"], \
+    "rank-side chunk spans must appear on rank tracks"
+meta_names = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+assert any(n.startswith("el.jsonl.part") for n in meta_names), \
+    f"no elastic rank shard contributed to the trace: {meta_names}"
+# every job_done's trace_id resolves through the merger
+from specpride_tpu.observability import traceplane
+from specpride_tpu.observability.journal import expand_parts, read_events
+serve_files, _ = expand_parts(os.path.join(tmp, "serve.jsonl"))
+assert len(serve_files) > 1, "the rotating daemon journal never rotated"
+done = [e for f in serve_files for e in read_events(f)[0]
+        if e["event"] == "job_done"]
+assert len(done) == 6, [e.get("job_id") for e in done]
+for e in done:
+    tid = traceplane.resolve_job_trace(serve_files, e["job_id"])
+    assert tid == e["trace_id"], (e["job_id"], tid)
+# exemplars on the drain snapshot: strict validator + presence
+from specpride_tpu.observability.exporter import parse_exposition_full
+text = open(os.path.join(tmp, "serve.prom")).read()
+samples, exemplars, problems = parse_exposition_full(text)
+assert not problems, problems
+ex_names = {name for name, _ in exemplars}
+assert any(n.startswith("specpride_serve_job_wall_seconds_bucket")
+           for n in ex_names), ex_names
+assert all("trace_id" in ex for ex in exemplars.values()), exemplars
+print(f"distributed trace OK: {len(spans)} spans on {len(pids)} "
+      f"tracks, {len(flows)} flow events, 6/6 job traces resolvable, "
+      f"{len(serve_files)} journal segments, exemplars strict-valid")
+EOF
+# the critical-path view renders off the same shards
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$dt_tmp/serve.jsonl" $dt_tmp/client_*.jsonl \
+    --trace "$DT_TRACE" | grep -q "critical path"
+# elastic byte parity under tracing: merged output == the plain run
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    merge-parts "$dt_tmp/el.mgf" --elastic "$dt_tmp/coord"
+cmp "$dt_tmp/plain.mgf" "$dt_tmp/el.mgf"
+rm -rf "$dt_tmp"
+
 echo "== memory bandwidth: --precision byte ratios + QC gate + --no-donate parity =="
 # per method: the bf16 run must exit 0 with the QC-cosine gate green
 # (run_end.precision.ok) and journaled h2d_bytes <= 0.55x its f32 run's;

@@ -317,9 +317,12 @@ def parse_event_table(text: str) -> dict[str, dict]:
     """The docs event table: the markdown table whose header row's
     first cell is ``event``, rows ``| `name` | payload | meaning |``.
 
-    Returns ``{event: {"required": set, "line": n}}``.  Required fields
-    are the backticked names in the payload cell BEFORE any ``plus``
-    marker — the documented convention for optional/additive fields."""
+    Returns ``{event: {"required": set, "mentioned": set, "line": n}}``.
+    Required fields are the backticked names in the payload cell BEFORE
+    any ``plus`` marker — the documented convention for optional/
+    additive fields; ``mentioned`` is every backticked name in the cell
+    (the journal-schema checker holds the v4 trace-envelope fields to
+    mentioned-at-least, required-or-optional)."""
     out: dict[str, dict] = {}
     in_table = False
     for lineno, line in enumerate(text.splitlines(), 1):
@@ -346,5 +349,9 @@ def parse_event_table(text: str) -> dict[str, dict]:
         # optional/additive fields are documented after a "(plus ...)"
         required_part = re.split(r"\(?\bplus\b", payload, maxsplit=1)[0]
         required = set(_CODE_SPAN_RE.findall(required_part))
-        out[event] = {"required": required, "line": lineno}
+        out[event] = {
+            "required": required,
+            "mentioned": set(_CODE_SPAN_RE.findall(payload)),
+            "line": lineno,
+        }
     return out

@@ -19,6 +19,14 @@ carry every required field (when the kwargs are statically visible and
 no ``**`` passthrough hides them); schema events are documented with
 exactly the schema's required payload; documented events exist in the
 schema; renderer literals name real events.
+
+The v4 trace-context envelope self-enforces through the same anchors:
+``TRACE_EVENT_FIELDS`` (same module as ``EVENT_FIELDS``) names the
+events that must carry their causal fields (``trace_id`` on the serving
+job events, ``trace_ids`` on ``batch_dispatch``) — every statically
+visible emit site must pass them, and the docs event-table row must at
+least mention each one (required or behind the ``plus`` marker), so a
+new emit site cannot silently ship an untraceable event.
 """
 
 from __future__ import annotations
@@ -48,6 +56,19 @@ def _event_fields(project: Project):
     if table is None:
         return None
     return mod, {k: v for k, v in table.items() if v is not None}, line
+
+
+def _trace_event_fields(project: Project) -> dict[str, set]:
+    """The v4 trace-envelope table (``TRACE_EVENT_FIELDS``), or empty
+    when the project doesn't declare one (pre-v4 fixture trees)."""
+    hit = project.one_constant("TRACE_EVENT_FIELDS")
+    if hit is None:
+        return {}
+    _mod, node, _line = hit
+    table = dict_of_str_sets(node)
+    if table is None:
+        return {}
+    return {k: v for k, v in table.items() if v is not None}
 
 
 def _emit_sites(project: Project):
@@ -102,9 +123,10 @@ def run(project: Project) -> list[Finding]:
     if anchor is None:
         return []
     schema_mod, schema, schema_line = anchor
+    trace_fields = _trace_event_fields(project)
     findings: list[Finding] = []
 
-    # 1. emit sites vs schema
+    # 1. emit sites vs schema (incl. the v4 trace envelope)
     for mod, node, event, kwargs, passthrough in _emit_sites(project):
         if event not in schema:
             findings.append(Finding(
@@ -125,6 +147,20 @@ def run(project: Project) -> list[Finding]:
                 message=(
                     f"emit of `{event}` is missing required fields "
                     f"{missing} (EVENT_FIELDS)"
+                ),
+            ))
+        missing_trace = sorted(
+            trace_fields.get(event, set()) - kwargs
+        )
+        if missing_trace:
+            findings.append(Finding(
+                check=CHECK, path=mod.rel, line=node.lineno,
+                symbol=f"emit:{event}:trace",
+                message=(
+                    f"emit of `{event}` is missing the v4 trace-"
+                    f"envelope fields {missing_trace} "
+                    f"(TRACE_EVENT_FIELDS) — an untraceable serving "
+                    f"event breaks the cross-process causal join"
                 ),
             ))
 
@@ -172,6 +208,25 @@ def run(project: Project) -> list[Finding]:
                         message=(
                             f"{_DOC} documents event `{event}` which "
                             f"is not in EVENT_FIELDS"
+                        ),
+                    ))
+            # v4 trace envelope: the documented row must at least
+            # MENTION each causal field (required, or optional behind
+            # the `plus` marker — they are version-gated, so either
+            # placement is legitimate; silence is not)
+            for event, fields in sorted(trace_fields.items()):
+                row = table.get(event)
+                if row is None:
+                    continue  # the missing-row finding above covers it
+                absent = sorted(fields - row.get("mentioned", set()))
+                if absent:
+                    findings.append(Finding(
+                        check=CHECK, path=_DOC, line=row["line"],
+                        symbol=f"doc:{event}:trace",
+                        message=(
+                            f"{_DOC} row for `{event}` does not "
+                            f"mention the v4 trace-envelope fields "
+                            f"{absent} (TRACE_EVENT_FIELDS)"
                         ),
                     ))
 

@@ -39,11 +39,13 @@ from specpride_tpu.observability import (
     MetricsRegistry,
     NullJournal,
     RunStats,
+    TraceContext,
     Tracer,
     configure_logging,
     device_counters_snapshot,
     device_summary,
     device_trace,
+    emit_clock_anchor,
     export_run_metrics,
     logger,
     open_journal,
@@ -2473,12 +2475,27 @@ def _open_run_journal(args, backend, command: str, n_clusters: int):
         # accounting: its padding gauges come from the same counters
         if getattr(args, "metrics_out", None):
             backend.pack_accounting = True
+    # the v4 causal envelope: adopt the context a parent hop handed us
+    # (the serving daemon via args, a fleet supervisor via the
+    # SPECPRIDE_TRACE env) or mint a fresh trace — either way every
+    # event this journal emits carries the trace_id, and the clock
+    # anchor ties this process's mono axis to the wall clock FIRST so
+    # the trace merger can place everything that follows
+    ctx = getattr(args, "_trace_ctx", None) or TraceContext.from_env()
+    if ctx is None:
+        ctx = TraceContext.mint()
+    args._trace_ctx = ctx
+    journal.bind_trace(ctx.trace_id)
     journal.emit(
         "run_start", command=command,
         method=getattr(args, "method", command),
         backend=getattr(args, "backend", "numpy"),
         n_clusters=int(n_clusters), output=args.output,
     )
+    if journal.enabled:
+        # directly after run_start so the anchor lands in THIS run's
+        # segment (the merger fits clocks per run_start segment)
+        emit_clock_anchor(journal)
     if hasattr(backend, "journal"):
         # device runs: record how the persistent compilation cache
         # resolved (dir, or why it stayed off) and snapshot the
@@ -2540,11 +2557,16 @@ def _open_run_journal(args, backend, command: str, n_clusters: int):
             # _install_tracer_early already traced the parse phase: its
             # buffered spans replay into the journal here (after
             # run_start, so journal consumers see a well-ordered run;
-            # each keeps its original `mono`, so the timeline is exact)
-            tracing.current().attach_journal(journal, keep=bool(chrome))
+            # each keeps its original `mono`, so the timeline is exact).
+            # The trace context lands now (the journal did not exist at
+            # install time): parse-phase spans predate it and carry no
+            # span ids, every span from here on does.
+            tracer = tracing.current()
+            tracer.ctx = ctx
+            tracer.attach_journal(journal, keep=bool(chrome))
         else:
             args._prev_tracer = _set_run_tracer(
-                args, Tracer(journal=journal, keep=bool(chrome))
+                args, Tracer(journal=journal, keep=bool(chrome), ctx=ctx)
             )
     return journal
 
@@ -2821,6 +2843,18 @@ def _run_elastic(
     range_size = int(getattr(args, "elastic_range", 0) or 0)
     if range_size <= 0:
         range_size = 2 * max(int(getattr(args, "checkpoint_every", 512)), 1)
+    # ONE trace for the whole elastic run: a fleet-spawned rank adopts
+    # the supervisor's context (SPECPRIDE_TRACE, resolved inside
+    # _open_run_journal), a late joiner adopts the trace the plan
+    # creator registered in the coordinator record, and only the first
+    # rank of an unsupervised run mints — so every rank's journal
+    # carries the SAME trace_id and `specpride trace` merges them
+    if getattr(args, "_trace_ctx", None) is None \
+            and TraceContext.from_env() is None:
+        plan = Coordinator.read_plan(root)
+        args._trace_ctx = TraceContext.from_env(
+            (plan or {}).get("trace")
+        )
     journal = _open_run_journal(args, backend, command, len(clusters))
     if quarantine is not None:
         quarantine.bind(journal)
@@ -2835,6 +2869,7 @@ def _run_elastic(
         local_dir=local_dir,
         steal=getattr(args, "elastic_steal", "on") != "off",
         chunk_hint=max(int(getattr(args, "checkpoint_every", 512)), 1),
+        trace=args._trace_ctx.to_env(),
     )
     logger.info(
         "elastic rank %d: %d ranges of <=%d clusters via %s "
@@ -2860,6 +2895,7 @@ def _run_elastic(
             telemetry.exposition,
             host=getattr(args, "metrics_host", "127.0.0.1"),
             port=args.metrics_port,
+            health=telemetry.health,
         ).start()
         logger.info("elastic liveness metrics -> %s", exporter.url)
     # ONE harness for the whole rank lifetime: fault-plan visit counters
@@ -3153,6 +3189,7 @@ def cmd_serve(args) -> int:
         warmup_jobs=args.warmup_jobs,
         watchdog_timeout=args.watchdog_timeout,
         journal_path=args.journal,
+        journal_rotate_mb=args.journal_rotate_mb,
         metrics_port=args.metrics_port,
         metrics_host=args.metrics_host,
         metrics_out=args.metrics_out,
@@ -3223,13 +3260,19 @@ def cmd_submit(args) -> int:
     policy = RetryPolicy(
         retries=retries, backoff=getattr(args, "retry_backoff", 0.5),
     )
+    # ONE trace across every resubmit attempt: the retries are hops of
+    # the same logical request, and the client journal (--journal)
+    # shows them as sibling submit spans under one trace_id
+    ctx = TraceContext.from_env() or TraceContext.mint()
 
     def _attempt() -> int:
         last = None
         try:
             for msg in serve_client.submit(args.socket, job,
                                            timeout=args.timeout,
-                                           client=args.client):
+                                           client=args.client,
+                                           journal=args.journal,
+                                           trace=ctx):
                 print(json.dumps(msg), flush=True)
                 last = msg
         except (OSError, serve_client.ServeError) as e:
@@ -3280,7 +3323,17 @@ def cmd_fleet(args) -> int:
             "fleet --ranks 2 -- consensus in.mgf out.mgf --method "
             "bin-mean --elastic /shared/coord"
         )
+    # ONE trace for the whole fleet: the supervisor mints (or inherits)
+    # the context and hands it to every spawned rank via the
+    # SPECPRIDE_TRACE env, so all rank journals + the fleet journal
+    # carry the same trace_id and merge onto one causal timeline
+    ctx = TraceContext.from_env() or TraceContext.mint()
+    env = dict(os.environ)
+    env[tracing.TRACE_ENV] = ctx.to_env()
     journal = open_journal(args.journal)
+    journal.bind_trace(ctx.trace_id)
+    if journal.enabled:
+        emit_clock_anchor(journal)
     try:
         try:
             sup = FleetSupervisor(
@@ -3288,6 +3341,7 @@ def cmd_fleet(args) -> int:
                 max_ranks=args.max_ranks, journal=journal,
                 poll_interval=args.poll,
                 scale_horizon=args.scale_horizon,
+                env=env,
             )
         except ValueError as e:
             raise SystemExit(str(e))
@@ -3334,6 +3388,17 @@ def cmd_cas_server(args) -> int:
 def cmd_stats(args) -> int:
     from specpride_tpu.observability.stats_cli import follow_stats, run_stats
 
+    if getattr(args, "trace", None):
+        # the critical-path view of ONE causal trace across the given
+        # shards: which hop (client wait, daemon queue, batch, kernel)
+        # to shorten first
+        from specpride_tpu.observability import traceplane
+
+        view = traceplane.extract_trace(args.journals, args.trace)
+        for w in view.warnings:
+            print(f"warning: {w}", file=sys.stderr)
+        traceplane.render_critical_path(view, sys.stdout)
+        return 0 if view.spans else 1
     if getattr(args, "follow", False):
         if len(args.journals) != 1:
             raise SystemExit("--follow tails exactly one journal")
@@ -3351,9 +3416,21 @@ def cmd_trace(args) -> int:
     """Reconstruct a Chrome trace from one or more run journals, merging
     multi-host ``.part<rank>`` shards onto a single timeline (pid = rank).
     A post-mortem tool: schema violations (e.g. the torn final line of a
-    killed run) are reported on stderr and dropped, never fatal."""
+    killed run) are reported on stderr and dropped, never fatal.
+
+    ``--trace-id ID`` (or ``--job JOBID``, resolved through the serving
+    events) switches to the CAUSAL mode: extract exactly one trace's
+    spans from all the shards, align every process's monotonic timeline
+    onto one wall axis via the journaled clock anchors (bounded skew),
+    and emit flow arrows across process tracks — client submit ->
+    daemon queue/job -> shared batch -> job pipeline / elastic ranks on
+    ONE timeline."""
     from specpride_tpu.observability.tracing import build_chrome_trace
 
+    if getattr(args, "job", None) is not None or getattr(
+        args, "trace_id", None
+    ):
+        return _cmd_trace_causal(args)
     n_spans, n_files, warnings, violations = build_chrome_trace(
         args.journals, args.out
     )
@@ -3374,6 +3451,49 @@ def cmd_trace(args) -> int:
         )
         return 1
     print(f"{n_spans} spans -> {args.out}", file=sys.stderr)
+    return 0
+
+
+def _cmd_trace_causal(args) -> int:
+    """``specpride trace --job JOBID | --trace-id ID``: one causal
+    timeline across journal shards (see cmd_trace)."""
+    from specpride_tpu.observability import traceplane
+    from specpride_tpu.observability.journal import expand_parts
+
+    trace_id = getattr(args, "trace_id", None)
+    if trace_id is None:
+        files: list[str] = []
+        for p in args.journals:
+            got, warn = expand_parts(p)
+            files.extend(got)
+            for w in warn:
+                print(f"warning: {w}", file=sys.stderr)
+        trace_id = traceplane.resolve_job_trace(files, args.job)
+        if trace_id is None:
+            print(
+                f"no trace_id found for job {args.job} in the given "
+                "journals (is the daemon journal among them, and does "
+                "it predate schema v4?)", file=sys.stderr,
+            )
+            return 1
+    view = traceplane.build_trace_chrome(
+        args.journals, trace_id, args.out
+    )
+    for w in view.warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    for v in view.violations:
+        print(f"dropped: {v}", file=sys.stderr)
+    if not view.spans and not view.instants:
+        print(
+            f"trace {trace_id}: no matching events in the given "
+            "journals", file=sys.stderr,
+        )
+        return 1
+    print(
+        f"trace {trace_id}: {len(view.spans)} spans across "
+        f"{len(view.shards)} process track(s), clock-skew bound "
+        f"{view.skew_bound_s:.4f}s -> {args.out}", file=sys.stderr,
+    )
     return 0
 
 
@@ -3921,6 +4041,14 @@ def build_parser() -> argparse.ArgumentParser:
         "watch live with `specpride stats --follow`",
     )
     psv.add_argument(
+        "--journal-rotate-mb", type=float, default=0.0, metavar="N",
+        help="rotate the live --journal into numbered segments "
+        "(<journal>.1, .2, ...) once it exceeds N megabytes, so a "
+        "days-long daemon journal stays bounded; `specpride stats` "
+        "(incl. --follow) and the `specpride trace` merger read across "
+        "segment boundaries (default 0 = never rotate)",
+    )
+    psv.add_argument(
         "--metrics-port", type=int, default=None, metavar="PORT",
         help="serve a live Prometheus /metrics endpoint on this port "
         "(0 = ephemeral, read the bound port from the serve_start "
@@ -4015,6 +4143,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--retry-backoff", type=float, default=0.5, metavar="S",
         help="base backoff before the first resubmit; doubles per "
         "attempt with deterministic jitter (default 0.5)",
+    )
+    psb.add_argument(
+        "--journal", metavar="FILE",
+        help="write the CLIENT-side journal shard for this submit: a "
+        "clock anchor plus submit/submit:admit/submit:wait spans under "
+        "the job's trace_id — `specpride trace --job` merges it with "
+        "the daemon and job journals into one causal timeline",
     )
     psb.add_argument(
         "job", nargs=argparse.REMAINDER,
@@ -4124,6 +4259,13 @@ def build_parser() -> argparse.ArgumentParser:
         "breaches, burn) from a serving daemon's job_done events — "
         "works with --follow for a live view",
     )
+    pst.add_argument(
+        "--trace", metavar="HEX32", default=None,
+        help="render the CRITICAL PATH of one causal trace (by "
+        "trace_id) across the given journal shards: per-hop exclusive "
+        "seconds from client submit through daemon queue/dispatch, "
+        "shared batch, and pipeline spans, on one clock-anchored axis",
+    )
     pst.set_defaults(fn=cmd_stats)
 
     pt = sub.add_parser(
@@ -4139,6 +4281,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pt.add_argument("-o", "--out", default="trace.json",
                     help="trace-event JSON output path (default trace.json)")
+    pt.add_argument(
+        "--job", type=int, default=None, metavar="JOBID",
+        help="causal mode: reconstruct the ONE trace of this served "
+        "job (resolved via the daemon journal's job events) — spans "
+        "from every given shard align on one wall axis via clock "
+        "anchors, with flow arrows across process tracks",
+    )
+    pt.add_argument(
+        "--trace-id", default=None, metavar="HEX32",
+        help="causal mode with an explicit trace id (e.g. harvested "
+        "from a /metrics exemplar or a journal event)",
+    )
     pt.set_defaults(fn=cmd_trace)
 
     pl = sub.add_parser(

@@ -29,15 +29,19 @@ Four pieces:
 from specpride_tpu.observability.journal import (
     EVENT_FIELDS,
     SCHEMA_VERSION,
+    TRACE_EVENT_FIELDS,
     Journal,
     NullJournal,
+    emit_clock_anchor,
     expand_parts,
+    expand_segments,
     open_journal,
     read_events,
     validate_event,
 )
 from specpride_tpu.observability.tracing import (
     NullTracer,
+    TraceContext,
     Tracer,
     build_chrome_trace,
 )
@@ -57,18 +61,22 @@ from specpride_tpu.observability.stats import (
 __all__ = [
     "EVENT_FIELDS",
     "SCHEMA_VERSION",
+    "TRACE_EVENT_FIELDS",
     "Journal",
     "MetricsRegistry",
     "NullJournal",
     "NullTracer",
     "RunStats",
+    "TraceContext",
     "Tracer",
     "build_chrome_trace",
     "configure_logging",
     "device_counters_snapshot",
     "device_summary",
     "device_trace",
+    "emit_clock_anchor",
     "expand_parts",
+    "expand_segments",
     "export_run_metrics",
     "logger",
     "open_journal",

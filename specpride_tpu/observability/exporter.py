@@ -310,18 +310,24 @@ class ServeTelemetry:
     def job_done(
         self, *, command: str, method: str | None, status: str,
         wall_s: float, queue_wait_s: float, summary: dict | None = None,
-        worker: int | None = None,
+        worker: int | None = None, trace_id: str | None = None,
     ) -> dict:
         """Fold one finished job in; returns the SLO fields (empty when
         no objective covers the method) for the daemon to journal on its
-        ``job_done`` event."""
+        ``job_done`` event.  ``trace_id`` rides the wall/queue-wait
+        histogram observations as an OpenMetrics exemplar, so a latency
+        outlier on ``/metrics`` is one ``specpride trace --trace-id``
+        away from its full cross-process timeline."""
         m = method or "-"
+        exemplar = {"trace_id": trace_id} if trace_id else None
         if status == "done":
             self.jobs_done.inc(1, command=command, method=m)
         else:
             self.jobs_failed.inc(1, command=command, method=m)
-        self.job_wall.observe(wall_s, method=m)
-        self.job_queue_wait.observe(queue_wait_s, method=m)
+        self.job_wall.observe(wall_s, exemplar=exemplar, method=m)
+        self.job_queue_wait.observe(
+            queue_wait_s, exemplar=exemplar, method=m
+        )
         if worker is not None:
             self.worker_busy.inc(max(float(wall_s), 0.0),
                                  worker=str(worker))
@@ -527,6 +533,31 @@ class ElasticTelemetry:
             "split-off tails THIS rank claimed from slower live peers",
         )
 
+    def health(self) -> tuple[bool, str]:
+        """Readiness for ``GET /healthz`` on an elastic rank: degraded
+        while a PEER's heartbeat has gone stale past TTL + grace with
+        uncommitted work remaining (the fleet supervisor's scale-up
+        signal, now visible to load balancers too).  A peer that
+        STOPPED cleanly (the final ``stopped`` heartbeat — a retired
+        spare, a rank out of claimable work) is not stale, however old
+        its last beat: degrading every survivor over a healthy exit
+        would have load balancers pulling good ranks."""
+        coord = self.coord
+        threshold = coord.ttl + getattr(coord, "grace", 0.0)
+        stale = sorted(
+            r
+            for r, (age, stopped) in coord.rank_heartbeat_states().items()
+            if age > threshold and not stopped
+        )
+        done, total = coord.done_count(), len(coord.ranges)
+        bits = [f"rank={coord.rank}", f"ranges_committed={done}/{total}"]
+        if stale and done < total:
+            return False, (
+                "stale_ranks=" + ",".join(str(r) for r in stale)
+                + " " + " ".join(bits)
+            )
+        return True, " ".join(bits)
+
     def exposition(self) -> str:
         with self._render_lock:
             coord = self.coord
@@ -572,16 +603,25 @@ class MetricsExporter:
 
     Binds ``host:port`` (port 0 = ephemeral; read the bound port back
     from ``.port``), serves ``GET /metrics`` with the Prometheus text
-    content type and ``GET /healthz`` with a one-line liveness body, on
+    content type and ``GET /healthz`` with a one-line readiness body, on
     a daemon thread pool (``ThreadingHTTPServer``) so a slow scraper
     never blocks the next one.  Loopback by default: the telemetry
     plane is an OPERATOR surface, exposing it beyond the host is an
-    explicit ``--metrics-host`` decision."""
+    explicit ``--metrics-host`` decision.
+
+    ``health`` (optional): a callback returning ``(ok, detail)`` —
+    ``/healthz`` answers ``200 ok <detail>`` or ``503 degraded
+    <detail>``, so a fleet supervisor or load balancer gets a REAL
+    per-lane readiness signal (the serving daemon wires its watchdog's
+    stalled-lane view in; without a callback the endpoint stays the
+    old unconditional liveness 200)."""
 
     CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
-    def __init__(self, render, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, render, host: str = "127.0.0.1", port: int = 0,
+                 health=None):
         self._render = render
+        self._health = health
         self.host = host
         self._requested_port = port
         self._httpd: http.server.ThreadingHTTPServer | None = None
@@ -599,6 +639,7 @@ class MetricsExporter:
 
     def start(self) -> "MetricsExporter":
         render = self._render
+        health = self._health
         content_type = self.CONTENT_TYPE
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -606,11 +647,12 @@ class MetricsExporter:
             def log_message(self, fmt, *args):  # noqa: A002 - stdlib sig
                 pass
 
-            def _reply(self, body: bytes, ctype: str) -> None:
+            def _reply(self, body: bytes, ctype: str,
+                       code: int = 200) -> None:
                 # a scraper with a short timeout may drop the connection
                 # mid-body: that's its problem, not a stderr traceback
                 try:
-                    self.send_response(200)
+                    self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
@@ -629,7 +671,22 @@ class MetricsExporter:
                         return
                     self._reply(body, content_type)
                 elif path == "/healthz":
-                    self._reply(b"ok\n", "text/plain")
+                    if health is None:
+                        self._reply(b"ok\n", "text/plain")
+                        return
+                    try:
+                        ok, detail = health()
+                    except Exception as e:  # noqa: BLE001 - report, not crash
+                        ok, detail = False, f"health probe failed: {e}"
+                    body = (
+                        ("ok" if ok else "degraded")
+                        + (f" {detail}" if detail else "") + "\n"
+                    ).encode("utf-8")
+                    # 503 on degraded: the readiness semantics load
+                    # balancers and fleet supervisors key off
+                    self._reply(
+                        body, "text/plain", code=200 if ok else 503
+                    )
                 else:
                     self.send_error(404, "only /metrics and /healthz")
 
@@ -671,7 +728,35 @@ _SAMPLE_RE = re.compile(
 _LABEL_RE = re.compile(
     r'(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"'
 )
+# OpenMetrics exemplar (after the ` # ` split): {labels} value [ts]
+_EXEMPLAR_RE = re.compile(
+    r"^\{(?P<labels>.*)\} (?P<value>\S+)(?: (?P<ts>-?[\d.]+))?$"
+)
 _TYPES = frozenset({"counter", "gauge", "histogram", "summary", "untyped"})
+
+
+def _split_exemplar(line: str) -> tuple[str, str | None]:
+    """Split a sample line at its OpenMetrics exemplar marker (`` # ``)
+    — but only OUTSIDE quoted label values: a client id like
+    ``team # 1`` is a legal label value and must stay part of the
+    sample (label values are user-controlled; a naive split would
+    reject previously-valid exposition)."""
+    in_quotes = False
+    i = 0
+    n = len(line)
+    while i < n:
+        c = line[i]
+        if in_quotes:
+            if c == "\\":
+                i += 1  # skip the escaped char
+            elif c == '"':
+                in_quotes = False
+        elif c == '"':
+            in_quotes = True
+        elif c == " " and line[i:i + 3] == " # ":
+            return line[:i], line[i + 3:]
+        i += 1
+    return line, None
 
 
 def _parse_value(tok: str) -> float | None:
@@ -712,17 +797,32 @@ def _parse_labels(raw: str, problems: list, lineno: int) -> tuple | None:
 
 
 def parse_exposition(text: str) -> tuple[dict, list[str]]:
+    """Strictly parse a Prometheus text exposition (see
+    :func:`parse_exposition_full`; this keeps the original two-value
+    signature for callers that don't read exemplars)."""
+    samples, _exemplars, problems = parse_exposition_full(text)
+    return samples, problems
+
+
+def parse_exposition_full(
+    text: str,
+) -> tuple[dict, dict, list[str]]:
     """Strictly parse a Prometheus text exposition.
 
-    Returns ``(samples, problems)`` — ``samples`` maps ``(metric_name,
-    ((label, value), ...))`` to the float value.  ``problems`` is empty
-    for a conforming exposition; the checks cover what a real scraper
+    Returns ``(samples, exemplars, problems)`` — ``samples`` maps
+    ``(metric_name, ((label, value), ...))`` to the float value;
+    ``exemplars`` maps the same keys to ``{label: value}`` dicts for
+    every ``_bucket`` line carrying an OpenMetrics exemplar suffix
+    (`` # {trace_id="..."} <value>``).  ``problems`` is empty for a
+    conforming exposition; the checks cover what a real scraper
     enforces plus the histogram invariants: TYPE before (and at most
     once per) metric, valid metric/label names, parseable values, no
     duplicate series, cumulative non-decreasing ``_bucket`` counts with
-    a ``+Inf`` bucket equal to ``_count``, and a trailing newline."""
+    a ``+Inf`` bucket equal to ``_count``, exemplars only on bucket
+    lines with well-formed labels and values, and a trailing newline."""
     problems: list[str] = []
     samples: dict[tuple, float] = {}
+    exemplars: dict[tuple, dict] = {}
     typed: dict[str, str] = {}
     seen_sample_of: set[str] = set()
     if text and not text.endswith("\n"):
@@ -757,7 +857,10 @@ def parse_exposition(text: str) -> tuple[dict, list[str]]:
                     typed[name] = mtype
             # other comments are allowed and ignored
             continue
-        m = _SAMPLE_RE.match(line)
+        # OpenMetrics exemplar suffix: split it off before the sample
+        # grammar match, validate it separately (bucket lines only)
+        sample_part, exemplar_raw = _split_exemplar(line)
+        m = _SAMPLE_RE.match(sample_part)
         if m is None:
             problems.append(f"line {lineno}: unparseable sample {line!r}")
             continue
@@ -778,6 +881,32 @@ def parse_exposition(text: str) -> tuple[dict, list[str]]:
         if key in samples:
             problems.append(f"line {lineno}: duplicate series {key}")
         samples[key] = value
+        if exemplar_raw is not None:
+            if not name.endswith("_bucket"):
+                problems.append(
+                    f"line {lineno}: exemplar on a non-bucket sample "
+                    f"{name}"
+                )
+                continue
+            em = _EXEMPLAR_RE.fullmatch(exemplar_raw.strip())
+            if em is None:
+                problems.append(
+                    f"line {lineno}: malformed exemplar "
+                    f"{exemplar_raw!r}"
+                )
+                continue
+            ex_labels = _parse_labels(
+                em.group("labels") or "", problems, lineno
+            )
+            if ex_labels is None:
+                continue
+            if _parse_value(em.group("value")) is None:
+                problems.append(
+                    f"line {lineno}: bad exemplar value "
+                    f"{em.group('value')!r}"
+                )
+                continue
+            exemplars[key] = dict(ex_labels)
     # histogram invariants per (base name, non-le label set)
     for name, mtype in typed.items():
         if mtype != "histogram":
@@ -813,7 +942,7 @@ def parse_exposition(text: str) -> tuple[dict, list[str]]:
                 )
             if rest not in counts:
                 problems.append(f"{name}{dict(rest)}: missing _count")
-    return samples, problems
+    return samples, exemplars, problems
 
 
 def validate_exposition(text: str) -> list[str]:

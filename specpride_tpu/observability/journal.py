@@ -2,19 +2,30 @@
 post-mortems.
 
 Every event is one JSON object per line with four envelope fields —
-``v`` (schema version, currently 2), ``ts`` (unix seconds), ``mono``
+``v`` (schema version, currently 4), ``ts`` (unix seconds), ``mono``
 (``time.perf_counter()`` seconds: monotonic, so interval reconstruction
 — span timelines, event spacing — is immune to wall-clock jumps; only
-comparable within one process run, anchored to ``ts`` at ``run_start``),
-``event`` (type name) — plus the per-type payload listed in
-``EVENT_FIELDS``.  v1 journals (no ``mono``) still read and validate.
-An operator can ``tail -f`` a live run's journal (every line is flushed
-as it is written) or feed one or more finished/dead journals to
-``specpride stats`` for an aggregate post-mortem.
+comparable within one process run, anchored to the wall clock by the
+``clock_anchor`` events), ``event`` (type name) — plus the per-type
+payload listed in ``EVENT_FIELDS``.  v4 adds the **trace-context
+envelope**: a journal bound to a trace (``bind_trace``) stamps
+``trace_id`` (32-hex) on every event it emits, the serving daemon's
+per-job events carry it explicitly (``TRACE_EVENT_FIELDS``), and
+``span`` events gain ``span_id``/``parent_span_id`` so one causal tree
+spans processes.  v1–v3 journals (no ``mono`` / no trace fields) still
+read and validate.  An operator can ``tail -f`` a live run's journal
+(every line is flushed as it is written) or feed one or more
+finished/dead journals to ``specpride stats`` for an aggregate
+post-mortem.
 
 Multi-host runs write one journal per rank (``<journal>.part<id>``, the
 same naming as output shards); ``expand_parts`` resolves a base path to
 its rank-ordered part list the way ``merge-parts`` does for outputs.
+Long-lived daemons rotate their live journal at a size bound
+(``--journal-rotate-mb``) into numbered segments (``<journal>.1``,
+``.2``, ...; the un-suffixed path is always the live tail);
+``expand_parts`` resolves those too, oldest first, so ``stats``/
+``trace`` read across segment boundaries transparently.
 """
 
 from __future__ import annotations
@@ -22,14 +33,19 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import threading
 import time
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 4
 
 # versions read_events accepts: v2 added the monotonic `mono` envelope
-# field and the `span` event; v1 events remain valid (additive change)
-ACCEPTED_VERSIONS = frozenset({1, SCHEMA_VERSION})
+# field and the `span` event; v4 added the trace-context envelope
+# (trace_id / span ids) and the `clock_anchor` event.  v3 is reserved —
+# the live-telemetry-plane revision was docs-only, with no envelope
+# change, and the journal version skips it to keep the wire and docs
+# version numbers aligned; a v3 journal reads exactly like v2.
+ACCEPTED_VERSIONS = frozenset({1, 2, 3, SCHEMA_VERSION})
 
 # event type -> required payload fields (the envelope v/ts/mono/event is
 # implied; extra fields are allowed — the schema is additive within a
@@ -97,6 +113,14 @@ EVENT_FIELDS: dict[str, frozenset] = {
     # retired (excess capacity scaled down)
     "rank_spawn": frozenset({"pid"}),
     "rank_retire": frozenset({"pid", "reason"}),
+    # cross-process clock anchoring (v4): one high-precision wall<->mono
+    # pair — `wall` captured between two perf_counter reads, the
+    # envelope `mono` overridden to their midpoint, `uncertainty_s`
+    # half their distance — emitted at journal open and re-emitted on
+    # heartbeat cadence, so the trace merger can align per-process
+    # monotonic timelines onto ONE wall axis with a bounded skew
+    # (observability.traceplane.clock_anchor_fit)
+    "clock_anchor": frozenset({"wall", "uncertainty_s"}),
     # warm-start subsystem (specpride_tpu.warmstart): how the persistent
     # compilation cache resolved for this run (dir, or the reason it
     # stayed off) — post-mortems must be able to tell cached from cold
@@ -141,9 +165,29 @@ EVENT_FIELDS: dict[str, frozenset] = {
     "run_end": frozenset({"counters", "phases_s", "elapsed_s", "device"}),
     # v2: one finished tracing span (observability.tracing).  The span's
     # end time is the envelope `mono`; start = mono - dur_s.  Optional
-    # `labels` carries the per-span annotations (kernel, rows, ...).
+    # `labels` carries the per-span annotations (kernel, rows, ...);
+    # v4 adds `span_id`/`parent_span_id` when a trace context is
+    # installed, so the causal tree survives process boundaries.
     "span": frozenset({"name", "dur_s", "depth"}),
 }
+
+# v4 trace-context envelope: events that MUST carry their causal trace
+# fields from schema v4 on (older journals validate without them — the
+# requirement is version-gated in validate_event).  The serving
+# daemon's journal holds many concurrent traces, so its per-job events
+# name theirs explicitly; per-run journals stamp every event via
+# `Journal.bind_trace` instead.  `batch_dispatch` carries `trace_ids`
+# (plural): one shared dispatch serves members of SEVERAL traces.
+# `specpride lint` (journal-schema) enforces these at every emit site.
+TRACE_EVENT_FIELDS: dict[str, frozenset] = {
+    "job_queued": frozenset({"trace_id"}),
+    "job_start": frozenset({"trace_id"}),
+    "job_done": frozenset({"trace_id"}),
+    "batch_dispatch": frozenset({"trace_ids"}),
+}
+
+_TRACE_ID_RE = re.compile(r"[0-9a-f]{32}")
+_SPAN_ID_RE = re.compile(r"[0-9a-f]{16}")
 
 
 def _json_default(obj):
@@ -159,29 +203,50 @@ def _json_default(obj):
 class Journal:
     """Append-only JSONL event writer.  Line-buffered so each event hits
     the filesystem as one complete line — tailable mid-run, and a crash
-    loses at most the event being written."""
+    loses at most the event being written.
+
+    ``rotate_mb`` > 0 bounds the live file: once an emit pushes it past
+    the bound, the file is renamed to the next numbered segment
+    (``<path>.1``, ``.2``, ...) and a fresh live file opens — a
+    days-long daemon journal stays bounded, and readers
+    (``expand_parts`` / ``stats --follow``) walk the segments in order.
+
+    ``bind_trace(trace_id)`` stamps the v4 causal envelope: every
+    subsequent event carries ``trace_id`` unless the emit names its own
+    (one run journal = one trace; the multi-trace serving daemon leaves
+    its journal unbound and stamps per-job events explicitly)."""
 
     enabled = True
 
-    def __init__(self, path: str | os.PathLike):
+    def __init__(self, path: str | os.PathLike, rotate_mb: float = 0.0):
         self.path = str(path)
+        self.trace_id: str | None = None
+        self.rotate_bytes = int(max(float(rotate_mb), 0.0) * 1024 * 1024)
         # one journal is shared by the CLI thread, the pipelined executor's
         # packer thread, and the fetch pool; a lock keeps each event line
         # whole (TextIOWrapper gives no cross-thread write atomicity)
         self._lock = threading.Lock()
         self._fh = open(self.path, "a", buffering=1, encoding="utf-8")
+        self._bytes = 0
         # a kill mid-write leaves a torn final line with no newline; a
         # resumed run appending straight onto it would corrupt BOTH its
         # own run_start and the torn event — heal the seam first
         try:
             with open(self.path, "rb") as fh:
                 fh.seek(0, os.SEEK_END)
+                self._bytes = fh.tell()
                 if fh.tell() > 0:
                     fh.seek(-1, os.SEEK_END)
                     if fh.read(1) != b"\n":
                         self._fh.write("\n")
+                        self._bytes += 1
         except OSError:
             pass
+
+    def bind_trace(self, trace_id: str | None) -> None:
+        """Stamp ``trace_id`` on every event emitted from now on (the
+        per-run causal envelope; None unbinds)."""
+        self.trace_id = trace_id
 
     def emit(self, event: str, **fields) -> dict:
         rec = {
@@ -190,6 +255,8 @@ class Journal:
             "mono": time.perf_counter(),
             "event": event,
         }
+        if self.trace_id is not None and "trace_id" not in fields:
+            rec["trace_id"] = self.trace_id
         rec.update(fields)
         line = json.dumps(rec, default=_json_default) + "\n"
         with self._lock:
@@ -198,7 +265,45 @@ class Journal:
             # crashing the thread on a closed file
             if not self._fh.closed:
                 self._fh.write(line)
+                # json.dumps default ensure_ascii output is pure ASCII,
+                # so the character count IS the byte count — no second
+                # encode on the hot path
+                self._bytes += len(line)
+                if self.rotate_bytes and self._bytes >= self.rotate_bytes:
+                    self._rotate_locked()
         return rec
+
+    def _rotate_locked(self) -> None:
+        """Roll the live file over to the next numbered segment (caller
+        holds the lock).  Rename-then-reopen: an event line is never
+        split across segments, and a reader mid-tail finds the renamed
+        segment by number (`stats --follow` handles the swap)."""
+        self._fh.close()
+        n = 1
+        for num, _seg in _numbered_segments(self.path):
+            n = max(n, num + 1)
+        try:
+            os.replace(self.path, f"{self.path}.{n}")
+        except OSError:
+            # the rename failing (exotic filesystems) must not kill the
+            # run: keep appending to the oversized live file instead
+            pass
+        self._fh = open(self.path, "a", buffering=1, encoding="utf-8")
+        self._bytes = 0
+        # every segment is self-anchored: the merger fits clocks per
+        # file, so a fresh segment must not degrade to the coarse
+        # envelope fallback until the next cadence anchor arrives
+        # (written inline — emit() would re-enter the lock)
+        rec = {
+            "v": SCHEMA_VERSION, "event": "clock_anchor",
+            **_anchor_fields(),
+        }
+        rec["ts"] = rec["wall"]
+        if self.trace_id is not None:
+            rec["trace_id"] = self.trace_id
+        line = json.dumps(rec, default=_json_default) + "\n"
+        self._fh.write(line)
+        self._bytes += len(line)
 
     def close(self) -> None:
         with self._lock:
@@ -217,6 +322,10 @@ class NullJournal:
 
     enabled = False
     path = None
+    trace_id = None
+
+    def bind_trace(self, trace_id: str | None) -> None:
+        pass
 
     def emit(self, event: str, **fields) -> dict:
         return {}
@@ -231,8 +340,34 @@ class NullJournal:
         pass
 
 
-def open_journal(path: str | None) -> Journal | NullJournal:
-    return Journal(path) if path else NullJournal()
+def open_journal(
+    path: str | None, rotate_mb: float = 0.0
+) -> Journal | NullJournal:
+    return Journal(path, rotate_mb=rotate_mb) if path else NullJournal()
+
+
+def _anchor_fields() -> dict:
+    """One high-precision wall<->mono capture: ``wall`` read between two
+    ``perf_counter`` reads, ``mono`` their midpoint, ``uncertainty_s``
+    half the window — the ONE construction both ``emit_clock_anchor``
+    and the post-rotation inline write share."""
+    t0 = time.perf_counter()
+    wall = time.time()
+    t1 = time.perf_counter()
+    return {
+        "mono": (t0 + t1) / 2.0,
+        "wall": wall,
+        "uncertainty_s": round((t1 - t0) / 2.0, 9),
+    }
+
+
+def emit_clock_anchor(journal) -> dict:
+    """Journal one high-precision wall<->mono pair: ``wall`` is captured
+    between two ``perf_counter`` reads and the envelope ``mono``
+    overridden to their midpoint, so the pair's skew is bounded by
+    ``uncertainty_s`` (half the capture window) — the unit the trace
+    merger's clock fit sums into its alignment bound."""
+    return journal.emit("clock_anchor", **_anchor_fields())
 
 
 def validate_event(rec: object) -> list[str]:
@@ -255,6 +390,29 @@ def validate_event(rec: object) -> list[str]:
         missing = sorted(required - rec.keys())
         if missing:
             problems.append(f"{event}: missing fields {missing}")
+    # v4 trace-context envelope: the causal fields are REQUIRED on the
+    # serving events from v4 on (older journals validate without them),
+    # and syntactically checked wherever they appear — a malformed id
+    # would silently break every cross-process join downstream
+    if rec.get("v", 0) >= 4 and required is not None:
+        missing = sorted(
+            TRACE_EVENT_FIELDS.get(event, frozenset()) - rec.keys()
+        )
+        if missing:
+            problems.append(
+                f"{event}: missing v4 trace fields {missing}"
+            )
+    tid = rec.get("trace_id")
+    if tid is not None and not (
+        isinstance(tid, str) and _TRACE_ID_RE.fullmatch(tid)
+    ):
+        problems.append(f"malformed trace_id {tid!r} (need 32 hex chars)")
+    for key in ("span_id", "parent_span_id"):
+        sid = rec.get(key)
+        if sid is not None and not (
+            isinstance(sid, str) and _SPAN_ID_RE.fullmatch(sid)
+        ):
+            problems.append(f"malformed {key} {sid!r} (need 16 hex chars)")
     return problems
 
 
@@ -285,15 +443,43 @@ def read_events(path: str) -> tuple[list[dict], list[str]]:
     return events, violations
 
 
+def _numbered_segments(path: str) -> list[tuple[int, str]]:
+    """``(number, file)`` for every rotated segment of EXACTLY this
+    journal: the whole remainder past ``<path>.`` must be digits, so a
+    rank shard's rotated segment (``x.jsonl.part00000.1``) can never be
+    misread as a segment of the base ``x.jsonl``."""
+    out = []
+    prefix_len = len(path) + 1
+    for seg in glob.glob(glob.escape(path) + ".*"):
+        suffix = seg[prefix_len:]
+        if suffix.isdigit():
+            out.append((int(suffix), seg))
+    out.sort()
+    return out
+
+
+def expand_segments(path: str) -> list[str]:
+    """One journal's rotated segments plus the live file, oldest first:
+    ``<path>.1``, ``<path>.2``, ..., ``<path>`` — the read order that
+    reconstructs the stream a ``--journal-rotate-mb`` daemon rotated.
+    Paths that do not exist are simply absent (a never-rotated journal
+    returns just itself)."""
+    out = [p for _, p in _numbered_segments(path)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
 def expand_parts(path: str) -> tuple[list[str], list[str]]:
     """Resolve a journal path to its file list, rank-aware like
-    ``merge-parts``: the path itself if it exists, else its
-    ``<path>.part<id>`` shards ordered by parsed rank (NOT lexically).
-    Returns ``(paths, warnings)``; a gap in the rank sequence is a
-    warning, not an error — a post-mortem of a dead run must still read
-    the ranks that DID write."""
+    ``merge-parts``: the path itself (preceded by any rotated
+    ``<path>.<n>`` segments, oldest first) if it exists, else its
+    ``<path>.part<id>`` shards ordered by parsed rank (NOT lexically),
+    each with ITS segments.  Returns ``(paths, warnings)``; a gap in
+    the rank sequence is a warning, not an error — a post-mortem of a
+    dead run must still read the ranks that DID write."""
     if os.path.exists(path):
-        return [path], []
+        return expand_segments(path), []
     parts = glob.glob(glob.escape(path) + ".part*")
     if not parts:
         return [], [f"no journal at {path} and no {path}.part* shards"]
@@ -302,6 +488,8 @@ def expand_parts(path: str) -> tuple[list[str], list[str]]:
         suffix = p.rsplit(".part", 1)[1]
         if suffix.isdigit():
             ranked.append((int(suffix), p))
+        elif re.fullmatch(r"\d+\.\d+", suffix):
+            pass  # a part's rotated segment: expand_segments finds it
         else:
             warnings.append(f"unrecognized part name {p}")
     ranked.sort()
@@ -312,4 +500,7 @@ def expand_parts(path: str) -> tuple[list[str], list[str]]:
             f"{path}: rank gap — have {ranks}, missing {missing} "
             "(a rank died before writing its journal?)"
         )
-    return [p for _, p in ranked], warnings
+    out: list[str] = []
+    for _, p in ranked:
+        out.extend(expand_segments(p))
+    return out, warnings

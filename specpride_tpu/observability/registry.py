@@ -116,12 +116,17 @@ class Gauge(_Metric):
 
 
 class _HistState:
-    __slots__ = ("counts", "total", "n")
+    __slots__ = ("counts", "total", "n", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
         self.total = 0.0
         self.n = 0
+        # bucket index (len(buckets) = +Inf) -> (labels, value): the
+        # LAST exemplar observed into each bucket, rendered OpenMetrics-
+        # style after the bucket line — a latency outlier on /metrics
+        # names the trace_id that caused it
+        self.exemplars: dict[int, tuple[dict, float]] = {}
 
 
 class Histogram(_Metric):
@@ -129,18 +134,42 @@ class Histogram(_Metric):
         super().__init__(kind, name, help, label_names)
         self.buckets = tuple(sorted(buckets))
 
-    def observe(self, v: float, **labels) -> None:
+    def observe(
+        self, v: float, exemplar: dict | None = None, **labels
+    ) -> None:
+        """Fold one observation in.  ``exemplar`` (e.g.
+        ``{"trace_id": ...}``) attaches to the bucket the value lands
+        in, last-writer-wins — the OpenMetrics affordance that links a
+        histogram outlier back to its full distributed trace."""
         key = self._key(labels)
         with self._lock:
             st = self.samples.get(key)
             if st is None:
                 st = self.samples[key] = _HistState(len(self.buckets))
+            bucket = len(self.buckets)  # +Inf
             for i, le in enumerate(self.buckets):
                 if v <= le:
                     st.counts[i] += 1
+                    bucket = i
                     break
             st.total += v
             st.n += 1
+            if exemplar:
+                st.exemplars[bucket] = (dict(exemplar), float(v))
+
+
+def _fmt_exemplar(ex: "tuple[dict, float] | None") -> str:
+    """OpenMetrics exemplar suffix for a ``_bucket`` line (empty when
+    the bucket has none): `` # {trace_id="..."} <value>`` — the hook
+    that makes a latency outlier one ``specpride trace --trace-id``
+    away from its full cross-process timeline."""
+    if not ex:
+        return ""
+    labels, value = ex
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return f" # {{{inner}}} {_fmt(value)}"
 
 
 def _sample_lines(m: _Metric, extra: tuple = ()) -> list[str]:
@@ -152,7 +181,7 @@ def _sample_lines(m: _Metric, extra: tuple = ()) -> list[str]:
     with m._lock:
         samples = {
             key: (
-                (tuple(st.counts), st.total, st.n)
+                (tuple(st.counts), st.total, st.n, dict(st.exemplars))
                 if isinstance(m, Histogram)
                 else st
             )
@@ -173,16 +202,22 @@ def _sample_lines(m: _Metric, extra: tuple = ()) -> list[str]:
             ])
         )
         if isinstance(m, Histogram):
-            counts, total, n = samples[key]
+            counts, total, n, exemplars = samples[key]
             cum = 0
-            for le, c in zip(m.buckets, counts):
+            for i, (le, c) in enumerate(zip(m.buckets, counts)):
                 cum += c
                 blabel = ",".join(
                     filter(None, [labelstr, f'le="{_fmt(le)}"'])
                 )
-                lines.append(f"{name}_bucket{{{blabel}}} {cum}")
+                lines.append(
+                    f"{name}_bucket{{{blabel}}} {cum}"
+                    f"{_fmt_exemplar(exemplars.get(i))}"
+                )
             blabel = ",".join(filter(None, [labelstr, 'le="+Inf"']))
-            lines.append(f"{name}_bucket{{{blabel}}} {n}")
+            lines.append(
+                f"{name}_bucket{{{blabel}}} {n}"
+                f"{_fmt_exemplar(exemplars.get(len(m.buckets)))}"
+            )
             base = f"{{{labelstr}}}" if labelstr else ""
             lines.append(f"{name}_sum{base} {_fmt(total)}")
             lines.append(f"{name}_count{base} {n}")

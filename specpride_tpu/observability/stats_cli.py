@@ -18,6 +18,7 @@ import sys
 
 from specpride_tpu.observability.journal import (
     expand_parts,
+    expand_segments,
     read_events,
     validate_event,
 )
@@ -641,6 +642,28 @@ def _read_new_events(path: str, offset: int) -> tuple[list[dict], int]:
     return events, offset + len(chunk)
 
 
+def _poll_rotated(
+    path: str, offset: int, segs_seen: int
+) -> tuple[list[dict], int, int]:
+    """One ``--follow`` poll that survives journal ROTATION
+    (``--journal-rotate-mb``): when new numbered segments appeared
+    since the last poll, the live file we were tailing was renamed —
+    drain the first new segment from our old offset (it IS the old
+    live file), later ones whole, then continue on the fresh live file
+    from 0.  Returns ``(events, offset, segs_seen)``."""
+    rotated = [p for p in expand_segments(path) if p != path]
+    events: list[dict] = []
+    if len(rotated) > segs_seen:
+        for i, seg in enumerate(rotated[segs_seen:]):
+            evs, _ = _read_new_events(seg, offset if i == 0 else 0)
+            events.extend(evs)
+        segs_seen = len(rotated)
+        offset = 0
+    evs, offset = _read_new_events(path, offset)
+    events.extend(evs)
+    return events, offset, segs_seen
+
+
 def follow_stats(
     path: str, out=None, interval: float = 1.0, stop=None,
     max_updates: int = 0, top_spans: int = 0, slo: bool = False,
@@ -651,18 +674,24 @@ def follow_stats(
     without restarting ``stats`` per look.
 
     Renders the LAST run segment in the journal (the live one; a
-    journal reopened across runs holds several).  ``stop`` (a
+    journal reopened across runs holds several; a rotating daemon
+    journal is followed across its numbered segments).  ``stop`` (a
     ``threading.Event``) and ``max_updates`` are programmatic exits for
     tests; interactively Ctrl-C exits 0."""
     import time as _time
 
     out = out or sys.stdout
     offset = 0
+    # rotated segments that predate this follow are HISTORY — start at
+    # the live tail, count them consumed
+    segs_seen = len([p for p in expand_segments(path) if p != path])
     events: list[dict] = []
     updates = 0
     try:
         while True:
-            new_events, offset = _read_new_events(path, offset)
+            new_events, offset, segs_seen = _poll_rotated(
+                path, offset, segs_seen
+            )
             if new_events:
                 events.extend(new_events)
                 # only the LAST run segment is ever rendered: drop the

@@ -44,6 +44,94 @@ from specpride_tpu.observability.journal import (
 )
 
 
+# -- trace context -------------------------------------------------------
+#
+# The v4 causal envelope: a `trace_id` minted once per logical request
+# (job admission, elastic run start, one-shot CLI run) plus the span id
+# of the hop that spawned the current scope.  The pair threads through
+# every process boundary — the serve wire protocol (`"trace"` on
+# submit), the coordinator's plan record, and the SPECPRIDE_TRACE env
+# handoff for spawned rank processes — so every hop's spans parent into
+# ONE cross-process tree the trace merger (observability.traceplane)
+# can reassemble.
+
+TRACE_ENV = "SPECPRIDE_TRACE"
+
+
+def new_trace_id() -> str:
+    """A fresh 128-bit trace id (32 lowercase hex chars)."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """A fresh 64-bit span id (16 lowercase hex chars)."""
+    return os.urandom(8).hex()
+
+
+class TraceContext:
+    """One hop's causal coordinates: the trace it belongs to and the
+    span id its top-level spans parent under."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    @classmethod
+    def mint(cls) -> "TraceContext":
+        return cls(new_trace_id(), new_span_id())
+
+    def child(self) -> "TraceContext":
+        """The context a spawned hop runs under: same trace, fresh
+        parent span id."""
+        return TraceContext(self.trace_id, new_span_id())
+
+    def to_env(self) -> str:
+        return f"{self.trace_id}:{self.span_id}"
+
+    def to_wire(self) -> dict:
+        return {"trace_id": self.trace_id, "parent_span_id": self.span_id}
+
+    @classmethod
+    def from_env(cls, value: str | None = None) -> "TraceContext | None":
+        """Parse the ``SPECPRIDE_TRACE`` handoff (``trace_id:span_id``);
+        None when absent or malformed — a bad handoff must degrade to a
+        fresh trace, never crash a rank."""
+        if value is None:
+            value = os.environ.get(TRACE_ENV)
+        if not value:
+            return None
+        parts = value.strip().split(":")
+        if len(parts) != 2:
+            return None
+        trace_id, span_id = parts
+        if re.fullmatch(r"[0-9a-f]{32}", trace_id) and re.fullmatch(
+            r"[0-9a-f]{16}", span_id
+        ):
+            return cls(trace_id, span_id)
+        return None
+
+    @classmethod
+    def from_wire(cls, obj) -> "TraceContext | None":
+        """Parse the submit message's ``trace`` object; None when absent,
+        raises ``ValueError`` on a present-but-malformed one (the daemon
+        rejects the job — a half-broken trace join is worse than none)."""
+        if obj is None:
+            return None
+        if not isinstance(obj, dict):
+            raise ValueError("trace must be an object")
+        trace_id = obj.get("trace_id")
+        span_id = obj.get("parent_span_id")
+        if not (isinstance(trace_id, str)
+                and re.fullmatch(r"[0-9a-f]{32}", trace_id)):
+            raise ValueError("trace.trace_id must be 32 hex chars")
+        if not (isinstance(span_id, str)
+                and re.fullmatch(r"[0-9a-f]{16}", span_id)):
+            raise ValueError("trace.parent_span_id must be 16 hex chars")
+        return cls(trace_id, span_id)
+
+
 class _NullSpan:
     """Reusable no-op span (one shared instance; carries no state)."""
 
@@ -83,13 +171,16 @@ class Span:
     ``note(**labels)`` may add labels any time before close — the journal
     event is only written when the span finishes."""
 
-    __slots__ = ("tracer", "name", "labels", "t0", "depth")
+    __slots__ = ("tracer", "name", "labels", "t0", "depth", "span_id",
+                 "parent_span_id")
     enabled = True
 
     def __init__(self, tracer: "Tracer", name: str, labels: dict):
         self.tracer = tracer
         self.name = name
         self.labels = labels
+        self.span_id = None
+        self.parent_span_id = None
 
     def note(self, **labels) -> None:
         self.labels.update(labels)
@@ -97,6 +188,14 @@ class Span:
     def __enter__(self) -> "Span":
         stack = self.tracer._stack()
         self.depth = len(stack)
+        if self.tracer.ctx is not None:
+            # causal ids are assigned at OPEN (children must see their
+            # parent's id on the stack), journaled at close with the rest
+            self.span_id = new_span_id()
+            self.parent_span_id = (
+                stack[-1].span_id if stack and stack[-1].span_id
+                else self.tracer.ctx.span_id
+            )
         stack.append(self)
         self.t0 = time.perf_counter()
         return self
@@ -107,7 +206,8 @@ class Span:
         if stack and stack[-1] is self:
             stack.pop()
         self.tracer._record(
-            self.name, end, end - self.t0, self.depth, self.labels
+            self.name, end, end - self.t0, self.depth, self.labels,
+            span_id=self.span_id, parent_span_id=self.parent_span_id,
         )
         return False
 
@@ -126,9 +226,14 @@ class Tracer:
 
     enabled = True
 
-    def __init__(self, journal=None, keep: bool = False):
+    def __init__(self, journal=None, keep: bool = False, ctx=None):
         self.journal = journal if journal is not None else NullJournal()
         self.keep = keep
+        # trace context (v4 causal envelope): when set, every span gets
+        # a fresh span_id at open and a parent_span_id from the
+        # enclosing span (ctx.span_id at stack bottom), journaled with
+        # the span event — the cross-process causal tree
+        self.ctx: TraceContext | None = ctx
         self.spans: list[dict] = []  # finished spans (when keep)
         # wall/mono anchor pair for exporting kept spans without a journal
         self.t0_wall = time.time()
@@ -165,12 +270,22 @@ class Tracer:
         ``time.perf_counter()``).  Used where the interval is timed by
         existing instrumentation — per-kernel dispatch timing — rather
         than a ``with`` block."""
+        span_id = parent = None
+        if self.ctx is not None:
+            span_id = new_span_id()
+            stack = self._stack()
+            parent = (
+                stack[-1].span_id if stack and stack[-1].span_id
+                else self.ctx.span_id
+            )
         self._record(
-            name, t_start + dur_s, dur_s, len(self._stack()), labels
+            name, t_start + dur_s, dur_s, len(self._stack()), labels,
+            span_id=span_id, parent_span_id=parent,
         )
 
     def _record(self, name: str, mono_end: float, dur_s: float,
-                depth: int, labels: dict) -> None:
+                depth: int, labels: dict, span_id: str | None = None,
+                parent_span_id: str | None = None) -> None:
         # `tid`: the recording thread's lane.  The pipelined executor's
         # packer thread emits spans that GENUINELY overlap the dispatch
         # lane's — without a lane id the Chrome view would stack both
@@ -183,6 +298,10 @@ class Tracer:
             "name": name, "dur_s": round(dur_s, 6), "depth": depth,
             "tid": _lane_of_thread(),
         }
+        if span_id is not None:
+            rec["span_id"] = span_id
+        if parent_span_id is not None:
+            rec["parent_span_id"] = parent_span_id
         if labels:
             rec["labels"] = dict(labels)
         # the envelope `mono` must be the span's END, not the emit time:

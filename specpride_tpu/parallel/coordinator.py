@@ -81,6 +81,7 @@ import threading
 import time
 import uuid
 
+from specpride_tpu.observability.journal import emit_clock_anchor
 from specpride_tpu.observability.stats import logger
 from specpride_tpu.parallel.store import (
     FsStore,
@@ -185,6 +186,7 @@ class Coordinator:
         local_dir: str | None = None,
         steal: bool = True,
         chunk_hint: int = 0,
+        trace: str | None = None,
     ):
         self.root = root
         self.store: Store = store_from_spec(root)
@@ -205,6 +207,11 @@ class Coordinator:
             else max(self.ttl / 4.0, 0.05)
         )
         self.journal = journal
+        # the run's trace-context handoff ("trace_id:span_id"): the plan
+        # creator registers it so LATE-JOINING ranks (spares spawned
+        # without the SPECPRIDE_TRACE env) adopt the same trace instead
+        # of minting their own — one elastic run, one causal timeline
+        self.trace = trace
         self.steal_enabled = bool(steal)
         self.chunk_hint = max(int(chunk_hint), 1)
         self.n_clusters = int(n_clusters)
@@ -261,6 +268,7 @@ class Coordinator:
             "n_clusters": self.n_clusters,
             "range_size": self.range_size,
             "n_ranges": self.n_base_ranges,
+            **({"trace": self.trace} if self.trace else {}),
         }
 
     def _register_plan(self) -> None:
@@ -953,6 +961,10 @@ class Coordinator:
             self.journal.emit(
                 "heartbeat", rank=self.rank, holding=held, ttl=self.ttl,
             )
+            # the clock anchor rides the heartbeat cadence: a long
+            # elastic run's journal stays wall-alignable (bounded skew)
+            # even across NTP slews mid-run
+            emit_clock_anchor(self.journal)
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
@@ -967,14 +979,25 @@ class Coordinator:
         """rank -> seconds since its last heartbeat write (store clock)
         — the live fleet view the metrics exporter samples per
         scrape."""
-        out: dict[int, float] = {}
+        return {
+            rank: age
+            for rank, (age, _stopped) in self.rank_heartbeat_states().items()
+        }
+
+    def rank_heartbeat_states(self) -> dict[int, tuple[float, bool]]:
+        """rank -> (age_s, stopped): the ages plus the clean-shutdown
+        marker ``stop()`` writes — consumers distinguishing "finished
+        and left" (stale age is fine) from "went silent mid-run"
+        (presumed dead) must read this, not the bare ages (the
+        ``/healthz`` readiness probe does)."""
+        out: dict[int, tuple[float, bool]] = {}
         for key in self.store.list_keys("hb/"):
             got = self.store.get_with_age(key)
             if got is None:
                 continue
             rank, age = got[0].get("rank"), got[2]
             if isinstance(rank, int) and age is not None:
-                out[rank] = age
+                out[rank] = (age, bool(got[0].get("stopped")))
         return out
 
     def wait_for_work(self, timeout: float | None = None) -> None:

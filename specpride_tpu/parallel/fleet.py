@@ -67,7 +67,13 @@ def extract_flag(argv: list[str], flag: str) -> str | None:
 class FleetSupervisor:
     """Drive one elastic run to completion with ``ranks`` workers and up
     to ``spares`` warm spares.  :meth:`run` blocks until every range is
-    committed (returns 0) or no worker can make progress (returns 1)."""
+    committed (returns 0) or no worker can make progress (returns 1).
+
+    ``env`` is the spawned workers' environment; ``cmd_fleet`` stamps
+    the supervisor's trace context into it (``SPECPRIDE_TRACE``,
+    ``trace_id:span_id``) so every rank — boot workers, replacements,
+    scaled-up spares — journals under ONE trace and ``specpride trace``
+    merges the whole fleet onto a single causal timeline."""
 
     def __init__(
         self,

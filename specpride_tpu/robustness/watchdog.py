@@ -104,6 +104,22 @@ class Watchdog:
                 if self.on_stall is not None:
                     self.on_stall()
 
+    def stalled(self) -> list[tuple[str, float]]:
+        """Currently-open sections the monitor has flagged as stalled:
+        ``(lane, elapsed_s)`` pairs — the live per-lane health view the
+        serving daemon's ``/healthz`` readiness probe reports (a section
+        that EXITED clears itself, so recovery is visible immediately,
+        not at the next poll)."""
+        if not self.enabled:
+            return []
+        now = time.perf_counter()
+        with self._lock:
+            return [
+                (lane, round(now - t0, 3))
+                for key, (lane, t0) in self._sections.items()
+                if key in self._flagged
+            ]
+
     def stop(self) -> None:
         if self._thread is not None:
             self._stop.set()

@@ -5,6 +5,13 @@ helpers tests/bench drive directly.
 ("accepted", with the queue depth) before the job finishes — an
 operator watching a loaded daemon sees immediately whether the job
 queued or was rejected, then waits only for the terminal line.
+
+Every submit mints (or adopts) a **trace context** and sends it on the
+wire, so the daemon's spans parent under this client's submit span;
+with ``journal=`` the client writes its OWN journal shard — a clock
+anchor plus ``submit``/``submit:admit``/``submit:wait`` spans — which
+``specpride trace --job`` merges with the daemon and job journals into
+one causal timeline (the client track).
 """
 
 from __future__ import annotations
@@ -13,6 +20,11 @@ import json
 import socket
 import time
 
+from specpride_tpu.observability.journal import (
+    emit_clock_anchor,
+    open_journal,
+)
+from specpride_tpu.observability.tracing import TraceContext, new_span_id
 from specpride_tpu.serve import protocol
 
 
@@ -61,20 +73,60 @@ def _default_client_id() -> str:
 
 def submit(
     socket_path: str | None, argv: list[str], timeout: float | None = 30.0,
-    client: str | None = None,
+    client: str | None = None, journal: str | None = None,
+    trace: TraceContext | None = None,
 ):
     """Submit one job; yield every server message (admission line first,
     terminal line last).  ``timeout`` bounds connect + admission only —
     once the job is accepted the wait is unbounded (it may legitimately
     sit behind other clients' jobs).  ``client`` overrides the
     per-process scheduling identity (load generators simulating
-    distinct tenants)."""
-    sock = _connect(socket_path, timeout)
+    distinct tenants).
+
+    ``trace`` overrides the minted trace context (resubmit loops keep
+    ONE trace across attempts, each attempt a child submit span);
+    ``journal`` writes the client-side journal shard (clock anchor +
+    submit spans) for the trace merger."""
+    ctx = trace if trace is not None else TraceContext.mint()
+    # self-minted context: the submit span IS the trace root; a caller-
+    # provided one makes this attempt a child (resubmit loops emit one
+    # sibling submit span per attempt under the shared request id)
+    submit_span = ctx.span_id if trace is None else new_span_id()
+    submit_parent = None if trace is None else ctx.span_id
+    # the daemon's serve:queue/serve:job spans parent under the WAIT
+    # span (minted up front, emitted at close): the server does its
+    # work while the client waits — that is the causal chain a
+    # critical-path walk must descend through
+    wait_span = new_span_id()
+    jr = open_journal(journal)
+    jr.bind_trace(ctx.trace_id)
+    if jr.enabled:
+        emit_clock_anchor(jr)
+    t_start = time.perf_counter()
+    t_admit = None
+
+    def _span(name, t0, t1, span_id=None, parent=None, **labels):
+        if not jr.enabled:
+            return
+        jr.emit(
+            "span", name=name, mono=t1, dur_s=round(t1 - t0, 6),
+            depth=0 if parent is None else 1, tid=0,
+            span_id=span_id or new_span_id(),
+            **({"parent_span_id": parent} if parent else {}),
+            **({"labels": labels} if labels else {}),
+        )
+
+    sock = None
+    last_status = "error"
+    job_id = None
     try:
+        sock = _connect(socket_path, timeout)
         fh = sock.makefile("rw", encoding="utf-8", newline="\n")
         protocol.write_msg(
             fh, op="submit", argv=list(argv),
             client=client or _default_client_id(),
+            trace={"trace_id": ctx.trace_id,
+                   "parent_span_id": wait_span},
         )
         while True:
             try:
@@ -86,21 +138,39 @@ def submit(
                                  "response (daemon killed mid-job?)")
             yield msg
             status = msg.get("status")
+            job_id = msg.get("job_id", job_id)
             if status == "accepted":
+                t_admit = time.perf_counter()
+                _span("submit:admit", t_start, t_admit,
+                      parent=submit_span)
                 sock.settimeout(None)  # the job may queue; wait it out
             if status in ("done", "error", "rejected"):
+                last_status = status
                 return
     finally:
-        sock.close()
+        if sock is not None:
+            sock.close()
+        t_end = time.perf_counter()
+        if t_admit is not None:
+            _span("submit:wait", t_admit, t_end, span_id=wait_span,
+                  parent=submit_span)
+        _span(
+            "submit", t_start, t_end, span_id=submit_span,
+            parent=submit_parent, status=last_status,
+            **({"job_id": job_id} if job_id is not None else {}),
+        )
+        jr.close()
 
 
 def submit_wait(
     socket_path: str | None, argv: list[str], timeout: float | None = 30.0,
-    client: str | None = None,
+    client: str | None = None, journal: str | None = None,
+    trace: TraceContext | None = None,
 ) -> dict:
     """Submit and return only the terminal message."""
     last: dict = {}
-    for last in submit(socket_path, argv, timeout=timeout, client=client):
+    for last in submit(socket_path, argv, timeout=timeout, client=client,
+                       journal=journal, trace=trace):
         pass
     return last
 

@@ -86,10 +86,18 @@ from specpride_tpu.observability import (
     logger,
     open_journal,
 )
+from specpride_tpu.observability.journal import emit_clock_anchor
+from specpride_tpu.observability.tracing import TraceContext, new_span_id
 from specpride_tpu.robustness import errors as rb_errors
 from specpride_tpu.robustness.watchdog import Watchdog
 from specpride_tpu.serve import placement, protocol
 from specpride_tpu.serve.scheduler import AdmissionQueue, QuotaExceeded
+
+# how often a long-lived daemon re-journals its wall<->mono clock
+# anchor (piggybacked on job completions): frequent enough that the
+# trace merger's skew bound stays tight across NTP slews, cheap enough
+# to be noise in the journal
+CLOCK_ANCHOR_INTERVAL_S = 60.0
 
 
 class Job:
@@ -98,10 +106,12 @@ class Job:
 
     __slots__ = (
         "job_id", "client", "argv", "args", "command", "conn", "fh",
-        "t_enqueued", "ack", "batch_key",
+        "t_enqueued", "ack", "batch_key", "trace_id", "span_id",
+        "parent_span_id",
     )
 
-    def __init__(self, job_id, client, argv, args, command, conn, fh):
+    def __init__(self, job_id, client, argv, args, command, conn, fh,
+                 trace: TraceContext | None = None):
         self.job_id = job_id
         self.client = client
         self.argv = argv
@@ -110,6 +120,14 @@ class Job:
         self.conn = conn
         self.fh = fh
         self.t_enqueued = time.perf_counter()
+        # the v4 causal envelope: adopt the client's trace (the submit
+        # span becomes this job's parent) or mint a fresh root at
+        # admission; `span_id` is the job's own serve:job span, the
+        # parent every pipeline span inside the job nests under
+        ctx = trace if trace is not None else TraceContext.mint()
+        self.trace_id = ctx.trace_id
+        self.parent_span_id = ctx.span_id if trace is not None else None
+        self.span_id = new_span_id()
         # set once the reader has WRITTEN the "accepted" line: the
         # worker (or drain) waits on it before the terminal line, so
         # the two threads can never interleave bytes on one connection
@@ -152,6 +170,7 @@ class ServeDaemon:
         warmup_jobs: int = 0,
         watchdog_timeout: float = 0.0,
         journal_path: str | None = None,
+        journal_rotate_mb: float = 0.0,
         metrics_port: int | None = None,
         metrics_host: str = "127.0.0.1",
         metrics_out: str | None = None,
@@ -175,7 +194,14 @@ class ServeDaemon:
             conflict_key=_job_claimed_paths,
         )
         self.journal_path = journal_path
+        self.journal_rotate_mb = max(float(journal_rotate_mb), 0.0)
         self.journal = None
+        # cross-process clock anchoring: re-emit a clock_anchor on a
+        # heartbeat cadence so days-long daemon journals stay alignable
+        # even across wall-clock steps (NTP slews); worker lanes share
+        # the throttle state under its own lock
+        self._anchor_lock = threading.Lock()
+        self._last_anchor_mono = 0.0
         self.backend = None  # worker 0's backend (back-compat alias)
         # execution lanes: 0 = auto (min(#local jax devices, 4)); the
         # placement plan and per-worker backends are built at boot
@@ -251,11 +277,19 @@ class ServeDaemon:
         from specpride_tpu.warmstart.routing import RoutingTable
 
         self._t_boot = time.perf_counter()
-        self.journal = open_journal(self.journal_path)
+        self.journal = open_journal(
+            self.journal_path, rotate_mb=self.journal_rotate_mb,
+        )
         self.journal.emit(
             "run_start", command="serve", method="serve", backend="tpu",
             n_clusters=0, socket=self.socket_path,
         )
+        # the daemon journal holds MANY concurrent traces, so it never
+        # binds one — per-job events name theirs explicitly; the clock
+        # anchor still ties this process's mono axis to the wall clock
+        emit_clock_anchor(self.journal)
+        with self._anchor_lock:
+            self._last_anchor_mono = time.perf_counter()
         ws_cache.configure_compile_cache(self.compile_cache)
         state = ws_cache.cache_state()
         self.journal.emit(
@@ -327,7 +361,7 @@ class ServeDaemon:
         if self.metrics_port is not None:
             self.exporter = MetricsExporter(
                 self.telemetry.exposition, host=self.metrics_host,
-                port=self.metrics_port,
+                port=self.metrics_port, health=self._healthz,
             ).start()
         self._boot_warmup(state)
         sock_dir = os.path.dirname(self.socket_path)
@@ -428,6 +462,42 @@ class ServeDaemon:
         telemetry.uptime.set(
             round(time.perf_counter() - self._t_boot, 3)
         )
+
+    def _healthz(self) -> tuple[bool, str]:
+        """Per-lane readiness for ``GET /healthz``: ``ok`` while no
+        execution lane is stalled, ``degraded`` (HTTP 503) naming the
+        stalled lanes once the watchdog flags one — so fleet
+        supervisors and load balancers see a wedged lane, not an
+        unconditional 200 from a daemon that can no longer serve.
+        Draining reports degraded too: a drain is not ready for new
+        work.  Without ``--watchdog-timeout`` the stall signal is
+        unavailable and the probe degrades only on drain (noted in the
+        body so operators know what they armed)."""
+        bits = [f"workers={len(self.slots)}",
+                f"inflight={len(self._inflight_by)}"]
+        if self._draining or self._stop.is_set():
+            return False, "draining " + " ".join(bits)
+        stalled = self.watchdog.stalled()
+        if stalled:
+            lanes = ",".join(sorted({lane for lane, _ in stalled}))
+            worst = max(e for _, e in stalled)
+            return False, (
+                f"stalled={lanes} worst_stall_s={worst} "
+                + " ".join(bits)
+            )
+        if not self.watchdog.enabled:
+            bits.append("watchdog=off")
+        return True, " ".join(bits)
+
+    def _maybe_anchor(self) -> None:
+        """Re-emit the journal's clock anchor on heartbeat cadence
+        (cheap throttle — at most one pair per interval across lanes)."""
+        now = time.perf_counter()
+        with self._anchor_lock:
+            if now - self._last_anchor_mono < CLOCK_ANCHOR_INTERVAL_S:
+                return
+            self._last_anchor_mono = now
+        emit_clock_anchor(self.journal)
 
     def _socket_alive(self) -> bool:
         probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -651,8 +721,16 @@ class ServeDaemon:
                 f"daemon-owned flags on a job: {overridden} (set them on "
                 "`specpride serve` at boot)", False,
             )
+        try:
+            # the client's causal envelope: adopt its trace so the
+            # daemon-side spans parent under the submit span; a
+            # PRESENT-but-malformed trace rejects (a half-broken join
+            # is worse than none), absent mints a fresh root in Job
+            trace = TraceContext.from_wire(msg.get("trace"))
+        except ValueError as e:
+            return reject(str(e), False)
         job = Job(job_id, client or id(conn), argv, args,
-                  argv[0], conn, fh)
+                  argv[0], conn, fh, trace=trace)
         if self.batch_window > 0:
             # admission marks batch-eligible jobs: the compatibility key
             # is computed ONCE here (reader thread) so the worker-side
@@ -675,13 +753,14 @@ class ServeDaemon:
         self.journal.emit(
             "job_queued", job_id=job_id, client=str(job.client),
             command=job.command, method=getattr(args, "method", None),
+            trace_id=job.trace_id,
             **({"batch_eligible": job.batch_key is not None}
                if self.batch_window > 0 else {}),
         )
         try:
             protocol.write_msg(
                 fh, ok=True, status="accepted", job_id=job_id,
-                queue_depth=len(self.queue),
+                queue_depth=len(self.queue), trace_id=job.trace_id,
             )
         finally:
             job.ack.set()  # even on a dead client the worker must not wait
@@ -1010,6 +1089,11 @@ class ServeDaemon:
             with self._counts_lock:
                 self.batches_dispatched += 1
                 self.jobs_batched += len(shared)
+        # the shared dispatch is ONE leader span in the leader's trace,
+        # linked to every member: `trace_ids` names each member's trace
+        # (the merger includes the batch in all of them) and the span
+        # parents under the leader's serve:job span
+        batch_span = new_span_id()
         self.journal.emit(
             "batch_dispatch", batch_id=bid,
             jobs=[j.job_id for j in batch],
@@ -1024,7 +1108,21 @@ class ServeDaemon:
             dispatches=dev["dispatches"],
             bucket_occupancy_frac=dev["bucket_occupancy_frac"],
             padding_waste_frac=dev["padding_waste_frac"],
+            trace_ids=[j.trace_id for j in batch],
+            span_id=batch_span,
+            parent_span_id=leader.span_id,
             **({"error": err} if err else {}),
+        )
+        self.journal.emit(
+            "span", name="serve:batch", mono=t0 + wall,
+            dur_s=round(wall, 6), depth=1, tid=wid,
+            trace_id=leader.trace_id, span_id=batch_span,
+            parent_span_id=leader.span_id,
+            labels={
+                "batch_id": bid, "n_jobs": len(batch),
+                "n_clusters": n_clusters, "status": status,
+                "worker": wid,
+            },
         )
         if shared is not None:
             # jobs SERVED from the share (a member whose parse failed
@@ -1047,14 +1145,30 @@ class ServeDaemon:
              "batch_jobs": batch_info["n_jobs"]}
             if batch_info is not None else {}
         )
+        self._maybe_anchor()
         wait_s = time.perf_counter() - job.t_enqueued
         self.journal.emit(
             "job_start", job_id=job.job_id, command=job.command,
             method=getattr(job.args, "method", None),
             queue_wait_s=round(wait_s, 4), worker=wid,
+            trace_id=job.trace_id,
             **batch_fields,
         )
         t0 = time.perf_counter()
+        # the admission->execution wait as a REAL span in the job's
+        # causal tree (sibling of serve:job, parented under the
+        # client's submit span when one arrived on the wire)
+        span_kwargs = (
+            {"parent_span_id": job.parent_span_id}
+            if job.parent_span_id else {}
+        )
+        self.journal.emit(
+            "span", name="serve:queue", mono=t0,
+            dur_s=round(wait_s, 6), depth=0, tid=wid,
+            trace_id=job.trace_id, span_id=new_span_id(),
+            labels={"job_id": job.job_id, "worker": wid},
+            **span_kwargs,
+        )
         # THREAD-scoped compile counters: every compile a job causes
         # fires on the worker thread that dispatched it, so this
         # delta is the job's own even with other lanes compiling
@@ -1085,15 +1199,31 @@ class ServeDaemon:
                 self.jobs_done += 1
             else:
                 self.jobs_failed += 1
+        # the job's execution interval as the serve:job span — ITS
+        # span_id is what every pipeline span inside the job (and a
+        # shared batch dispatch it led) parents under
+        self.journal.emit(
+            "span", name="serve:job", mono=time.perf_counter(),
+            dur_s=round(wall, 6), depth=0, tid=wid,
+            trace_id=job.trace_id, span_id=job.span_id,
+            labels={
+                "job_id": job.job_id, "worker": wid,
+                "command": job.command, "status": status,
+                **({"method": getattr(job.args, "method")}
+                   if getattr(job.args, "method", None) else {}),
+            },
+            **span_kwargs,
+        )
         # fold the finished job into the live metric plane; the SLO
         # evaluation (objective, measured latency, ok/breach) rides
-        # the journal's job_done so `stats --slo` and /metrics agree
+        # the journal's job_done so `stats --slo` and /metrics agree —
+        # and the trace_id rides the latency histograms as an exemplar
         slo_fields = self.telemetry.job_done(
             command=job.command,
             method=getattr(job.args, "method", None),
             status=status, wall_s=wall, queue_wait_s=wait_s,
             summary=summary if isinstance(summary, dict) else None,
-            worker=wid,
+            worker=wid, trace_id=job.trace_id,
         )
         self.journal.emit(
             "job_done", job_id=job.job_id, status=status,
@@ -1102,6 +1232,7 @@ class ServeDaemon:
             method=getattr(job.args, "method", None),
             fresh_compiles=cc.get("misses", 0),
             worker=wid,
+            trace_id=job.trace_id,
             **batch_fields,
             **slo_fields,
             **({"error": err} if err else {}),
@@ -1114,6 +1245,7 @@ class ServeDaemon:
                     rc=rc, wall_s=round(wall, 4),
                     queue_wait_s=round(wait_s, 4), stats=summary,
                     compile_cache=cc, worker=wid,
+                    trace_id=job.trace_id,
                     **({"batch": batch_fields} if batch_fields else {}),
                 )
             else:
@@ -1152,6 +1284,10 @@ class ServeDaemon:
         # its tracer + singleton snapshots to this thread (numpy-backend
         # jobs too: their journal spans must not leak across lanes)
         job.args._serve_worker = wid
+        # the job's pipeline runs under ITS causal context: every span
+        # in the job's own --journal parents under the serve:job span,
+        # and the job journal stamps the trace_id on every event
+        job.args._trace_ctx = TraceContext(job.trace_id, job.span_id)
         backend = None
         if getattr(job.args, "backend", "tpu") == "tpu":
             backend = self.worker_backends[wid]

@@ -8,11 +8,22 @@ like any other JSONL stream.
 
 Client -> server (one request per connection)::
 
-    {"op": "submit", "argv": ["consensus", IN, OUT, "--method", ...]}
+    {"op": "submit", "argv": ["consensus", IN, OUT, "--method", ...],
+     "trace": {"trace_id": HEX32, "parent_span_id": HEX16}}
     {"op": "ping"}
     {"op": "status"}
     {"op": "profile", "seconds": 3.0, "trace_dir": DIR,
      "chrome_trace": FILE}
+
+``trace`` (optional) is the v4 causal envelope: the client minted a
+``trace_id`` and opened a submit span — the daemon adopts the trace,
+parents its serve:queue/serve:job spans under ``parent_span_id``, and
+stamps the id on the job's journal events, so ``specpride trace
+--trace-id`` reassembles client + daemon + job (+ shared batch) onto
+one timeline.  Absent, the daemon mints a fresh trace at admission
+(every served job is traceable either way); present-but-malformed
+rejects permanently.  The admission and terminal replies echo
+``trace_id`` back so shell callers can harvest it.
 
 ``profile`` (``specpride profile``) captures a bounded ``jax.profiler``
 device trace on the RUNNING warm daemon — no restart, no cold

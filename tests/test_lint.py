@@ -335,6 +335,74 @@ def test_journal_schema_catches_all_directions(bad_root):
     assert "doc:run_end" in symbols  # schema event missing a row
 
 
+def test_journal_schema_trace_envelope_both_directions(tmp_path):
+    """The v4 extension: an emit of a TRACE_EVENT_FIELDS event missing
+    its causal fields is a finding, and so is a docs row that never
+    mentions them; the fixed variants are clean."""
+    files = base_fixture(good=True)
+    files["fix/journal.py"] = """
+        EVENT_FIELDS = {
+            "run_start": frozenset({"command"}),
+            "run_end": frozenset({"elapsed_s"}),
+            "job_done": frozenset({"job_id"}),
+        }
+
+        TRACE_EVENT_FIELDS = {
+            "job_done": frozenset({"trace_id"}),
+        }
+
+        class Journal:
+            def emit(self, event, **fields):
+                return {}
+    """
+    files["fix/emitter.py"] = """
+        def go(journal):
+            journal.emit("run_start", command="x")
+            journal.emit("run_end", elapsed_s=1.0)
+            journal.emit("job_done", job_id=1)  # no trace_id
+    """
+    files["docs/observability.md"] = """
+        # Events
+
+        | event | payload (required) | meaning |
+        |---|---|---|
+        | `run_start` | `command` | run began |
+        | `run_end` | `elapsed_s` (plus `counters`) | run finished |
+        | `job_done` | `job_id` | done, trace field undocumented |
+    """ + DOC_METRICS_GOOD
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["journal-schema"])
+    symbols = {f.symbol for f in hits}
+    assert "emit:job_done:trace" in symbols, hits
+    assert "doc:job_done:trace" in symbols, hits
+    # fixed: the emit carries trace_id, the row mentions it behind plus
+    files["fix/emitter.py"] = """
+        def go(journal, tid):
+            journal.emit("run_start", command="x")
+            journal.emit("run_end", elapsed_s=1.0)
+            journal.emit("job_done", job_id=1, trace_id=tid)
+    """
+    files["docs/observability.md"] = """
+        # Events
+
+        | event | payload (required) | meaning |
+        |---|---|---|
+        | `run_start` | `command` | run began |
+        | `run_end` | `elapsed_s` (plus `counters`) | run finished |
+        | `job_done` | `job_id` (plus `trace_id`, required from v4) | done |
+    """ + DOC_METRICS_GOOD
+    root2 = write_tree(tmp_path / "fixed", files)
+    assert run_checks(root2, select=["journal-schema"]) == []
+
+
+def test_journal_schema_no_trace_table_is_vacuous(tmp_path):
+    """A fixture tree without TRACE_EVENT_FIELDS (pre-v4) reports no
+    trace findings — the anchor-absent convention every checker keeps."""
+    root = write_tree(tmp_path, base_fixture(good=True))
+    hits = run_checks(root, select=["journal-schema"])
+    assert not any(":trace" in f.symbol for f in hits), hits
+
+
 def test_journal_schema_catches_stale_renderer_literal(tmp_path):
     files = base_fixture(good=True)
     files["fix/emitter.py"] = textwrap.dedent(
