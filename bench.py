@@ -1731,6 +1731,228 @@ def bench_serving_batching(
     }
 
 
+def bench_autotune(
+    clusters, workdir: str, n_files: int = 6, clusters_per_file: int = 8,
+    burst_jobs_per_client: int = 6, lone_jobs: int = 10,
+) -> dict:
+    """Closed-loop controller A/B (BENCH_r18 acceptance): a SHIFTING
+    two-phase workload — a concurrent small-job burst where batching
+    wins, then a sequential lone-job phase where any collection window
+    is pure added latency — served by three configs: ``static-0``
+    (batching off), ``static-50`` (50ms window, the burst's friend),
+    and ``autotune`` (``--autotune on`` over the full 0:50 clamp,
+    booted at window 0).  No single static window is right for both
+    phases; the controller must widen during the burst and shrink back
+    for the lone phase, landing at-or-near the best static config in
+    EACH phase without a human picking the number.  Byte parity holds
+    for every job in every cell, and the controller's journal must
+    replay bit-exact (`specpride autotune-replay` semantics, run
+    in-process)."""
+    import os
+    import signal as _signal
+    import statistics
+    import subprocess
+    import sys
+    import threading
+
+    from specpride_tpu.autotune.replay import replay_journal
+    from specpride_tpu.io.mgf import write_mgf
+    from specpride_tpu.serve import client as sc
+
+    # distinct small tenant inputs (the batching section's regime:
+    # each solo dispatch under-fills the 64-row bucket floor)
+    srcs, goldens = [], []
+    cache = os.path.join(workdir, "at_cache")  # shared across boots
+    for i in range(n_files):
+        part = clusters[
+            i * clusters_per_file : (i + 1) * clusters_per_file
+        ]
+        assert part, "bench workload too small for the autotune section"
+        src = os.path.join(workdir, f"at_in_{i}.mgf")
+        write_mgf([s for c in part for s in c.members], src)
+        srcs.append(src)
+        golden_path = os.path.join(workdir, f"at_cli_{i}.mgf")
+        p = subprocess.run(
+            [sys.executable, "-m", "specpride_tpu", "consensus", src,
+             golden_path, "--method", "bin-mean",
+             "--layout", "bucketized", "--force-device",
+             "--compile-cache", cache],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        assert p.returncode == 0, p.stderr.decode(errors="replace")[-2000:]
+        with open(golden_path, "rb") as fh:
+            goldens.append(fh.read())
+
+    configs = (
+        ("static-0", ["--batch-window", "0"]),
+        ("static-50", ["--batch-window", "50"]),
+        ("autotune", ["--batch-window", "0", "--autotune", "on",
+                      "--autotune-interval", "0.2",
+                      "--autotune-batch-window", "0:50"]),
+    )
+    rows = []
+    for name, flags in configs:
+        sock = os.path.join(workdir, f"at_{name}.sock")
+        journal = os.path.join(workdir, f"at_{name}.jsonl")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "specpride_tpu", "serve",
+             "--socket", sock, "--compile-cache", cache,
+             "--layout", "bucketized", "--force-device",
+             "--journal", journal, "--max-queue", "64",
+             "--workers", "1"] + flags,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            assert sc.wait_for_socket(sock, timeout=300), \
+                f"{name}: daemon never booted"
+
+            def _submit(cid, out):
+                t0 = time.perf_counter()
+                term = sc.submit_wait(
+                    sock,
+                    ["consensus", srcs[cid], out, "--method",
+                     "bin-mean"],
+                    timeout=600, client=f"tenant-{cid}",
+                )
+                assert term.get("status") == "done", term
+                return (time.perf_counter() - t0,
+                        term["compile_cache"].get("misses", 0), out)
+
+            def _burst(phase):
+                """Phase A: n_files clients submit concurrently."""
+                results: list = []
+                errors: list = []
+                lock = threading.Lock()
+
+                def _client(cid):
+                    try:
+                        for j in range(burst_jobs_per_client):
+                            out = os.path.join(
+                                workdir,
+                                f"at_{name}_{phase}_{cid}_{j}.mgf",
+                            )
+                            got = _submit(cid, out)
+                            with lock:
+                                results.append((cid,) + got)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+
+                t0 = time.perf_counter()
+                threads = [
+                    threading.Thread(target=_client, args=(c,))
+                    for c in range(n_files)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                assert not errors, errors[:3]
+                return wall, results
+
+            # warm until a full burst pass compiles nothing fresh
+            for attempt in range(4):
+                _, warm = _burst(f"warm{attempt}")
+                if all(f == 0 for _, _, f, _ in warm):
+                    break
+
+            # phase A: the concurrent burst
+            burst_wall, burst = _burst("burst")
+            assert all(f == 0 for _, _, f, _ in burst), burst
+            # phase B: sequential lone jobs — an empty queue between
+            # each, so any collection window is pure added latency
+            lone: list = []
+            lone_t0 = time.perf_counter()
+            for j in range(lone_jobs):
+                out = os.path.join(workdir, f"at_{name}_lone_{j}.mgf")
+                lone.append((j % n_files,) + _submit(j % n_files, out))
+            lone_wall = time.perf_counter() - lone_t0
+            assert all(f == 0 for _, _, f, _ in lone), lone
+
+            for cid, _, _, out in burst + lone:
+                with open(out, "rb") as fh:
+                    assert fh.read() == goldens[cid], out
+
+            proc.send_signal(_signal.SIGTERM)
+            rc = proc.wait(timeout=300)
+            assert rc == 0, f"{name}: drain exited {rc}"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+        lone_lat = sorted(dt for _, dt, _, _ in lone)
+        n_burst = len(burst)
+        row = {
+            "config": name,
+            "burst_jobs": n_burst,
+            "burst_wall_s": round(burst_wall, 3),
+            "burst_jobs_per_sec": round(n_burst / burst_wall, 3),
+            "lone_jobs": len(lone),
+            "lone_wall_s": round(lone_wall, 3),
+            "lone_latency_p50_s": round(
+                lone_lat[len(lone_lat) // 2], 4),
+            "lone_latency_mean_s": round(
+                statistics.fmean(lone_lat), 4),
+            "total_wall_s": round(burst_wall + lone_wall, 3),
+            "byte_parity_jobs": n_burst + len(lone),
+        }
+        if name == "autotune":
+            import json as _json
+
+            events = [_json.loads(ln) for ln in open(journal)]
+            at = [e for e in events if e.get("event") == "autotune"]
+            acted = [e for e in at if e.get("acted")]
+            assert acted, "the autotune config never acted on a knob"
+            row["decisions"] = len(at)
+            row["acted"] = len(acted)
+            row["decision_log"] = [
+                {"knob": e["knob"], "old": e["old"], "new": e["new"],
+                 "reason": e["reason"]} for e in acted
+            ]
+            # the determinism audit over the bench's own journal
+            rep = replay_journal(journal)
+            assert rep["ok"], rep
+            row["replay"] = {
+                "decisions": rep["decisions"],
+                "reproduced": rep["reproduced"],
+                "ok": rep["ok"],
+            }
+        rows.append(row)
+        eprint(
+            f"[autotune] {name}: burst {n_burst} jobs in "
+            f"{burst_wall:.2f}s = {row['burst_jobs_per_sec']:.2f} "
+            f"jobs/sec; lone p50 {row['lone_latency_p50_s']:.3f}s; "
+            f"total {row['total_wall_s']:.2f}s"
+            + (f"; {row['acted']} acted decision(s), replay ok"
+               if name == "autotune" else "")
+        )
+    by = {r["config"]: r for r in rows}
+    return {
+        "n_files": n_files,
+        "clusters_per_file": clusters_per_file,
+        "burst_jobs_per_client": burst_jobs_per_client,
+        "lone_jobs": lone_jobs,
+        "rows": rows,
+        "verdict": {
+            # the controller's bar: at-or-near the best static config
+            # in EACH phase of the shifting workload
+            "burst_vs_best_static": round(
+                by["autotune"]["burst_wall_s"]
+                / min(by["static-0"]["burst_wall_s"],
+                      by["static-50"]["burst_wall_s"]), 3),
+            "lone_vs_best_static": round(
+                by["autotune"]["lone_wall_s"]
+                / min(by["static-0"]["lone_wall_s"],
+                      by["static-50"]["lone_wall_s"]), 3),
+            "total_vs_best_single_static": round(
+                by["autotune"]["total_wall_s"]
+                / min(by["static-0"]["total_wall_s"],
+                      by["static-50"]["total_wall_s"]), 3),
+        },
+    }
+
+
 def bench_telemetry(
     clusters, workdir: str, n_serving_clusters: int = 128,
     repeats: int = 5, jobs_per_batch: int = 6, extra_scrapes: int = 100,
@@ -2132,8 +2354,8 @@ def main() -> None:
         help="with --report: comma list of report sections to run "
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
         "prefetch_sweep,worker_sweep,fault_overhead,warm_start,serving,"
-        "serving_concurrency,serving_batching,telemetry,elastic,"
-        "elastic_steal,pallas,bandwidth",
+        "serving_concurrency,serving_batching,autotune,telemetry,"
+        "elastic,elastic_steal,pallas,bandwidth",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -2158,8 +2380,8 @@ def main() -> None:
     all_sections = (
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
         "worker_sweep,fault_overhead,warm_start,serving,"
-        "serving_concurrency,serving_batching,telemetry,elastic,"
-        "elastic_steal,pallas,bandwidth"
+        "serving_concurrency,serving_batching,autotune,telemetry,"
+        "elastic,elastic_steal,pallas,bandwidth"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -2312,6 +2534,10 @@ def main() -> None:
                 if "serving_batching" in secs:
                     report["serving_batching"] = \
                         bench_serving_batching(clusters, workdir)
+                if "autotune" in secs:
+                    report["autotune"] = bench_autotune(
+                        clusters, workdir
+                    )
                 if "telemetry" in secs:
                     report["telemetry"] = bench_telemetry(
                         clusters, workdir

@@ -1104,6 +1104,182 @@ cmp "$bw_tmp/don.mgf" "$bw_tmp/nodon.mgf"
 echo "donation parity OK"
 rm -rf "$bw_tmp"
 
+echo "== autotune: closed-loop controller (serve on + elastic observe + replay) =="
+# (a) serve --autotune on with a tight batch-window clamp: a 1-lane
+# daemon under a 12-job concurrent burst must journal >=1 ACTED
+# batch_window_ms decision carrying its full evidence payload (signal
+# snapshot, params, clock, trace exemplars), every served output must
+# stay byte-identical to the one-shot CLI, and `specpride
+# autotune-replay` must reproduce every decision from the journal
+# alone.  Two timing rules keep the deep-queue sample deterministic:
+# the burst is one driver process with a thread per client (separate
+# `specpride submit` processes would serialize on interpreter startup
+# and trickle in), and it runs COLD — the first job's compile wall
+# pins the single lane while the other 11 stack behind it, so the
+# 0.1s controller ticks reliably observe depth >= queue_hi (a warm
+# burst of these tiny jobs drains in ~20ms, between two ticks).
+at_tmp=$(mktemp -d)
+AT_IN=tests/data/golden_clustered.mgf
+AT_SOCK="$at_tmp/serve.sock"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    serve --socket "$AT_SOCK" --compile-cache "$at_tmp/cache" \
+    --journal "$at_tmp/serve.jsonl" --workers 1 --max-queue 32 \
+    --autotune on --autotune-interval 0.1 \
+    --autotune-batch-window 5:25 &
+AT_PID=$!
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$AT_IN" "$at_tmp/cli.mgf" --method bin-mean
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - \
+    "$AT_SOCK" "$AT_IN" "$at_tmp" <<'EOF'
+import sys
+import threading
+
+from specpride_tpu.serve import client as sc
+
+sock, src, tmp = sys.argv[1:4]
+assert sc.wait_for_socket(sock, timeout=180), "daemon never came up"
+
+
+def job(tag, client):
+    term = sc.submit_wait(
+        sock,
+        ["consensus", src, f"{tmp}/served_{tag}.mgf",
+         "--method", "bin-mean"],
+        timeout=600, client=client,
+    )
+    assert term.get("status") == "done", term
+
+
+errs = []
+
+
+def run(i):
+    try:
+        job(str(i), f"burst-{i % 4}")
+    except Exception as e:  # surfaced after join
+        errs.append(repr(e))
+
+
+threads = [threading.Thread(target=run, args=(i,)) for i in range(12)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert not errs, errs[:3]
+EOF
+for i in 0 1 2 3 4 5 6 7 8 9 10 11; do
+    cmp "$at_tmp/cli.mgf" "$at_tmp/served_$i.mgf"
+done
+# stats renders the controller's state off the LIVE (run_end-less)
+# journal: summary line plus the per-decision log under --autotune
+AT_STATS=$(env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m \
+    specpride_tpu stats "$at_tmp/serve.jsonl" --autotune)
+printf '%s\n' "$AT_STATS" | grep -q "autotune: mode=on" || {
+    printf '%s\n' "$AT_STATS"
+    echo "FAIL: stats did not render the live autotune summary"
+    exit 1
+}
+kill -TERM $AT_PID
+AT_RC=0; wait $AT_PID || AT_RC=$?
+test "$AT_RC" -eq 0
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$at_tmp" <<'EOF'
+import os, sys
+from specpride_tpu.observability.journal import read_events
+tmp = sys.argv[1]
+events, violations = read_events(os.path.join(tmp, "serve.jsonl"))
+assert not violations, violations
+at = [e for e in events if e["event"] == "autotune"]
+assert at and all(e["knob"] == "batch_window_ms" for e in at), at
+acted = [e for e in at if e["acted"]]
+assert acted, "the burst never produced an acted decision"
+widen = [e for e in acted if e["new"] > e["old"]]
+assert widen, f"no widen decision under a depth-12 burst: {at}"
+for e in at:  # the evidence contract: every decision self-describes
+    assert e["mode"] == "on" and e["reason"], e
+    assert e["signal"]["now"] == e["clock"], e
+    assert (e["params"]["lo_ms"], e["params"]["hi_ms"]) == (5.0, 25.0)
+    assert 5.0 <= e["new"] <= 25.0, e
+    assert isinstance(e["trace_ids"], list), e
+w = widen[0]
+assert w["signal"]["queue_depth"] >= w["params"]["queue_hi"], w
+print(f"serve autotune OK: {len(at)} decision(s), {len(acted)} acted, "
+      f"first widen at queue depth {w['signal']['queue_depth']}, "
+      "12 served outputs byte-identical to CLI")
+EOF
+# (b) elastic 2-rank observe run: the rank controllers must journal
+# >=1 would-be elastic_range decision WITHOUT acting (observe never
+# touches the split hint), and the merged output must stay
+# byte-identical to the serial run of the same input
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$at_tmp/el_in.mgf" <<'EOF'
+import sys
+
+import numpy as np
+
+from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.io.mgf import write_mgf
+
+# enough clusters (checkpoint-every 1 => one heartbeat each) that the
+# post-compile phase spans several 1s controller ticks even on a
+# contended 1-core runner
+rng = np.random.default_rng(18)
+clusters = []
+for i in range(48):
+    members = []
+    for k in range(int(rng.integers(4, 7))):
+        mz = np.sort(rng.uniform(150, 1500, 150))
+        members.append(Spectrum(
+            mz=mz, intensity=rng.uniform(1, 1e4, 150),
+            precursor_mz=420.0, precursor_charge=2, rt=1.0,
+            title=f"e{i:03d};s{k}",
+        ))
+    clusters.append(Cluster(f"e{i:03d}", members))
+write_mgf([s for c in clusters for s in c.members], sys.argv[1])
+EOF
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$at_tmp/el_in.mgf" "$at_tmp/el_serial.mgf" \
+    --method bin-mean --backend tpu
+at_elastic() { # $1 = rank
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        consensus "$at_tmp/el_in.mgf" "$at_tmp/el.mgf" \
+        --method bin-mean --backend tpu \
+        --elastic "$at_tmp/coord" --process-id "$1" \
+        --elastic-range 4 --checkpoint-every 1 --elastic-ttl 2 \
+        --journal "$at_tmp/el.jsonl" --autotune observe
+}
+at_elastic 0 &
+AT_R0=$!
+at_elastic 1 &
+AT_R1=$!
+wait $AT_R0
+wait $AT_R1
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    merge-parts "$at_tmp/el.mgf" --elastic "$at_tmp/coord"
+cmp "$at_tmp/el_serial.mgf" "$at_tmp/el.mgf"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$at_tmp" <<'EOF'
+import json, os, sys
+tmp = sys.argv[1]
+at = []
+for rank in (0, 1):
+    shard = os.path.join(tmp, f"el.jsonl.part{rank:05d}")
+    events = [json.loads(l) for l in open(shard)]
+    at += [e for e in events if e["event"] == "autotune"]
+assert at, "no rank journaled a would-be elastic_range decision"
+assert all(e["knob"] == "elastic_range" for e in at), at
+assert all(e["mode"] == "observe" for e in at), at
+assert all(e["acted"] is False for e in at), \
+    f"observe mode must never act: {at}"
+assert all("chunk_s_mean" in e["signal"]["heartbeats"] for e in at), at
+print(f"elastic observe OK: {len(at)} would-be decision(s) journaled, "
+      "none acted, merged output byte-identical to serial")
+EOF
+# (c) the determinism audit: replay must reproduce every decision in
+# both journals exactly (exit 0)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    autotune-replay "$at_tmp/serve.jsonl"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    autotune-replay "$at_tmp/el.jsonl"
+rm -rf "$at_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

@@ -27,6 +27,13 @@ job events, ``trace_ids`` on ``batch_dispatch``) — every statically
 visible emit site must pass them, and the docs event-table row must at
 least mention each one (required or behind the ``plus`` marker), so a
 new emit site cannot silently ship an untraceable event.
+
+``V5_EVENT_FIELDS`` (the v5 additions — ``chunk_s`` on ``heartbeat``)
+gets the same both-direction treatment: every statically visible emit
+site must pass the field, and the docs row must mention it.  Version-
+gated tables keep old committed journals valid while making it
+impossible for NEW emit sites to drop the field the autotune signal
+fold depends on.
 """
 
 from __future__ import annotations
@@ -62,6 +69,19 @@ def _trace_event_fields(project: Project) -> dict[str, set]:
     """The v4 trace-envelope table (``TRACE_EVENT_FIELDS``), or empty
     when the project doesn't declare one (pre-v4 fixture trees)."""
     hit = project.one_constant("TRACE_EVENT_FIELDS")
+    if hit is None:
+        return {}
+    _mod, node, _line = hit
+    table = dict_of_str_sets(node)
+    if table is None:
+        return {}
+    return {k: v for k, v in table.items() if v is not None}
+
+
+def _v5_event_fields(project: Project) -> dict[str, set]:
+    """The v5 additive-field table (``V5_EVENT_FIELDS``), or empty when
+    the project doesn't declare one (pre-v5 fixture trees)."""
+    hit = project.one_constant("V5_EVENT_FIELDS")
     if hit is None:
         return {}
     _mod, node, _line = hit
@@ -124,6 +144,7 @@ def run(project: Project) -> list[Finding]:
         return []
     schema_mod, schema, schema_line = anchor
     trace_fields = _trace_event_fields(project)
+    v5_fields = _v5_event_fields(project)
     findings: list[Finding] = []
 
     # 1. emit sites vs schema (incl. the v4 trace envelope)
@@ -161,6 +182,17 @@ def run(project: Project) -> list[Finding]:
                     f"envelope fields {missing_trace} "
                     f"(TRACE_EVENT_FIELDS) — an untraceable serving "
                     f"event breaks the cross-process causal join"
+                ),
+            ))
+        missing_v5 = sorted(v5_fields.get(event, set()) - kwargs)
+        if missing_v5:
+            findings.append(Finding(
+                check=CHECK, path=mod.rel, line=node.lineno,
+                symbol=f"emit:{event}:v5",
+                message=(
+                    f"emit of `{event}` is missing the v5 fields "
+                    f"{missing_v5} (V5_EVENT_FIELDS) — the autotune "
+                    f"signal fold depends on them"
                 ),
             ))
 
@@ -227,6 +259,22 @@ def run(project: Project) -> list[Finding]:
                             f"{_DOC} row for `{event}` does not "
                             f"mention the v4 trace-envelope fields "
                             f"{absent} (TRACE_EVENT_FIELDS)"
+                        ),
+                    ))
+            # v5 additive fields: same mention rule as the v4 envelope
+            for event, fields in sorted(v5_fields.items()):
+                row = table.get(event)
+                if row is None:
+                    continue  # the missing-row finding above covers it
+                absent = sorted(fields - row.get("mentioned", set()))
+                if absent:
+                    findings.append(Finding(
+                        check=CHECK, path=_DOC, line=row["line"],
+                        symbol=f"doc:{event}:v5",
+                        message=(
+                            f"{_DOC} row for `{event}` does not "
+                            f"mention the v5 fields {absent} "
+                            f"(V5_EVENT_FIELDS)"
                         ),
                     ))
 

@@ -1,0 +1,34 @@
+"""Closed-loop autotune: a telemetry-driven controller that tunes the
+serving and elastic planes from their own journals (ROADMAP item 8).
+
+The observability plane already carries every signal an operator reads
+before moving a knob — queue depth, batch occupancy and window wait,
+SLO burn, per-rank EWMA chunk walls, trace span attribution.  This
+package closes the loop: a :class:`~.signals.SignalState` folds those
+events into windowed estimates, pure :mod:`~.policy` modules map a
+snapshot to a proposed knob value, and a :class:`~.controller.Controller`
+journals every decision as an evidence-carrying ``autotune`` event
+(schema v5) before actuating it through the host's existing live
+config path.  ``--autotune off|observe|on`` is the kill switch:
+``observe`` journals would-be decisions without acting (the safe
+rollout default), ``off`` leaves every output byte-identical to a
+controller-free run.  ``specpride autotune-replay`` re-runs the
+policies over a recorded journal and diffs the decisions, so the
+controller's behavior is itself reviewable offline.
+"""
+
+from specpride_tpu.autotune.controller import (  # noqa: F401
+    Controller,
+    ControllerThread,
+    evaluate,
+)
+from specpride_tpu.autotune.policy import (  # noqa: F401
+    MODES,
+    BatchWindowPolicy,
+    ElasticRangePolicy,
+    FleetSparesPolicy,
+    WorkerPolicy,
+    parse_clamp,
+    policy_from_params,
+)
+from specpride_tpu.autotune.signals import SignalState  # noqa: F401

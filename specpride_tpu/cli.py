@@ -283,6 +283,17 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
         "Share it between ranks on one host so takeovers resume a "
         "dead rank's committed prefix instead of recomputing",
     )
+    p.add_argument(
+        "--autotune", choices=["off", "observe", "on"], default="off",
+        help="(with --elastic) closed-loop controller re-sizing "
+        "SPLIT-OFF ranges from the heartbeat EWMA chunk walls (ROADMAP "
+        "4b): 'observe' journals every would-be --elastic-range "
+        "decision without acting, 'on' also caps how much tail a donor "
+        "cedes per steal — already-claimed ranges are never resized, "
+        "merged output stays byte-identical.  Requires --journal; "
+        "every decision is an `autotune` event (default off; see "
+        "docs/autotune.md)",
+    )
 
 
 def _add_observability(p: argparse.ArgumentParser) -> None:
@@ -2877,6 +2888,34 @@ def _run_elastic(
         coord.store.describe(), coord.ttl,
         "on" if coord.steal_enabled else "off",
     )
+    autotune = getattr(args, "autotune", "off") or "off"
+    ctl_thread = None
+    if autotune != "off":
+        if not journal.enabled:
+            raise SystemExit(
+                "--autotune observe|on requires --journal: every "
+                "decision must be journaled as evidence"
+            )
+        from specpride_tpu.autotune.controller import (
+            Controller,
+            ControllerThread,
+        )
+        from specpride_tpu.autotune.policy import ElasticRangePolicy
+
+        chunk = max(int(getattr(args, "checkpoint_every", 512)), 1)
+        ctl = Controller(journal, mode=autotune)
+        ctl.register(
+            ElasticRangePolicy(
+                lo=chunk, hi=4 * range_size, chunk_hint=chunk,
+            ),
+            get=lambda: coord.split_hint or range_size,
+            set=coord.set_split_hint,
+        )
+        ctl_thread = ControllerThread(ctl, interval=1.0).start()
+        logger.info(
+            "elastic rank %d: autotune %s (elastic_range clamp "
+            "[%d, %d])", rank, autotune, chunk, 4 * range_size,
+        )
     exporter = None
     if getattr(args, "metrics_port", None) is not None:
         from specpride_tpu.observability.exporter import (
@@ -2922,6 +2961,14 @@ def _run_elastic(
             )
     finally:
         harness.close()
+        if ctl_thread is not None:
+            # final progress beat first: a rank that finished inside
+            # one heartbeat interval would hand the drain tick a
+            # journal with no chunk walls to decide on.  Then stop the
+            # controller before coord.stop(): a tick racing the
+            # journal close would lose its decision line
+            coord.flush_progress()
+            ctl_thread.stop()
         if exporter is not None:
             exporter.stop()
         coord.stop()
@@ -3171,6 +3218,22 @@ def cmd_serve(args) -> int:
             "--batch-max-clusters must be >= 1 "
             f"(got {args.batch_max_clusters})"
         )
+    autotune = getattr(args, "autotune", "off") or "off"
+    if autotune != "off" and not args.journal:
+        raise SystemExit(
+            "serve --autotune observe|on requires --journal: every "
+            "decision must be journaled as evidence"
+        )
+    autotune_bw = None
+    if getattr(args, "autotune_batch_window", None):
+        from specpride_tpu.autotune.policy import parse_clamp
+
+        try:
+            autotune_bw = parse_clamp(
+                args.autotune_batch_window, "--autotune-batch-window"
+            )
+        except ValueError as e:
+            raise SystemExit(str(e))
     return ServeDaemon(
         args.socket,
         max_queue=args.max_queue,
@@ -3194,6 +3257,9 @@ def cmd_serve(args) -> int:
         metrics_host=args.metrics_host,
         metrics_out=args.metrics_out,
         slo=slo,
+        autotune=autotune,
+        autotune_interval=getattr(args, "autotune_interval", 1.0),
+        autotune_batch_window=autotune_bw,
     ).run()
 
 
@@ -3342,6 +3408,7 @@ def cmd_fleet(args) -> int:
                 poll_interval=args.poll,
                 scale_horizon=args.scale_horizon,
                 env=env,
+                autotune=getattr(args, "autotune", "off") or "off",
             )
         except ValueError as e:
             raise SystemExit(str(e))
@@ -3405,11 +3472,29 @@ def cmd_stats(args) -> int:
         return follow_stats(
             args.journals[0], interval=args.interval,
             top_spans=args.top_spans, slo=args.slo,
+            autotune=getattr(args, "autotune", False),
         )
     return run_stats(
         args.journals, json_out=args.json, top_spans=args.top_spans,
-        slo=args.slo,
+        slo=args.slo, autotune=getattr(args, "autotune", False),
     )
+
+
+def cmd_autotune_replay(args) -> int:
+    """``specpride autotune-replay JOURNAL``: the controller's
+    determinism audit — rebuild each recorded policy from its journaled
+    params, re-run it on the journaled signal snapshot, refold the
+    snapshots from the event stream, and require everything to match.
+    Exit 0 iff every decision reproduces.  See docs/autotune.md."""
+    from specpride_tpu.autotune.replay import render_replay, replay_journal
+
+    result = replay_journal(args.journal)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True, default=str)
+            fh.write("\n")
+    render_replay(result, sys.stdout)
+    return 0 if result["ok"] else 1
 
 
 def cmd_trace(args) -> int:
@@ -4075,6 +4160,26 @@ def build_parser() -> argparse.ArgumentParser:
         "as burn counters on /metrics; render with "
         "`specpride stats --slo`",
     )
+    psv.add_argument(
+        "--autotune", choices=["off", "observe", "on"], default="off",
+        help="closed-loop controller over the daemon's live knobs "
+        "(batch window, active worker lanes), driven by the journal's "
+        "own telemetry: 'observe' journals every would-be decision "
+        "without acting (the safe rollout mode), 'on' also actuates; "
+        "every decision is an `autotune` journal event carrying its "
+        "evidence — requires --journal; replay with `specpride "
+        "autotune-replay` (default off; see docs/autotune.md)",
+    )
+    psv.add_argument(
+        "--autotune-interval", type=float, default=1.0, metavar="S",
+        help="controller tick interval in seconds (default 1.0)",
+    )
+    psv.add_argument(
+        "--autotune-batch-window", metavar="LO:HI", default=None,
+        help="clamp for the tuned batch window in MILLISECONDS, e.g. "
+        "0:50 — the controller never moves --batch-window outside "
+        "[LO, HI] (default 0:50)",
+    )
     psv.set_defaults(fn=cmd_serve)
 
     ppr = sub.add_parser(
@@ -4201,6 +4306,15 @@ def build_parser() -> argparse.ArgumentParser:
         "decisions (workers journal separately via their own --journal)",
     )
     pf.add_argument(
+        "--autotune", choices=["off", "observe", "on"], default="off",
+        help="closed-loop controller over the warm-spare count, driven "
+        "by live steal pressure (split proposals, stale heartbeats): "
+        "'observe' journals every would-be --spares decision without "
+        "acting, 'on' also actuates within [0, max-ranks - ranks].  "
+        "Requires --journal; every decision is an `autotune` event "
+        "(default off; see docs/autotune.md)",
+    )
+    pf.add_argument(
         "job", nargs=argparse.REMAINDER,
         help="the rank argv to supervise, after --: consensus|select "
         "INPUT OUTPUT --elastic DIR|URL [flags] (no --process-id — "
@@ -4266,7 +4380,32 @@ def build_parser() -> argparse.ArgumentParser:
         "seconds from client submit through daemon queue/dispatch, "
         "shared batch, and pipeline spans, on one clock-anchored axis",
     )
+    pst.add_argument(
+        "--autotune", action="store_true",
+        help="also render the controller's decision log (knob, old -> "
+        "new, acted, reason) from the journals' autotune events — "
+        "works with --follow for a live view",
+    )
     pst.set_defaults(fn=cmd_stats)
+
+    par = sub.add_parser(
+        "autotune-replay",
+        help="re-run the autotune policies over a recorded journal and "
+        "verify every decision reproduces exactly (same new value, "
+        "same reason, refolded signal snapshots) — the determinism "
+        "audit for the closed-loop controller",
+    )
+    par.add_argument(
+        "journal",
+        help="journal file from an --autotune observe|on run (base "
+        "path; rotated segments and .part<rank> shards replay as "
+        "independent per-process streams)",
+    )
+    par.add_argument(
+        "--json", metavar="FILE",
+        help="also write the machine-readable replay result here",
+    )
+    par.set_defaults(fn=cmd_autotune_replay)
 
     pt = sub.add_parser(
         "trace",

@@ -104,7 +104,14 @@ PRE_REGISTERED_FAMILIES = (
     "specpride_serve_batch_*",
     "specpride_h2d_bytes_total",
     "specpride_d2h_bytes_total",
+    "specpride_autotune_*",
 )
+
+# the daemon-hosted autotune knobs: their current-value gauges and
+# decision counters pre-register at 0 for BOTH label values of `acted`,
+# so "the controller never moved this knob" is an auditable 0-valued
+# series, not an absent one
+AUTOTUNE_KNOBS = ("batch_window_ms", "workers")
 
 
 class ServeTelemetry:
@@ -276,6 +283,24 @@ class ServeTelemetry:
         self.batch_jobs.inc(0)
         self.batch_clusters.inc(0)
         self.batch_occupancy.set(0.0)
+        # closed-loop autotune (specpride_tpu.autotune): per-knob
+        # current value + decision counters, mirrored from every
+        # journaled `autotune` event by the controller
+        self.autotune_knob = r.gauge(
+            "specpride_autotune_knob",
+            "current value of each controller-managed knob",
+            labels=("knob",),
+        )
+        self.autotune_decisions = r.counter(
+            "specpride_autotune_decisions_total",
+            "autotune decisions journaled, by knob and whether the "
+            "controller acted (mode on) or only observed",
+            labels=("knob", "acted"),
+        )
+        for knob in AUTOTUNE_KNOBS:
+            self.autotune_knob.set(0.0, knob=knob)
+            self.autotune_decisions.inc(0, knob=knob, acted="true")
+            self.autotune_decisions.inc(0, knob=knob, acted="false")
         # device transfer rollups (memory-bandwidth campaign): summed
         # across worker-lane backend registries by delta at scrape time
         # (sync_singletons); pre-registered at 0 so a daemon that never
@@ -293,6 +318,16 @@ class ServeTelemetry:
 
     def job_rejected(self, reason: str) -> None:
         self.jobs_rejected.inc(1, reason=reason)
+
+    def autotune_decision(self, *, knob: str, value, acted: bool) -> None:
+        """Mirror one journaled ``autotune`` event into the live plane:
+        the knob gauge tracks the value in effect AFTER the decision
+        (the old value when the controller only observed)."""
+        if isinstance(value, (int, float)):
+            self.autotune_knob.set(float(value), knob=knob)
+        self.autotune_decisions.inc(
+            1, knob=knob, acted="true" if acted else "false"
+        )
 
     def batch_dispatch(
         self, *, n_jobs: int, n_clusters: int, window_wait_s: float,

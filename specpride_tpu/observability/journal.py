@@ -12,7 +12,10 @@ envelope**: a journal bound to a trace (``bind_trace``) stamps
 ``trace_id`` (32-hex) on every event it emits, the serving daemon's
 per-job events carry it explicitly (``TRACE_EVENT_FIELDS``), and
 ``span`` events gain ``span_id``/``parent_span_id`` so one causal tree
-spans processes.  v1–v3 journals (no ``mono`` / no trace fields) still
+spans processes.  v5 adds the **autotune** decision event (the
+closed-loop controller's evidence trail) and requires the elastic
+heartbeat to mirror its EWMA chunk wall (``chunk_s``) — both additive;
+v1–v4 journals (no ``mono`` / no trace fields / no autotune) still
 read and validate.  An operator can ``tail -f`` a live run's journal
 (every line is flushed as it is written) or feed one or more
 finished/dead journals to ``specpride stats`` for an aggregate
@@ -37,15 +40,16 @@ import re
 import threading
 import time
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 # versions read_events accepts: v2 added the monotonic `mono` envelope
 # field and the `span` event; v4 added the trace-context envelope
-# (trace_id / span ids) and the `clock_anchor` event.  v3 is reserved —
-# the live-telemetry-plane revision was docs-only, with no envelope
-# change, and the journal version skips it to keep the wire and docs
-# version numbers aligned; a v3 journal reads exactly like v2.
-ACCEPTED_VERSIONS = frozenset({1, 2, 3, SCHEMA_VERSION})
+# (trace_id / span ids) and the `clock_anchor` event; v5 added the
+# `autotune` decision event and the heartbeat `chunk_s` mirror.  v3 is
+# reserved — the live-telemetry-plane revision was docs-only, with no
+# envelope change, and the journal version skips it to keep the wire
+# and docs version numbers aligned; a v3 journal reads exactly like v2.
+ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, SCHEMA_VERSION})
 
 # event type -> required payload fields (the envelope v/ts/mono/event is
 # implied; extra fields are allowed — the schema is additive within a
@@ -157,6 +161,17 @@ EVENT_FIELDS: dict[str, frozenset] = {
          "status"}
     ),
     "serve_drain": frozenset({"n_rejected"}),
+    # closed-loop autotune controller (specpride_tpu.autotune, v5): one
+    # policy decision over one knob.  `mode` is the kill-switch position
+    # (observe|on); `acted` False means the decision was journaled
+    # without actuating (observe mode, or a no-change tick worth
+    # recording); `old`/`new` are the knob values; `reason` the policy's
+    # one-line justification; `signal` the windowed signal snapshot that
+    # triggered it — together the full evidence payload autotune-replay
+    # refolds to reproduce the decision
+    "autotune": frozenset(
+        {"knob", "mode", "old", "new", "reason", "signal", "acted"}
+    ),
     # on-demand device profiling (`specpride profile` against a live
     # daemon): one bounded jax.profiler capture window
     "profile_start": frozenset({"seconds"}),
@@ -184,6 +199,22 @@ TRACE_EVENT_FIELDS: dict[str, frozenset] = {
     "job_start": frozenset({"trace_id"}),
     "job_done": frozenset({"trace_id"}),
     "batch_dispatch": frozenset({"trace_ids"}),
+    # an autotune decision cites the traces active in its signal window
+    # as evidence (possibly empty — e.g. a fleet-spares decision between
+    # jobs); the field itself is mandatory from v5 on
+    "autotune": frozenset({"trace_ids"}),
+}
+
+# v5 additive requirements on PRE-EXISTING events: fields that became
+# mandatory at schema v5 but must not invalidate committed v4 journals
+# (the requirement is version-gated in validate_event, exactly like the
+# v4 trace envelope above).  heartbeat `chunk_s` is the per-rank EWMA
+# chunk wall steal targeting already consumes from the heartbeat STORE
+# record — mirrored into the journal event so post-mortems and the
+# elastic-range autotune policy read the same signal.  `specpride lint`
+# (journal-schema) enforces these at every emit site too.
+V5_EVENT_FIELDS: dict[str, frozenset] = {
+    "heartbeat": frozenset({"chunk_s"}),
 }
 
 _TRACE_ID_RE = re.compile(r"[0-9a-f]{32}")
@@ -214,13 +245,25 @@ class Journal:
     ``bind_trace(trace_id)`` stamps the v4 causal envelope: every
     subsequent event carries ``trace_id`` unless the emit names its own
     (one run journal = one trace; the multi-trace serving daemon leaves
-    its journal unbound and stamps per-job events explicitly)."""
+    its journal unbound and stamps per-job events explicitly).
+
+    ``set_tap(fn)`` installs an in-process observer called with every
+    record WHILE THE WRITE LOCK IS HELD — so the observer's fold order
+    is exactly the file's line order.  The autotune signal layer taps
+    its own journal this way, and pairs it with :meth:`emit_atomic`:
+    the controller snapshots its tapped state, decides, and writes the
+    decision in ONE critical section, so no concurrent worker event can
+    land between the evidence snapshot and the decision line — which is
+    what makes ``specpride autotune-replay`` deterministic."""
 
     enabled = True
 
     def __init__(self, path: str | os.PathLike, rotate_mb: float = 0.0):
         self.path = str(path)
         self.trace_id: str | None = None
+        # in-process observer of every emitted record (called under the
+        # write lock; must be fast and must never raise into the emit)
+        self._tap = None
         self.rotate_bytes = int(max(float(rotate_mb), 0.0) * 1024 * 1024)
         # one journal is shared by the CLI thread, the pipelined executor's
         # packer thread, and the fetch pool; a lock keeps each event line
@@ -248,7 +291,45 @@ class Journal:
         per-run causal envelope; None unbinds)."""
         self.trace_id = trace_id
 
-    def emit(self, event: str, **fields) -> dict:
+    def set_tap(self, tap) -> None:
+        """Install (or clear, with None) the per-record observer.  Tap
+        exceptions are swallowed: a broken observer must never take the
+        journal — and the run — down with it."""
+        with self._lock:
+            self._tap = tap
+
+    def attach_tap(self, tap) -> None:
+        """Install the per-record observer WITH CATCH-UP: every record
+        already in the journal (rotated segments first, then the live
+        file) is folded through ``tap`` before it goes live, all under
+        the write lock so no emit can interleave.  From the observer's
+        point of view its state is exactly ``fold(file so far)`` at
+        every instant — the invariant the offline refold audit
+        (``specpride autotune-replay``) holds live decisions to, which
+        a bare :meth:`set_tap` mid-run would silently break (events
+        from before the attach would be in the file but not the
+        fold)."""
+        with self._lock:
+            self._fh.flush()
+            segments = sorted(_numbered_segments(self.path))
+            for path in [seg for _n, seg in segments] + [self.path]:
+                try:
+                    fh = open(path, encoding="utf-8")
+                except OSError:
+                    continue
+                with fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except (ValueError, json.JSONDecodeError):
+                            continue  # torn tail line mid-write
+                        try:
+                            tap(rec)
+                        except Exception:
+                            pass  # same contract as the live tap
+            self._tap = tap
+
+    def _build_rec(self, event: str, fields: dict) -> dict:
         rec = {
             "v": SCHEMA_VERSION,
             "ts": time.time(),
@@ -258,19 +339,51 @@ class Journal:
         if self.trace_id is not None and "trace_id" not in fields:
             rec["trace_id"] = self.trace_id
         rec.update(fields)
+        return rec
+
+    def _write_locked(self, rec: dict) -> None:
+        """Serialize + append one record; caller holds the lock.  The
+        tap fires here — under the lock — so observer fold order is
+        exactly file line order."""
         line = json.dumps(rec, default=_json_default) + "\n"
+        # a multi-thread producer (the serving daemon's reader
+        # threads) may race close(); dropping a late event beats
+        # crashing the thread on a closed file
+        if not self._fh.closed:
+            self._fh.write(line)
+            # json.dumps default ensure_ascii output is pure ASCII,
+            # so the character count IS the byte count — no second
+            # encode on the hot path
+            self._bytes += len(line)
+            if self.rotate_bytes and self._bytes >= self.rotate_bytes:
+                self._rotate_locked()
+        if self._tap is not None:
+            try:
+                self._tap(rec)
+            except Exception:
+                pass
+
+    def emit(self, event: str, **fields) -> dict:
+        rec = self._build_rec(event, fields)
         with self._lock:
-            # a multi-thread producer (the serving daemon's reader
-            # threads) may race close(); dropping a late event beats
-            # crashing the thread on a closed file
-            if not self._fh.closed:
-                self._fh.write(line)
-                # json.dumps default ensure_ascii output is pure ASCII,
-                # so the character count IS the byte count — no second
-                # encode on the hot path
-                self._bytes += len(line)
-                if self.rotate_bytes and self._bytes >= self.rotate_bytes:
-                    self._rotate_locked()
+            self._write_locked(rec)
+        return rec
+
+    def emit_atomic(self, build) -> dict | None:
+        """Emit one event whose payload is COMPUTED under the write
+        lock: ``build()`` returns ``(event, fields)`` — or None to emit
+        nothing — and runs with no concurrent emit in flight, so state
+        it snapshots (e.g. the tapped signal fold) cannot drift between
+        snapshot and write.  This is the autotune controller's decision
+        primitive: evidence snapshot + policy + journal line are one
+        atomic step with respect to file order."""
+        with self._lock:
+            built = build()
+            if built is None:
+                return None
+            event, fields = built
+            rec = self._build_rec(event, fields)
+            self._write_locked(rec)
         return rec
 
     def _rotate_locked(self) -> None:
@@ -327,8 +440,17 @@ class NullJournal:
     def bind_trace(self, trace_id: str | None) -> None:
         pass
 
+    def set_tap(self, tap) -> None:
+        pass
+
+    def attach_tap(self, tap) -> None:
+        pass
+
     def emit(self, event: str, **fields) -> dict:
         return {}
+
+    def emit_atomic(self, build) -> dict | None:
+        return None
 
     def close(self) -> None:
         pass
@@ -402,6 +524,15 @@ def validate_event(rec: object) -> list[str]:
             problems.append(
                 f"{event}: missing v4 trace fields {missing}"
             )
+    # v5 additive requirements on pre-existing events (heartbeat
+    # chunk_s): gated exactly like the trace envelope, so committed
+    # v4 journals keep validating
+    if rec.get("v", 0) >= 5 and required is not None:
+        missing = sorted(
+            V5_EVENT_FIELDS.get(event, frozenset()) - rec.keys()
+        )
+        if missing:
+            problems.append(f"{event}: missing v5 fields {missing}")
     tid = rec.get("trace_id")
     if tid is not None and not (
         isinstance(tid, str) and _TRACE_ID_RE.fullmatch(tid)

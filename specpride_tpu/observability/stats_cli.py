@@ -255,6 +255,34 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
             if span > 0:
                 sv["jobs_per_sec"] = round(len(jobs) / span, 3)
         run["serving"] = sv
+    # closed-loop controller (--autotune): decision rollup + the full
+    # evidence-bearing log (rendered per-decision by `stats --autotune`,
+    # audited offline by `specpride autotune-replay`)
+    at_events = [e for e in events if e["event"] == "autotune"]
+    if at_events:
+        knobs: dict[str, dict] = {}
+        for e in at_events:
+            k = str(e.get("knob"))
+            row = knobs.setdefault(k, {"decisions": 0, "acted": 0})
+            row["decisions"] += 1
+            if e.get("acted"):
+                row["acted"] += 1
+                row["value"] = e.get("new")
+        run["autotune"] = {
+            "mode": at_events[-1].get("mode"),
+            "decisions": len(at_events),
+            "acted": sum(1 for e in at_events if e.get("acted")),
+            "knobs": knobs,
+            "log": [
+                {
+                    "knob": e.get("knob"), "old": e.get("old"),
+                    "new": e.get("new"), "acted": bool(e.get("acted")),
+                    "reason": e.get("reason"),
+                    "clock": e.get("clock"),
+                }
+                for e in at_events
+            ],
+        }
     if start:
         run.update(
             command=start.get("command"),
@@ -380,6 +408,39 @@ def _render_serving(sv: dict, out) -> None:
         )
 
 
+def _render_autotune(run: dict, out, detail: bool = False) -> None:
+    """The controller's at-a-glance line from the journal's `autotune`
+    events; ``stats --autotune`` adds the per-decision log (knob,
+    old -> new, acted, reason) — the human view of the evidence
+    `specpride autotune-replay` audits."""
+    at = run.get("autotune")
+    if not at:
+        if detail:
+            print(
+                "  autotune: no decisions in this journal (was the run "
+                "booted with --autotune observe|on?)", file=out,
+            )
+        return
+    per_knob = " ".join(
+        f"{k}={row['value']}"
+        for k, row in sorted(at.get("knobs", {}).items())
+        if "value" in row
+    )
+    print(
+        f"  autotune: mode={at.get('mode')} "
+        f"decisions={at.get('decisions', 0)} "
+        f"acted={at.get('acted', 0)}"
+        + (f" {per_knob}" if per_knob else ""), file=out,
+    )
+    if detail:
+        for d in at.get("log", ()):
+            mark = "acted" if d.get("acted") else "observed"
+            print(
+                f"    {d.get('knob')}: {d.get('old')} -> {d.get('new')} "
+                f"[{mark}] {d.get('reason')}", file=out,
+            )
+
+
 def _render_slo(run: dict, out) -> None:
     """``stats --slo``: the per-method SLO table from a serving
     journal's job_done evaluations (objective vs measured queue-wait +
@@ -438,7 +499,8 @@ def _render_rank_view(view: dict, out) -> None:
         print(f"  rank {rank}: {slow}{' '.join(bits)}", file=out)
 
 
-def _render_run(run: dict, out, slo: bool = False) -> None:
+def _render_run(run: dict, out, slo: bool = False,
+                autotune: bool = False) -> None:
     head = (
         f"{run['journal']}: {run.get('command', '?')}"
         f"/{run.get('method', '?')} backend={run.get('backend', '?')}"
@@ -463,6 +525,7 @@ def _render_run(run: dict, out, slo: bool = False) -> None:
             _render_serving(live, out)
             if slo:
                 _render_slo(run, out)
+        _render_autotune(run, out, detail=autotune)
         return
     counters = run.get("counters", {})
     print(
@@ -510,6 +573,7 @@ def _render_run(run: dict, out, slo: bool = False) -> None:
         _render_serving(run["serving"], out)
         if slo:
             _render_slo(run, out)
+    _render_autotune(run, out, detail=autotune)
     ws = run.get("warmstart")
     if ws:
         bits = []
@@ -667,6 +731,7 @@ def _poll_rotated(
 def follow_stats(
     path: str, out=None, interval: float = 1.0, stop=None,
     max_updates: int = 0, top_spans: int = 0, slo: bool = False,
+    autotune: bool = False,
 ) -> int:
     """``specpride stats --follow``: tail ONE live journal (a serving
     daemon's or a running batch job's) and re-render the summary every
@@ -709,7 +774,7 @@ def follow_stats(
                     f"event(s) ---", file=out,
                 )
                 _render_run(_summarize_run(path, segments[-1]), out,
-                            slo=slo)
+                            slo=slo, autotune=autotune)
                 from specpride_tpu.parallel.elastic import (
                     summarize_ranks,
                 )
@@ -736,7 +801,7 @@ def follow_stats(
 
 def run_stats(
     journal_paths: list[str], json_out: str | None = None, out=None,
-    top_spans: int = 0, slo: bool = False,
+    top_spans: int = 0, slo: bool = False, autotune: bool = False,
 ) -> int:
     out = out or sys.stdout
     files: list[str] = []
@@ -764,7 +829,7 @@ def run_stats(
             runs.append(_summarize_run(label, seg))
 
     for run in runs:
-        _render_run(run, out, slo=slo)
+        _render_run(run, out, slo=slo, autotune=autotune)
     # cross-rank fleet view: elastic liveness/reassignment rollup over
     # ALL the journals read (the per-rank .part shards merge here)
     from specpride_tpu.parallel.elastic import summarize_ranks

@@ -231,7 +231,17 @@ class Coordinator:
         self._lock = threading.Lock()
         self._held: dict[int, Claim] = {}
         self._cuts: dict[int, int] = {}  # range -> ratified cut (global)
+        # autotune's --elastic-range actuator (ROADMAP item 4b): caps
+        # how many clusters a donor cedes per ratified split.  None =
+        # classic steal-half, byte parity with pre-autotune behavior.
+        self._split_hint: int | None = None
         self._progress: dict[int, dict] = {}  # range -> {done, chunk_s}
+        # rank-level EWMA chunk wall: what the journal heartbeat's
+        # chunk_s (v5) carries.  Deliberately NOT the held-range view
+        # above (which empties the moment a range commits): the rank's
+        # measured pace outlives any one range, and the autotune
+        # elastic policy reads it at drain time, after the last commit
+        self._chunk_s_ewma: float | None = None
         self._done_cache: set[int] = set()  # commit markers never vanish
         self._stop = threading.Event()
         os.makedirs(os.path.join(self.local_dir, "ck"), exist_ok=True)
@@ -783,6 +793,7 @@ class Coordinator:
                 return None
             rng = self._by_id[k]
             cut = self._cuts.get(k)
+            hint = self._split_hint
         if cut is not None:
             return max(cut - rng.start, 0)
         if not self.steal_enabled or next_min_idx <= 0:
@@ -802,6 +813,13 @@ class Coordinator:
         chunk = max(self.chunk_hint, 1)
         remaining = rng.stop - (rng.start + int(next_min_idx))
         keep = max((remaining // 2) // chunk, 1) * chunk
+        if hint:
+            # autotune cap: cede at most ~hint clusters (whole chunks,
+            # at least one) so split-off tails land near the tuned
+            # range size.  Only ever GROWS keep — the donor's committed
+            # frontier and byte parity are untouched either way.
+            cede = max(int(hint) // chunk, 1) * chunk
+            keep = max(keep, remaining - cede)
         cut_global = rng.start + int(next_min_idx) + keep
         if cut_global >= rng.stop:
             # nothing left to give: publish a declined cut so the
@@ -836,6 +854,19 @@ class Coordinator:
             rng.stop - cut_global, new_id,
         )
         return max(cut_global - rng.start, 0)
+
+    @property
+    def split_hint(self) -> int | None:
+        with self._lock:
+            return self._split_hint
+
+    def set_split_hint(self, n: int | None) -> None:
+        """Autotune's ``elastic_range`` actuator: future ratified splits
+        cede at most ~``n`` clusters (rounded to whole chunks).  Applies
+        only to ranges not yet cut — never resizes claimed work, so
+        output byte parity is untouched.  ``None`` restores steal-half."""
+        with self._lock:
+            self._split_hint = int(n) if n else None
 
     def check_lease(self, k: int) -> None:
         """The basic fence: raise :class:`LeaseExpiredError` when this
@@ -881,6 +912,11 @@ class Coordinator:
                     prog["chunk_s"] = (
                         dt if prev is None
                         else _EWMA_ALPHA * dt + (1 - _EWMA_ALPHA) * prev
+                    )
+                    self._chunk_s_ewma = (
+                        dt if self._chunk_s_ewma is None
+                        else _EWMA_ALPHA * dt
+                        + (1 - _EWMA_ALPHA) * self._chunk_s_ewma
                     )
         if (
             cut is not None and max_idx is not None
@@ -935,6 +971,7 @@ class Coordinator:
                 for k, p in self._progress.items()
                 if k in self._held
             }
+            chunk_s_ewma = self._chunk_s_ewma
         for claim, k in zip(claims, held):
             # renewal = an atomic freshness bump (utime on the
             # filesystem, ETag-guarded rewrite on the object store).
@@ -958,8 +995,17 @@ class Coordinator:
             },
         )
         if self.journal is not None:
+            # chunk_s (v5): this rank's EWMA chunk wall — the autotune
+            # signal fold's elastic-plane input.  The RANK-level EWMA,
+            # not the held-range progress view above: that view empties
+            # at every range commit, and the policy must still see the
+            # measured pace at the end-of-run drain tick
             self.journal.emit(
                 "heartbeat", rank=self.rank, holding=held, ttl=self.ttl,
+                chunk_s=(
+                    round(chunk_s_ewma, 4)
+                    if chunk_s_ewma is not None else None
+                ),
             )
             # the clock anchor rides the heartbeat cadence: a long
             # elastic run's journal stays wall-alignable (bounded skew)
@@ -1006,6 +1052,20 @@ class Coordinator:
             timeout if timeout is not None
             else min(self.heartbeat_interval, 0.5)
         )
+
+    def flush_progress(self) -> None:
+        """Publish one immediate heartbeat (store mirror + journal
+        event) with the current progress view.  A rank that finishes
+        its whole workload inside one heartbeat interval never reaches
+        a timed beat with chunk walls folded in — a caller about to
+        evaluate the journal's heartbeat signal (the autotune drain
+        tick) asks for the final EWMA explicitly."""
+        try:
+            self._beat()
+        except OSError as e:
+            logger.warning(
+                "rank %d flush heartbeat failed: %s", self.rank, e,
+            )
 
     def stop(self) -> None:
         self._stop.set()

@@ -85,6 +85,7 @@ class FleetSupervisor:
         poll_interval: float = 0.5,
         scale_horizon: float = DEFAULT_SCALE_HORIZON_S,
         env: dict | None = None,
+        autotune: str = "off",
     ):
         spec = extract_flag(job_argv, "--elastic")
         if not spec:
@@ -124,6 +125,29 @@ class FleetSupervisor:
         self.replaced = 0
         self.failures: list[str] = []
         self._done_cache: set[str] = set()
+        if autotune not in ("off", "observe", "on"):
+            raise ValueError(
+                f"fleet autotune {autotune!r} must be off, observe or on"
+            )
+        self.autotune = autotune
+        self.controller = None
+        if autotune != "off":
+            if journal is None or not getattr(journal, "enabled", False):
+                raise ValueError(
+                    "fleet --autotune observe|on requires --journal: "
+                    "every decision must be journaled as evidence"
+                )
+            from specpride_tpu.autotune.controller import Controller
+            from specpride_tpu.autotune.policy import FleetSparesPolicy
+            ctl = Controller(journal, mode=autotune)
+            ctl.register(
+                FleetSparesPolicy(
+                    lo=0, hi=max(self.max_ranks - self.ranks, 0),
+                ),
+                get=lambda: self.spares,
+                set=lambda n: setattr(self, "spares", max(int(n), 0)),
+            )
+            self.controller = ctl
 
     # -- store views -----------------------------------------------------
 
@@ -315,8 +339,28 @@ class FleetSupervisor:
                         "no worker alive and no plan registered"
                     )
                     return 1
+                if self.controller is not None:
+                    # synchronous tick from the poll loop (no thread):
+                    # the store-derived pressure view rides the decision
+                    # as snapshot extras — recorded evidence, since it
+                    # is not derivable from this journal alone
+                    proposals = sum(
+                        1 for key in self.store.list_keys("split/")
+                        if ".cut." not in key
+                    )
+                    stale = sum(
+                        1 for hb, age in self._heartbeats()
+                        if not hb.get("stopped")
+                        and age > hb.get("ttl", self.ttl) + self.grace
+                    )
+                    self.controller.tick({
+                        "steal_proposals": proposals,
+                        "stale_ranks": stale,
+                    })
                 time.sleep(self.poll_interval)
         finally:
+            if self.controller is not None:
+                self.controller.close()
             for proc in self.procs:
                 if proc.poll() is None:
                     proc.terminate()
@@ -348,4 +392,10 @@ class FleetSupervisor:
             "retired": self.retired,
             "replaced": self.replaced,
             "failures": list(self.failures),
+            **(
+                {"autotune": {
+                    **self.controller.status(), "spares": self.spares,
+                }}
+                if self.controller is not None else {}
+            ),
         }

@@ -177,6 +177,9 @@ class ServeDaemon:
         slo: dict | None = None,
         batch_window: float = 0.0,
         batch_max_clusters: int = 4096,
+        autotune: str = "off",
+        autotune_interval: float = 1.0,
+        autotune_batch_window: tuple | None = None,
     ):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.compile_cache = compile_cache
@@ -220,6 +223,13 @@ class ServeDaemon:
         self.jobs_done = 0
         self.jobs_failed = 0
         self.jobs_rejected = 0
+        # live-config plane: knobs the autotune controller may move
+        # while workers run read their CURRENT value under this lock
+        # (one lock, leaf-level — never held while calling out), so a
+        # reader can never observe a torn write.  With --autotune off
+        # nothing ever writes after boot and the locked read returns
+        # the boot value forever — byte-identical behavior.
+        self._live_lock = threading.Lock()
         # cross-job micro-batching (serve.batcher): a worker that pops a
         # batch-eligible job pulls further COMPATIBLE queued jobs for up
         # to batch_window seconds (0 = off) and runs their cluster work
@@ -228,6 +238,27 @@ class ServeDaemon:
         # byte-identical to solo runs (see serve.batcher)
         self.batch_window = max(float(batch_window), 0.0)
         self.batch_max_clusters = max(int(batch_max_clusters), 1)
+        # closed-loop autotune (specpride_tpu.autotune): off = no
+        # controller object exists at all; observe = decisions are
+        # journaled, nothing actuates; on = batch-window and active-lane
+        # knobs move live through the _live_lock paths above
+        if autotune not in ("off", "observe", "on"):
+            raise ValueError(
+                f"autotune mode {autotune!r} must be off, observe or on"
+            )
+        self.autotune = autotune
+        self._active_workers_v: int | None = None  # None = all lanes
+        self.autotune_interval = max(float(autotune_interval), 0.05)
+        self.autotune_batch_window = (
+            tuple(autotune_batch_window)
+            if autotune_batch_window is not None else (0.0, 50.0)
+        )
+        self.controller = None  # autotune.Controller, built at boot
+        self._controller_thread = None
+        # worker parking (autotune workers knob) needs lanes to poll the
+        # pop so a parked lane can re-check; every other mode keeps the
+        # blocking pop — the exact pre-autotune behavior
+        self._pop_timeout = 0.2 if autotune == "on" else None
         self.batches_dispatched = 0
         self.jobs_batched = 0
         self._batch_ids = iter(range(1, 1 << 62)).__next__
@@ -267,6 +298,39 @@ class ServeDaemon:
         the sampler that need the full per-worker map read
         ``_inflight_by``)."""
         return next(iter(self._inflight_by.values()), None)
+
+    # -- live-config knobs (the autotune actuation plane) ----------------
+
+    @property
+    def batch_window(self) -> float:
+        """The micro-batch collection window in SECONDS, read under the
+        live-config lock at every use site (admission's eligibility
+        stamp, the collector's deadline) — so the controller can move
+        it between jobs without a worker ever seeing a torn value."""
+        with self._live_lock:
+            return self._batch_window_v
+
+    @batch_window.setter
+    def batch_window(self, value) -> None:
+        with self._live_lock:
+            self._batch_window_v = max(float(value), 0.0)
+
+    @property
+    def active_workers(self) -> int:
+        """Execution lanes currently picking up work: the worker-count
+        knob parks lanes ``wid >= active_workers`` (they finish their
+        current job, then idle) instead of destroying their warm
+        backends — unparking is instant."""
+        with self._live_lock:
+            n = self._active_workers_v
+        return n if n is not None else max(len(self.slots), 1)
+
+    @active_workers.setter
+    def active_workers(self, value) -> None:
+        with self._live_lock:
+            self._active_workers_v = min(
+                max(int(value), 1), max(len(self.slots), 1)
+            )
 
     # -- boot -----------------------------------------------------------
 
@@ -364,6 +428,7 @@ class ServeDaemon:
                 port=self.metrics_port, health=self._healthz,
             ).start()
         self._boot_warmup(state)
+        self._boot_autotune()
         sock_dir = os.path.dirname(self.socket_path)
         if sock_dir:
             os.makedirs(sock_dir, exist_ok=True)
@@ -399,6 +464,11 @@ class ServeDaemon:
             **({"slo": self.slo} if self.slo else {}),
             **({"quota": {c: repr(q) for c, q in self.quotas.items()}}
                if self.quotas else {}),
+            **({"autotune": self.autotune,
+                "autotune_interval_s": self.autotune_interval,
+                "autotune_batch_window_ms": list(
+                    self.autotune_batch_window)}
+               if self.autotune != "off" else {}),
         )
         logger.info(
             "serving on %s (boot %.2fs, %d kernel variants warmed, "
@@ -410,6 +480,55 @@ class ServeDaemon:
         if self.exporter is not None:
             logger.info("live metrics on %s", self.exporter.url)
         return self
+
+    def _boot_autotune(self) -> None:
+        """Construct the closed-loop controller (``--autotune
+        observe|on``): one :class:`~specpride_tpu.autotune.Controller`
+        tapping the daemon journal, with the batch-window and
+        active-lane policies bound to the locked live-config knobs.
+        ``off`` builds nothing — the kill switch is the absence of the
+        controller, so an off daemon is byte-identical to pre-autotune
+        behavior."""
+        if self.autotune == "off":
+            return
+        if not self.journal.enabled:
+            raise SystemExit(
+                "serve --autotune observe|on requires --journal: every "
+                "decision must be journaled as evidence"
+            )
+        from specpride_tpu.autotune import (
+            BatchWindowPolicy,
+            Controller,
+            ControllerThread,
+            WorkerPolicy,
+        )
+
+        lo_ms, hi_ms = self.autotune_batch_window
+        ctl = Controller(
+            self.journal, mode=self.autotune, telemetry=self.telemetry,
+        )
+        ctl.register(
+            BatchWindowPolicy(lo_ms=lo_ms, hi_ms=hi_ms),
+            get=lambda: round(self.batch_window * 1000.0, 3),
+            set=lambda ms: setattr(
+                self, "batch_window", float(ms) / 1000.0
+            ),
+        )
+        ctl.register(
+            WorkerPolicy(lo=1, hi=len(self.slots)),
+            get=lambda: self.active_workers,
+            set=lambda n: setattr(self, "active_workers", int(n)),
+        )
+        self.controller = ctl
+        self._controller_thread = ControllerThread(
+            ctl, interval=self.autotune_interval,
+        ).start()
+        logger.info(
+            "autotune %s: knobs %s, tick %.2fs, batch-window clamp "
+            "[%g, %g] ms", self.autotune,
+            ",".join(ctl.status()["knobs"]), self.autotune_interval,
+            lo_ms, hi_ms,
+        )
 
     def _sample_live(self, telemetry) -> None:
         """Scrape-time gauge refresh — every ``/metrics`` GET (and the
@@ -924,8 +1043,19 @@ class ServeDaemon:
 
     def _worker_loop(self, wid: int) -> None:
         while True:
-            job = self.queue.pop()
+            if self._pop_timeout is not None and \
+                    wid >= self.active_workers:
+                # parked lane (autotune workers knob, mode on): the
+                # warm backend idles — finish nothing new until the
+                # controller unparks this lane or the daemon drains
+                if self._stop.wait(self._pop_timeout):
+                    return
+                continue
+            job = self.queue.pop(timeout=self._pop_timeout)
             if job is None:
+                if self._pop_timeout is not None and \
+                        not self._stop.is_set():
+                    continue  # poll tick: re-check parking, not a drain
                 return
             self._inflight_by[wid] = job
             self._gate.wait()
@@ -1332,6 +1462,12 @@ class ServeDaemon:
         self._stop.set()
         if self.journal is None:
             return  # boot never completed; nothing to flush or reject
+        # the controller stops FIRST: a tick racing the final
+        # serve_drain/run_end emits (or the journal close below) would
+        # interleave a decision into the drain epilogue
+        if self._controller_thread is not None:
+            self._controller_thread.stop()
+            self._controller_thread = None
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -1447,6 +1583,16 @@ class ServeDaemon:
                 if self.exporter is not None else {}
             ),
             **({"slo": self.slo} if self.slo else {}),
+            **(
+                {"autotune": {
+                    **self.controller.status(),
+                    "batch_window_ms": round(
+                        self.batch_window * 1000.0, 3
+                    ),
+                    "active_workers": self.active_workers,
+                }}
+                if self.controller is not None else {}
+            ),
         }
 
     @staticmethod

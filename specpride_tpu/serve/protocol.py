@@ -116,6 +116,9 @@ DAEMON_ONLY_FLAGS = (
     # trace would race concurrent worker lanes (and any `specpride
     # profile` capture).  Profile the daemon itself instead.
     "--trace-dir",
+    # the closed-loop controller is a process-wide plane (the daemon
+    # boots its own via serve --autotune); a job cannot carry one
+    "--autotune",
 )
 
 # `specpride submit` exit code for a retriable non-success (BSD
@@ -177,7 +180,7 @@ _DAEMON_OWNED_DESTS = (
     "precision", "no_donate",
     "mesh", "coordinator", "num_processes", "process_id", "metrics_out",
     "elastic", "elastic_steal", "elastic_local", "metrics_port",
-    "trace_dir",
+    "trace_dir", "autotune",
 )
 
 _daemon_owned_defaults: dict | None = None

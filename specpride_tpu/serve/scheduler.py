@@ -179,6 +179,23 @@ class AdmissionQueue:
             st = self._states[client] = _ClientState(quota)
         return st
 
+    def set_quotas(self, quotas: dict[str, Quota] | None) -> None:
+        """Replace the quota table LIVE, under the queue's one condition
+        variable — the locked live-config path the autotune plane (and
+        operators via future reload verbs) actuates through.  Every
+        existing client state is re-resolved against the new table in
+        the same critical section, so no pop/offer can ever observe a
+        half-applied table (old map, new per-client quota, or vice
+        versa); inflight counts and deficit clocks carry over untouched.
+        Waiters are woken: a raised cap can make a parked client
+        eligible right now."""
+        with self._cond:
+            self.quotas = dict(quotas or {})
+            for client, st in self._states.items():
+                st.quota = self.quotas.get(client) \
+                    or self.quotas.get("*") or _NO_QUOTA
+            self._cond.notify_all()
+
     def __len__(self) -> int:
         with self._cond:
             return self._total

@@ -1,0 +1,649 @@
+"""Autotune subsystem tests: pure policies, shared hysteresis gating,
+the journal-tap signal fold, controller end-to-end through a real
+journal, decision replay (including tamper detection), and torn-read
+hammers on the locked live-config paths the controller actuates.
+
+The end-to-end tests use the same deterministic recipe as CI: a fake
+monotonic clock (a mutable list cell) drives the controller, so
+cooldown windows advance exactly when the test says they do.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from specpride_tpu.autotune.controller import (
+    Controller,
+    ControllerThread,
+    evaluate,
+)
+from specpride_tpu.autotune.policy import (
+    BatchWindowPolicy,
+    ElasticRangePolicy,
+    FleetSparesPolicy,
+    WorkerPolicy,
+    parse_clamp,
+    policy_from_params,
+)
+from specpride_tpu.autotune.replay import replay_journal
+from specpride_tpu.autotune.signals import SignalState
+from specpride_tpu.observability.journal import Journal, read_events
+from specpride_tpu.serve.scheduler import AdmissionQueue, Quota
+
+TRACE = "ab" * 16  # any 32-hex id satisfies the v4 trace envelope
+
+
+# -- policies: pure decisions over (signal, current, params) ------------
+
+
+class TestBatchWindowPolicy:
+    def setup_method(self):
+        self.p = BatchWindowPolicy(lo_ms=5.0, hi_ms=25.0, queue_hi=3)
+
+    def test_widen_from_floor_on_queue_depth(self):
+        got = self.p.decide({"queue_depth": 4}, 0.0)
+        assert got is not None
+        new, reason = got
+        assert new == 5.0
+        assert "queue depth 4" in reason
+
+    def test_widen_doubles_and_clamps(self):
+        assert self.p.decide({"queue_depth": 3}, 5.0)[0] == 10.0
+        assert self.p.decide({"queue_depth": 9}, 20.0)[0] == 25.0
+
+    def test_no_decision_below_queue_hi(self):
+        assert self.p.decide({"queue_depth": 2}, 0.0) is None
+
+    def test_zero_floor_seeds_first_widen(self):
+        # lo=0 must not make "widen from the floor" a no-op forever
+        p = BatchWindowPolicy(lo_ms=0.0, hi_ms=50.0, queue_hi=3)
+        assert p.decide({"queue_depth": 4}, 0.0)[0] == 1.0
+        assert p.decide({"queue_depth": 4}, 1.0)[0] == 2.0
+
+    def test_no_decision_at_ceiling(self):
+        assert self.p.decide({"queue_depth": 9}, 25.0) is None
+
+    def test_shrink_on_idle_solo_dispatches(self):
+        signal = {
+            "queue_depth": 0,
+            "jobs": {"n": 4},
+            "batch": {"jobs_mean": 1.0},
+        }
+        new, reason = self.p.decide(signal, 20.0)
+        assert new == 10.0
+        assert "shrink" in reason
+
+    def test_no_shrink_while_coalescing(self):
+        signal = {
+            "queue_depth": 0,
+            "jobs": {"n": 4},
+            "batch": {"jobs_mean": 3.0},  # window is earning its keep
+        }
+        assert self.p.decide(signal, 20.0) is None
+
+    def test_no_shrink_without_recent_jobs(self):
+        # an idle daemon is not evidence the window is too long
+        assert self.p.decide({"queue_depth": 0}, 20.0) is None
+
+
+class TestWorkerPolicy:
+    def setup_method(self):
+        self.p = WorkerPolicy(lo=1, hi=4, burn_hi=0.1, busy_lo=0.25,
+                              min_slo_jobs=3)
+
+    def test_unpark_on_slo_burn(self):
+        signal = {"jobs": {"slo_jobs": 10, "slo_breaches": 3}}
+        new, reason = self.p.decide(signal, 2)
+        assert new == 3
+        assert "unpark" in reason
+
+    def test_burn_needs_min_slo_jobs(self):
+        signal = {"jobs": {"slo_jobs": 2, "slo_breaches": 2}}
+        assert self.p.decide(signal, 2) is None
+
+    def test_unpark_clamped_at_pool_size(self):
+        signal = {"jobs": {"slo_jobs": 10, "slo_breaches": 9}}
+        assert self.p.decide(signal, 4) is None
+
+    def test_park_on_low_busy_fraction(self):
+        signal = {
+            "window_s": 30.0,
+            "queue_depth": 0,
+            "jobs": {"n": 3, "busy_s": 1.0, "slo_breaches": 0},
+        }
+        new, reason = self.p.decide(signal, 4)
+        assert new == 3
+        assert "park" in reason
+
+    def test_never_parks_below_floor(self):
+        signal = {
+            "window_s": 30.0,
+            "queue_depth": 0,
+            "jobs": {"n": 3, "busy_s": 0.0, "slo_breaches": 0},
+        }
+        assert self.p.decide(signal, 1) is None
+
+
+class TestElasticRangePolicy:
+    def setup_method(self):
+        self.p = ElasticRangePolicy(lo=8, hi=512, target_s=30.0,
+                                    chunk_hint=8)
+
+    def test_no_decision_on_stale_evidence(self):
+        assert self.p.decide({}, 64) is None
+        assert self.p.decide({"heartbeats": {"ranks": 2}}, 64) is None
+
+    def test_sizes_split_to_target_chunk_aligned(self):
+        # 8-cluster chunks take 4s -> 0.5s/cluster -> 60 clusters for
+        # 30s, aligned down to 56 (a multiple of the chunk hint)
+        signal = {"heartbeats": {"ranks": 2, "chunk_s_mean": 4.0}}
+        new, reason = self.p.decide(signal, 64)
+        assert new == 56
+        assert new % 8 == 0
+        assert "30.0s" in reason
+
+    def test_clamps_to_bounds(self):
+        fast = {"heartbeats": {"ranks": 1, "chunk_s_mean": 0.001}}
+        assert self.p.decide(fast, 64)[0] == 512
+        slow = {"heartbeats": {"ranks": 1, "chunk_s_mean": 400.0}}
+        assert self.p.decide(slow, 64)[0] == 8
+
+    def test_no_op_suppressed(self):
+        signal = {"heartbeats": {"ranks": 2, "chunk_s_mean": 4.0}}
+        assert self.p.decide(signal, 56) is None
+
+
+class TestFleetSparesPolicy:
+    def setup_method(self):
+        self.p = FleetSparesPolicy(lo=0, hi=2, pressure_hi=1)
+
+    def test_add_spare_on_steal_pressure(self):
+        signal = {"store": {"steal_proposals": 2, "stale_ranks": 0}}
+        new, reason = self.p.decide(signal, 0)
+        assert new == 1
+        assert "steal pressure" in reason
+
+    def test_add_spare_on_stale_rank(self):
+        signal = {"store": {"steal_proposals": 0, "stale_ranks": 1}}
+        assert self.p.decide(signal, 1) == (
+            2, "steal pressure (proposals=0, stale_ranks=1): "
+               "add a warm spare")
+
+    def test_clamped_at_hi(self):
+        signal = {"store": {"steal_proposals": 5, "stale_ranks": 2}}
+        assert self.p.decide(signal, 2) is None
+
+    def test_retire_on_quiet_window(self):
+        signal = {"store": {"steal_proposals": 0, "stale_ranks": 0}}
+        assert self.p.decide(signal, 2)[0] == 1
+        assert self.p.decide(signal, 0) is None  # already at floor
+
+
+class TestPolicyPlumbing:
+    def test_parse_clamp(self):
+        assert parse_clamp("5:25") == (5.0, 25.0)
+        assert parse_clamp("0:0") == (0.0, 0.0)
+        for bad in ("5", "hi:25", "25:5", "-1:5"):
+            with pytest.raises(ValueError):
+                parse_clamp(bad)
+
+    def test_policy_from_params_roundtrip(self):
+        src = BatchWindowPolicy(lo_ms=2.0, hi_ms=9.0, queue_hi=7)
+        rebuilt = policy_from_params("batch_window_ms", dict(src.params))
+        assert rebuilt.params == src.params
+
+    def test_policy_from_params_ignores_unknown_keys(self):
+        p = policy_from_params("workers", {"hi": 8, "from_the_future": 1})
+        assert p.params["hi"] == 8
+        assert "from_the_future" not in p.params
+
+    def test_policy_from_params_unknown_knob_raises(self):
+        with pytest.raises(ValueError, match="unknown autotune knob"):
+            policy_from_params("warp_factor", {})
+
+
+# -- the shared gate live ticks and replay both run ---------------------
+
+
+class TestEvaluateGating:
+    def setup_method(self):
+        self.p = BatchWindowPolicy(lo_ms=5.0, hi_ms=25.0, queue_hi=3,
+                                   cooldown_s=2.0, deadband=0.2)
+        self.busy = {"now": 100.0, "queue_depth": 4}
+
+    def test_passes_policy_decision_through(self):
+        assert evaluate(self.p, self.busy, 5.0, None) == (
+            10.0, "queue depth 4 >= 3: widen window to coalesce "
+                  "queued jobs")
+
+    def test_cooldown_suppresses(self):
+        assert evaluate(self.p, self.busy, 5.0, 99.0) is None
+        # exactly at the cooldown boundary the knob is free again
+        assert evaluate(self.p, self.busy, 5.0, 98.0) is not None
+
+    def test_deadband_suppresses_small_relative_moves(self):
+        p = FleetSparesPolicy(lo=0, hi=100)
+        p.params["deadband"] = 0.2
+        quiet = {"now": 0.0,
+                 "store": {"steal_proposals": 0, "stale_ranks": 0}}
+        # 50 -> 49 is a 2% move: inside the deadband, suppressed
+        assert evaluate(p, quiet, 50, None) is None
+        # 2 -> 1 is a 50% move: clears it
+        assert evaluate(p, quiet, 2, None) == (
+            1, "no steal pressure in window: retire a warm spare")
+
+    def test_policy_none_is_none(self):
+        assert evaluate(self.p, {"now": 0.0, "queue_depth": 0},
+                        5.0, None) is None
+
+
+# -- signal fold --------------------------------------------------------
+
+
+class TestSignalFold:
+    def test_queue_depth_is_a_counter_fold(self):
+        s = SignalState(30.0)
+        for _ in range(3):
+            s.observe({"event": "job_queued", "mono": 1.0})
+        s.observe({"event": "job_start", "mono": 2.0})
+        assert s.snapshot(5.0)["queue_depth"] == 2
+        s.observe({"event": "job_start", "mono": 3.0})
+        s.observe({"event": "job_start", "mono": 4.0})
+        s.observe({"event": "job_start", "mono": 5.0})  # never negative
+        assert s.snapshot(6.0)["queue_depth"] == 0
+
+    def test_job_window_sections_and_pruning(self):
+        s = SignalState(10.0)
+        s.observe({"event": "job_done", "mono": 1.0, "wall_s": 4.0,
+                   "queue_wait_s": 1.0, "status": "done",
+                   "slo_ok": False, "trace_id": "aa" * 16})
+        s.observe({"event": "job_done", "mono": 8.0, "wall_s": 2.0,
+                   "queue_wait_s": 0.0, "status": "done",
+                   "slo_ok": True, "trace_id": "bb" * 16})
+        snap = s.snapshot(9.0)
+        jobs = snap["jobs"]
+        assert jobs["n"] == 2 and jobs["done"] == 2
+        assert jobs["wall_mean_s"] == 3.0 and jobs["busy_s"] == 6.0
+        assert jobs["slo_jobs"] == 2 and jobs["slo_breaches"] == 1
+        assert jobs["age_s"] == 1.0
+        # the first job ages out of the window; the section re-derives
+        snap = s.snapshot(12.0)
+        assert snap["jobs"]["n"] == 1
+        assert snap["jobs"]["slo_breaches"] == 0
+
+    def test_batch_and_heartbeat_sections(self):
+        s = SignalState(30.0)
+        s.observe({"event": "batch_dispatch", "mono": 1.0, "n_jobs": 3,
+                   "window_wait_s": 0.01, "bucket_occupancy_frac": 0.5,
+                   "trace_ids": ["cc" * 16]})
+        s.observe({"event": "batch_dispatch", "mono": 2.0, "n_jobs": 1,
+                   "window_wait_s": 0.03, "bucket_occupancy_frac": 0.9})
+        s.observe({"event": "heartbeat", "mono": 3.0, "rank": 0,
+                   "chunk_s": 4.0})
+        s.observe({"event": "heartbeat", "mono": 4.0, "rank": 1,
+                   "chunk_s": 2.0})
+        snap = s.snapshot(5.0)
+        assert snap["batch"]["n"] == 2
+        assert snap["batch"]["jobs_mean"] == 2.0
+        assert snap["batch"]["solo"] == 1
+        assert snap["batch"]["occupancy_mean"] == 0.7
+        hb = snap["heartbeats"]
+        assert hb["ranks"] == 2 and hb["stale_ranks"] == 0
+        assert hb["chunk_s_mean"] == 3.0 and hb["chunk_s_max"] == 4.0
+        # a rank whose beat falls out of the window goes stale, and its
+        # wall stops feeding the mean
+        snap = s.snapshot(33.5)
+        assert snap["heartbeats"]["stale_ranks"] == 1
+        assert snap["heartbeats"]["chunk_s_mean"] == 2.0
+
+    def test_recent_traces_distinct_newest_first_order(self):
+        s = SignalState(30.0)
+        for i, tid in enumerate(["t1", "t2", "t1", "t3"]):
+            s.observe({"event": "job_done", "mono": float(i),
+                       "wall_s": 0.1, "status": "done",
+                       "trace_id": tid})
+        assert s.recent_traces() == ["t2", "t1", "t3"]
+        assert s.recent_traces(n=2) == ["t1", "t3"]
+
+    def test_unknown_and_autotune_events_ignored(self):
+        s = SignalState(30.0)
+        s.observe({"event": "autotune", "mono": 1.0, "knob": "workers"})
+        s.observe({"event": "from_the_future", "mono": 1.0})
+        s.observe("not a dict")
+        s.observe({"event": "job_queued"})  # no mono: dropped
+        assert s.snapshot(2.0)["queue_depth"] == 0
+
+
+# -- controller end-to-end over a real journal --------------------------
+
+
+def _drive(journal_path, mode):
+    """The deterministic widen/widen/shrink scenario: returns the
+    journal path, the final knob value, and the decisions list."""
+    clock = [100.0]
+    value = [0.0]
+    j = Journal(journal_path)
+    ctl = Controller(j, mode=mode, window_s=30.0,
+                     clock=lambda: clock[0])
+    ctl.register(
+        BatchWindowPolicy(lo_ms=5.0, hi_ms=25.0, queue_hi=3,
+                          cooldown_s=2.0),
+        get=lambda: value[0],
+        set=lambda v: value.__setitem__(0, v),
+    )
+    decisions = []
+    for i in range(4):
+        j.emit("job_queued", job_id=i, client="t", trace_id=TRACE)
+    decisions += ctl.tick()             # widen 0 -> 5 (queue depth 4)
+    clock[0] += 10.0                    # clear the cooldown
+    decisions += ctl.tick()             # widen again (5 -> 10)
+    for i in range(4):
+        j.emit("job_start", job_id=i, trace_id=TRACE)
+        j.emit("job_done", job_id=i, status="done", wall_s=0.01,
+               queue_wait_s=0.0, trace_id=TRACE)
+    j.emit("batch_dispatch", batch_id=1, jobs=[3], n_jobs=1,
+           n_clusters=1, window_wait_s=0.0, status="shared",
+           trace_ids=[TRACE])
+    clock[0] += 10.0
+    decisions += ctl.tick()             # shrink (queue idle, solo)
+    clock[0] += 10.0
+    decisions += ctl.tick()             # steady state: no decision
+    ctl.close()
+    j.close()
+    return value[0], decisions
+
+
+class TestControllerEndToEnd:
+    def test_on_mode_acts_and_journals_evidence(self, tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        final, decisions = _drive(path, "on")
+        assert [d["new"] for d in decisions] == [5.0, 10.0, 5.0]
+        assert all(d["acted"] for d in decisions)
+        assert final == 5.0  # the knob cell was actually moved
+        events, violations = read_events(path)
+        assert violations == []
+        at = [e for e in events if e["event"] == "autotune"]
+        assert len(at) == 3
+        for e in at:
+            # the evidence contract: every decision self-describes
+            assert e["knob"] == "batch_window_ms"
+            assert e["mode"] == "on"
+            assert e["reason"]
+            assert e["signal"]["now"] == e["clock"]
+            assert e["params"]["lo_ms"] == 5.0
+        # the shrink decision cites the window's traces as evidence
+        # (the widen ticks ran before any job_done/batch_dispatch
+        # carried a trace into the fold)
+        assert at[2]["trace_ids"] == [TRACE]
+        # decision lines land in fold order: the widen tick's evidence
+        # shows the queue the worker events built BEFORE it
+        assert at[0]["signal"]["queue_depth"] == 4
+
+    def test_observe_mode_journals_but_never_acts(self, tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        final, decisions = _drive(path, "observe")
+        assert final == 0.0  # knob cell untouched
+        # no actuation means the knob never leaves the floor, so the
+        # shrink branch can't fire: two would-be widens, nothing acted
+        assert [(d["new"], d["acted"]) for d in decisions] == [
+            (5.0, False), (5.0, False),
+        ]
+
+    def test_cooldown_blocks_back_to_back_ticks(self, tmp_path):
+        clock = [100.0]
+        value = [0.0]
+        j = Journal(str(tmp_path / "j.jsonl"))
+        ctl = Controller(j, mode="on", clock=lambda: clock[0])
+        ctl.register(
+            BatchWindowPolicy(lo_ms=5.0, hi_ms=25.0, cooldown_s=2.0),
+            get=lambda: value[0],
+            set=lambda v: value.__setitem__(0, v),
+        )
+        for i in range(4):
+            j.emit("job_queued", job_id=i, client="t", trace_id=TRACE)
+        assert len(ctl.tick()) == 1
+        clock[0] += 0.5  # inside the cooldown
+        assert ctl.tick() == []
+        ctl.close()
+        j.close()
+
+    def test_raising_policy_degrades_to_no_tuning(self, tmp_path):
+        j = Journal(str(tmp_path / "j.jsonl"))
+        ctl = Controller(j, mode="on", clock=lambda: 1.0)
+
+        class Exploding:
+            knob = "workers"
+            params = {}
+
+            def decide(self, signal, current):
+                raise RuntimeError("boom")
+
+        ctl.register(Exploding(), get=lambda: 1, set=lambda v: None)
+        assert ctl.tick() == []  # logged and skipped, never raised
+        ctl.close()
+        j.close()
+
+    def test_controller_thread_ticks_and_stops(self, tmp_path):
+        j = Journal(str(tmp_path / "j.jsonl"))
+        ctl = Controller(j, mode="on")
+        ctl.register(
+            FleetSparesPolicy(lo=0, hi=2, cooldown_s=0.0),
+            get=lambda: 0, set=lambda v: None,
+        )
+        ticked = threading.Event()
+        orig = ctl.tick
+
+        def _tick(extras=None):
+            out = orig(extras)
+            ticked.set()
+            return out
+
+        ctl.tick = _tick
+        t = ControllerThread(ctl, interval=0.05).start()
+        assert ticked.wait(timeout=10.0)
+        t.stop()
+        j.close()
+        assert ctl.journal._tap is None  # stop() detached the tap
+
+
+# -- replay: the determinism audit --------------------------------------
+
+
+class TestReplay:
+    def test_replay_reproduces_every_decision(self, tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        _drive(path, "on")
+        res = replay_journal(path)
+        assert res["ok"], res
+        assert res["decisions"] == 3
+        assert res["reproduced"] == 3
+        assert res["acted"] == 3
+        assert res["streams"] == 1
+        assert res["refold_mismatches"] == []
+
+    def test_replay_detects_tampered_decision(self, tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        _drive(path, "on")
+        lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        # rewrite the FIRST decision's outcome: the policy no longer
+        # derives it from the recorded signal
+        for rec in lines:
+            if rec.get("event") == "autotune":
+                rec["new"] = 17.0
+                break
+        with open(path, "w", encoding="utf-8") as fh:
+            for rec in lines:
+                fh.write(json.dumps(rec) + "\n")
+        res = replay_journal(path)
+        assert not res["ok"]
+        assert any("replay new=5.0" in m for m in res["mismatches"])
+
+    def test_replay_detects_acted_mode_inconsistency(self, tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        _drive(path, "observe")
+        lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        for rec in lines:
+            if rec.get("event") == "autotune":
+                rec["acted"] = True  # observe mode must never act
+                break
+        with open(path, "w", encoding="utf-8") as fh:
+            for rec in lines:
+                fh.write(json.dumps(rec) + "\n")
+        res = replay_journal(path)
+        assert not res["ok"]
+        assert any("inconsistent with mode" in m
+                   for m in res["mismatches"])
+
+    def test_replay_skips_refold_for_store_extras(self, tmp_path):
+        # fleet snapshots carry a store-derived view replay cannot
+        # re-derive from the journal: the decision check still runs
+        path = str(tmp_path / "fleet.jsonl")
+        j = Journal(path)
+        ctl = Controller(j, mode="on", clock=lambda: 50.0)
+        spares = [0]
+        ctl.register(
+            FleetSparesPolicy(lo=0, hi=2),
+            get=lambda: spares[0],
+            set=lambda v: spares.__setitem__(0, v),
+        )
+        out = ctl.tick(extras={"steal_proposals": 2, "stale_ranks": 0})
+        assert len(out) == 1 and out[0]["signal"]["store"]
+        ctl.close()
+        j.close()
+        res = replay_journal(path)
+        assert res["ok"], res
+        assert res["decisions"] == 1 and res["reproduced"] == 1
+
+
+# -- torn-read hammers on the locked live-config paths ------------------
+
+
+class TestLiveValueConcurrency:
+    """The controller moves knobs while hot paths read them; the locked
+    accessors must never expose a torn or out-of-set value (pattern:
+    test_exporter.py TestRegistryConcurrency)."""
+
+    N_ITER = 2000
+
+    def test_daemon_live_knobs_under_mutation_hammer(self, tmp_path):
+        from specpride_tpu.serve.daemon import ServeDaemon
+
+        d = ServeDaemon(
+            str(tmp_path / "s.sock"),
+            compile_cache=str(tmp_path / "cache"),
+            workers=4,
+        )
+        valid_windows = {0.005 * k for k in range(8)}
+        stop = threading.Event()
+        errors: list = []
+
+        def _mutate():
+            try:
+                for i in range(self.N_ITER):
+                    d.batch_window = 0.005 * (i % 8)
+                    d.active_workers = (i % 4) + 1
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        def _read():
+            try:
+                while not stop.is_set():
+                    w = d.batch_window
+                    assert w in valid_windows, w
+                    n = d.active_workers
+                    assert 1 <= n <= 4, n
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        writers = [threading.Thread(target=_mutate) for _ in range(2)]
+        readers = [threading.Thread(target=_read) for _ in range(2)]
+        for t in readers:
+            t.start()
+        for t in writers:
+            t.start()
+        for t in writers:
+            t.join(timeout=60)
+        stop.set()
+        for t in readers:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert d.batch_window in valid_windows
+        assert 1 <= d.active_workers <= 4
+
+    def test_set_quotas_live_under_offer_pop_hammer(self):
+        """Quota-table swaps racing offer/pop must never tear: every
+        popped job is released, accounting lands exact, and a final
+        table applies to every client atomically."""
+        q = AdmissionQueue(capacity=64)
+        tables = [
+            {"*": Quota(1.0, None)},
+            {"a": Quota(3.0, 8), "*": Quota(1.0, 4)},
+            {"b": Quota(2.0, 2)},
+        ]
+        stop = threading.Event()
+        errors: list = []
+        popped = []
+        pop_lock = threading.Lock()
+
+        def _swap():
+            try:
+                for i in range(self.N_ITER):
+                    q.set_quotas(tables[i % len(tables)])
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        def _offer(client):
+            try:
+                for i in range(200):
+                    try:
+                        q.offer(client, (client, i))
+                    except Exception as e:
+                        if "quota" not in str(e):
+                            raise
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        def _pop():
+            try:
+                while True:
+                    job = q.pop(timeout=0.05)
+                    if job is None:
+                        if stop.is_set():
+                            return
+                        continue
+                    with pop_lock:
+                        popped.append(job)
+                    q.release(job)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        swapper = threading.Thread(target=_swap)
+        offerers = [threading.Thread(target=_offer, args=(c,))
+                    for c in ("a", "b", "c")]
+        poppers = [threading.Thread(target=_pop) for _ in range(2)]
+        for t in poppers:
+            t.start()
+        swapper.start()
+        for t in offerers:
+            t.start()
+        for t in offerers:
+            t.join(timeout=60)
+        swapper.join(timeout=60)
+        # drain the tail, then stop the poppers
+        deadline = 200
+        while len(q) and deadline:
+            deadline -= 1
+            stop.wait(0.05)
+        stop.set()
+        for t in poppers:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(q) == 0
+        assert len(popped) == len(set(popped))  # no job served twice
+        # the last table swap fully applied: no half-resolved state
+        q.set_quotas({"*": Quota(5.0, 7)})
+        for st in q._states.values():
+            assert st.quota == Quota(5.0, 7)

@@ -401,6 +401,68 @@ def test_journal_schema_no_trace_table_is_vacuous(tmp_path):
     root = write_tree(tmp_path, base_fixture(good=True))
     hits = run_checks(root, select=["journal-schema"])
     assert not any(":trace" in f.symbol for f in hits), hits
+    assert not any(":v5" in f.symbol for f in hits), hits
+
+
+def test_journal_schema_v5_fields_both_directions(tmp_path):
+    """The v5 extension mirrors the v4 trace envelope: an emit of a
+    V5_EVENT_FIELDS event missing its additive field is a finding, and
+    so is a docs row that never mentions it; the fixed variants are
+    clean."""
+    files = base_fixture(good=True)
+    files["fix/journal.py"] = """
+        EVENT_FIELDS = {
+            "run_start": frozenset({"command"}),
+            "run_end": frozenset({"elapsed_s"}),
+            "heartbeat": frozenset({"rank"}),
+        }
+
+        V5_EVENT_FIELDS = {
+            "heartbeat": frozenset({"chunk_s"}),
+        }
+
+        class Journal:
+            def emit(self, event, **fields):
+                return {}
+    """
+    files["fix/emitter.py"] = """
+        def go(journal):
+            journal.emit("run_start", command="x")
+            journal.emit("run_end", elapsed_s=1.0)
+            journal.emit("heartbeat", rank=0)  # no chunk_s
+    """
+    files["docs/observability.md"] = """
+        # Events
+
+        | event | payload (required) | meaning |
+        |---|---|---|
+        | `run_start` | `command` | run began |
+        | `run_end` | `elapsed_s` (plus `counters`) | run finished |
+        | `heartbeat` | `rank` | liveness, v5 field undocumented |
+    """ + DOC_METRICS_GOOD
+    root = write_tree(tmp_path, files)
+    hits = run_checks(root, select=["journal-schema"])
+    symbols = {f.symbol for f in hits}
+    assert "emit:heartbeat:v5" in symbols, hits
+    assert "doc:heartbeat:v5" in symbols, hits
+    # fixed: the emit carries chunk_s, the row mentions it behind plus
+    files["fix/emitter.py"] = """
+        def go(journal, wall):
+            journal.emit("run_start", command="x")
+            journal.emit("run_end", elapsed_s=1.0)
+            journal.emit("heartbeat", rank=0, chunk_s=wall)
+    """
+    files["docs/observability.md"] = """
+        # Events
+
+        | event | payload (required) | meaning |
+        |---|---|---|
+        | `run_start` | `command` | run began |
+        | `run_end` | `elapsed_s` (plus `counters`) | run finished |
+        | `heartbeat` | `rank` (plus `chunk_s`, required from v5) | beat |
+    """ + DOC_METRICS_GOOD
+    root2 = write_tree(tmp_path / "fixed", files)
+    assert run_checks(root2, select=["journal-schema"]) == []
 
 
 def test_journal_schema_catches_stale_renderer_literal(tmp_path):
