@@ -1280,6 +1280,151 @@ env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
     autotune-replay "$at_tmp/el.jsonl"
 rm -rf "$at_tmp"
 
+echo "== flight recorder: incident plane (armed daemon, bundles + replay) =="
+# the v6 acceptance bar: a 2-lane daemon with the flight recorder ARMED
+# (--flightrec on + --incident-dir), an impossible bin-mean SLO
+# objective, and a 1.5s lane watchdog serves a 3-job breach streak
+# (slo_breach fires at the third consecutive job_done breach) plus one
+# job carrying an injected dispatch hang that wedges its serve:job lane
+# past the daemon watchdog (watchdog fires).  Assert: exactly those two
+# v6 `incident` events land in the journal, each with an atomic on-disk
+# bundle (manifest schema 1, ring holds the trigger record, no .tmp-
+# staging debris), every served output stays byte-identical to the
+# one-shot CLI, `specpride incident-replay` re-derives both incidents
+# bit-exact (exit 0), the incidents list/show/export read side works,
+# `stats --incidents` renders the plane off the LIVE journal, and the
+# drain metrics snapshot carries the per-detector incident counters.
+# The compile cache is pre-seeded by the CLI run so warm serve:job
+# sections never trip the daemon watchdog on their own.
+fr_tmp=$(mktemp -d)
+FR_IN=tests/data/golden_clustered.mgf
+FRSOCK="$fr_tmp/serve.sock"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    consensus "$FR_IN" "$fr_tmp/cli.mgf" --method bin-mean \
+    --compile-cache "$fr_tmp/cache"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    serve --socket "$FRSOCK" --compile-cache "$fr_tmp/cache" \
+    --journal "$fr_tmp/serve.jsonl" --workers 2 --max-queue 32 \
+    --watchdog-timeout 1.5 --slo "bin-mean=0.000001" \
+    --flightrec on --incident-dir "$fr_tmp/incidents" \
+    --metrics-port 0 --metrics-out "$fr_tmp/serve.prom" &
+FR_PID=$!
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$FRSOCK" <<'EOF'
+import sys
+from specpride_tpu.serve.client import wait_for_socket
+assert wait_for_socket(sys.argv[1], timeout=180), \
+    "flightrec daemon never came up"
+EOF
+fr_submit() { # $1 = tag; rest = extra job flags
+    FR_TAG="$1"; shift
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        submit --socket "$FRSOCK" -- \
+        consensus "$FR_IN" "$fr_tmp/served_$FR_TAG.mgf" \
+        --method bin-mean "$@" > /dev/null
+}
+# (a) the breach streak: every bin-mean job_done breaks the 1us
+# objective; the third consecutive breach fires slo_breach
+fr_submit s1
+fr_submit s2
+fr_submit s3
+# (b) the wedge: the injected dispatch hang stalls the serve:job lane
+# past the daemon's 1.5s watchdog (-> watchdog_stall -> incident); the
+# JOB's own 4s watchdog then cancels the hang so the retried job still
+# commits byte-identical output.  Its fourth-in-a-row SLO breach stays
+# inside slo_breach's 30s dedup cooldown — suppressed, never journaled
+# twice.
+fr_submit hang --prefetch 2 --retries 2 --retry-backoff 0.01 \
+    --watchdog-timeout 4 --inject-faults "dispatch:hang:1:0"
+for T in s1 s2 s3 hang; do
+    cmp "$fr_tmp/cli.mgf" "$fr_tmp/served_$T.mgf"
+done
+# the daemon is still LIVE: the incident summary renders off the
+# (run_end-less) journal
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$fr_tmp/serve.jsonl" --incidents | grep -q "incidents: mode=on"
+kill -TERM $FR_PID
+FR_RC=0; wait $FR_PID || FR_RC=$?
+test "$FR_RC" -eq 0
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$fr_tmp" <<'EOF'
+import json, os, sys
+tmp = sys.argv[1]
+from specpride_tpu.observability.journal import read_events
+events, violations = read_events(os.path.join(tmp, "serve.jsonl"))
+assert not violations, violations
+names = [e["event"] for e in events]
+assert "serve_drain" in names and names[-1] == "run_end", names[-6:]
+inc = [e for e in events if e["event"] == "incident"]
+assert sorted(e["detector"] for e in inc) == \
+    ["slo_breach", "watchdog"], inc
+for e in inc:
+    assert e["mode"] == "on" and e["bundled"] is True, e
+    assert e["incident_id"] and isinstance(e["evidence"], dict), e
+    assert e["trace_id"], e
+slo = next(e for e in inc if e["detector"] == "slo_breach")
+assert slo["evidence"]["streak"] == 3, slo
+wd = next(e for e in inc if e["detector"] == "watchdog")
+assert wd["evidence"]["lane"] == "serve:job", wd
+# on-disk bundles: atomic, schema-valid, complete, no staging debris
+from specpride_tpu.observability.flightrec import list_bundles
+inc_dir = os.path.join(tmp, "incidents")
+assert not [p for p in os.listdir(inc_dir) if ".tmp-" in p], \
+    "staging debris leaked into the incident dir"
+bundles, warnings = list_bundles(inc_dir)
+assert not warnings, warnings
+by_id = {b["incident"]["incident_id"]: b for b in bundles}
+assert set(by_id) == {e["incident_id"] for e in inc}, by_id
+for e in inc:
+    b = by_id[e["incident_id"]]
+    assert b["schema"] == 1 and b["dir"] == e["bundle_dir"], b
+    for fname in ("ring.jsonl", "stacks.txt", "journal_tail.jsonl",
+                  "metrics.prom", "config.json"):
+        assert fname in b["files"], (e["detector"], b["files"])
+        assert os.path.getsize(os.path.join(b["dir"], fname)) > 0, fname
+# each ring snapshot holds its own trigger record
+slo_ring = [json.loads(l) for l in open(
+    os.path.join(by_id[slo["incident_id"]]["dir"], "ring.jsonl"))]
+assert any(r["event"] == "job_done" and r.get("slo_ok") is False
+           for r in slo_ring), "trigger job_done missing from the ring"
+wd_ring = [json.loads(l) for l in open(
+    os.path.join(by_id[wd["incident_id"]]["dir"], "ring.jsonl"))]
+assert any(r["event"] == "watchdog_stall" for r in wd_ring), \
+    "trigger watchdog_stall missing from the ring"
+# the config section carries the armed plane's boot knobs + digest
+cfg = json.load(open(os.path.join(
+    by_id[slo["incident_id"]]["dir"], "config.json")))
+assert cfg["config"]["flightrec"] == "on" and cfg["digest"], cfg
+# the drain metrics snapshot counts both detectors (strict exposition)
+from specpride_tpu.observability.exporter import parse_exposition
+samples, problems = parse_exposition(
+    open(os.path.join(tmp, "serve.prom")).read())
+assert not problems, problems
+for det in ("slo_breach", "watchdog"):
+    key = ("specpride_incidents_total", (("detector", det),))
+    assert samples.get(key) == 1, (det, samples.get(key))
+print(f"incident plane OK: slo_breach + watchdog fired once each, "
+      f"{len(bundles)} atomic bundles, counters on the drain snapshot")
+EOF
+# read side: list renders both bundles; show resolves a git-style id
+# prefix; export tars a complete bundle
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    incidents list "$fr_tmp/incidents" > "$fr_tmp/inc_list.txt"
+grep -q "slo_breach" "$fr_tmp/inc_list.txt"
+grep -q "watchdog" "$fr_tmp/inc_list.txt"
+FR_ID=$(awk 'NR==1{print $1}' "$fr_tmp/inc_list.txt")
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    incidents show "$fr_tmp/incidents" "$(printf %.6s "$FR_ID")" \
+    > "$fr_tmp/inc_show.json"
+grep -q '"schema": 1' "$fr_tmp/inc_show.json"
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    incidents export "$fr_tmp/incidents" "$FR_ID" \
+    --output "$fr_tmp/inc.tar.gz"
+tar -tzf "$fr_tmp/inc.tar.gz" | grep -q manifest.json
+# the determinism audit: refold the journal through fresh detectors and
+# require both incidents to re-derive bit-exact (exit 0)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    incident-replay "$fr_tmp/serve.jsonl"
+rm -rf "$fr_tmp"
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== native: ASan parser suite =="
     make -C native asan

@@ -34,6 +34,12 @@ site must pass the field, and the docs row must mention it.  Version-
 gated tables keep old committed journals valid while making it
 impossible for NEW emit sites to drop the field the autotune signal
 fold depends on.
+
+``V6_EVENT_FIELDS`` (the v6 additions — ``incident_id`` + ``evidence``
+on the flight recorder's ``incident`` event) follows the identical
+discipline: ``incident-replay`` re-derives firings from exactly these
+fields, so an emit site dropping them would ship an unauditable
+incident.
 """
 
 from __future__ import annotations
@@ -82,6 +88,19 @@ def _v5_event_fields(project: Project) -> dict[str, set]:
     """The v5 additive-field table (``V5_EVENT_FIELDS``), or empty when
     the project doesn't declare one (pre-v5 fixture trees)."""
     hit = project.one_constant("V5_EVENT_FIELDS")
+    if hit is None:
+        return {}
+    _mod, node, _line = hit
+    table = dict_of_str_sets(node)
+    if table is None:
+        return {}
+    return {k: v for k, v in table.items() if v is not None}
+
+
+def _v6_event_fields(project: Project) -> dict[str, set]:
+    """The v6 additive-field table (``V6_EVENT_FIELDS``), or empty when
+    the project doesn't declare one (pre-v6 fixture trees)."""
+    hit = project.one_constant("V6_EVENT_FIELDS")
     if hit is None:
         return {}
     _mod, node, _line = hit
@@ -145,6 +164,7 @@ def run(project: Project) -> list[Finding]:
     schema_mod, schema, schema_line = anchor
     trace_fields = _trace_event_fields(project)
     v5_fields = _v5_event_fields(project)
+    v6_fields = _v6_event_fields(project)
     findings: list[Finding] = []
 
     # 1. emit sites vs schema (incl. the v4 trace envelope)
@@ -193,6 +213,17 @@ def run(project: Project) -> list[Finding]:
                     f"emit of `{event}` is missing the v5 fields "
                     f"{missing_v5} (V5_EVENT_FIELDS) — the autotune "
                     f"signal fold depends on them"
+                ),
+            ))
+        missing_v6 = sorted(v6_fields.get(event, set()) - kwargs)
+        if missing_v6:
+            findings.append(Finding(
+                check=CHECK, path=mod.rel, line=node.lineno,
+                symbol=f"emit:{event}:v6",
+                message=(
+                    f"emit of `{event}` is missing the v6 fields "
+                    f"{missing_v6} (V6_EVENT_FIELDS) — incident-replay "
+                    f"re-derives firings from them"
                 ),
             ))
 
@@ -275,6 +306,22 @@ def run(project: Project) -> list[Finding]:
                             f"{_DOC} row for `{event}` does not "
                             f"mention the v5 fields {absent} "
                             f"(V5_EVENT_FIELDS)"
+                        ),
+                    ))
+            # v6 additive fields: same mention rule as the v4 envelope
+            for event, fields in sorted(v6_fields.items()):
+                row = table.get(event)
+                if row is None:
+                    continue  # the missing-row finding above covers it
+                absent = sorted(fields - row.get("mentioned", set()))
+                if absent:
+                    findings.append(Finding(
+                        check=CHECK, path=_DOC, line=row["line"],
+                        symbol=f"doc:{event}:v6",
+                        message=(
+                            f"{_DOC} row for `{event}` does not "
+                            f"mention the v6 fields {absent} "
+                            f"(V6_EVENT_FIELDS)"
                         ),
                     ))
 

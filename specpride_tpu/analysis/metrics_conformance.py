@@ -23,7 +23,10 @@ Rules:
 5. pre-register-at-0: counters/gauges named by the exporter's
    ``PRE_REGISTERED_FAMILIES`` contract must be zero-initialized in
    the telemetry ``__init__`` — a drain snapshot must render 0-valued
-   series, not absent ones.
+   series, not absent ones.  The flight recorder's incident families
+   (``specpride_incidents_*``, one series per detector in the v6
+   catalog) ride this contract: "this detector never fired" must be
+   an auditable 0, not an absent series.
 """
 
 from __future__ import annotations

@@ -176,9 +176,24 @@ class Controller:
             "knobs": sorted(self._policies),
         }
 
+    def knob_values(self) -> dict:
+        """Current value of every registered knob, read through its
+        live accessor — the flight recorder's bundle snapshot of the
+        autotune plane at incident time.  A failing accessor reads
+        None: a diagnostic dump must never raise into its host."""
+        out: dict = {}
+        for knob, (_policy, get, _set) in list(self._policies.items()):
+            try:
+                out[knob] = get()
+            except Exception:  # noqa: BLE001 - diagnostic best effort
+                out[knob] = None
+        return out
+
     def close(self) -> None:
-        """Detach from the journal (the host is draining)."""
-        self.journal.set_tap(None)
+        """Detach from the journal (the host is draining).  Only THIS
+        controller's tap: a flight recorder tapping the same journal
+        keeps observing until its own stop."""
+        self.journal.detach_tap(self.signals.observe)
 
 
 class ControllerThread:

@@ -336,6 +336,22 @@ def _add_observability(p: argparse.ArgumentParser) -> None:
         "--metrics-host", default="127.0.0.1", metavar="HOST",
         help="bind address for --metrics-port (default 127.0.0.1)",
     )
+    p.add_argument(
+        "--flightrec", choices=["off", "observe", "on"], default="off",
+        help="(with --elastic) flight recorder: an always-on ring of "
+        "recent journal records plus health detectors (SLO-breach "
+        "streaks, latency spikes, watchdog stalls, lease churn, ...). "
+        "'observe' journals each detector firing as an `incident` "
+        "event; 'on' also dumps an atomic diagnostic bundle under "
+        "--incident-dir; 'off' constructs no recorder at all.  "
+        "Requires --journal (default off; see docs/observability.md)",
+    )
+    p.add_argument(
+        "--incident-dir", metavar="DIR",
+        help="directory for --flightrec on incident bundles (ring "
+        "dump, thread stacks, /metrics snapshot, autotune knob state, "
+        "config digest, journal tail; read with `specpride incidents`)",
+    )
 
 
 def _get_backend(args):
@@ -2917,6 +2933,7 @@ def _run_elastic(
             "[%d, %d])", rank, autotune, chunk, 4 * range_size,
         )
     exporter = None
+    metrics_fn = None
     if getattr(args, "metrics_port", None) is not None:
         from specpride_tpu.observability.exporter import (
             ElasticTelemetry,
@@ -2930,6 +2947,7 @@ def _run_elastic(
                 if r is not None
             ),
         )
+        metrics_fn = telemetry.exposition
         exporter = MetricsExporter(
             telemetry.exposition,
             host=getattr(args, "metrics_host", "127.0.0.1"),
@@ -2937,6 +2955,44 @@ def _run_elastic(
             health=telemetry.health,
         ).start()
         logger.info("elastic liveness metrics -> %s", exporter.url)
+    flightrec = getattr(args, "flightrec", "off") or "off"
+    recorder = None
+    if flightrec != "off":
+        if not journal.enabled:
+            raise SystemExit(
+                "--flightrec observe|on requires --journal: the "
+                "detectors fold the journal stream"
+            )
+        from specpride_tpu.observability.flightrec import FlightRecorder
+
+        ctl_ref = ctl_thread.controller if ctl_thread else None
+        recorder = FlightRecorder(
+            journal,
+            mode=flightrec,
+            incident_dir=getattr(args, "incident_dir", None),
+            metrics_fn=metrics_fn,
+            autotune_fn=(
+                (lambda: {"status": ctl_ref.status(),
+                          "knobs": ctl_ref.knob_values()})
+                if ctl_ref is not None else None
+            ),
+            # the coordinator's lease-state counters ride every bundle
+            # — the store-derived view a dead rank's journal alone
+            # cannot reconstruct
+            extra_fn=coord.counters,
+            config={
+                "host": "elastic",
+                "rank": rank,
+                "store": coord.store.describe(),
+                "n_ranges": len(coord.ranges),
+                "range_size": range_size,
+                "ttl_s": coord.ttl,
+                "steal": coord.steal_enabled,
+                "autotune": autotune,
+                "flightrec": flightrec,
+            },
+        ).start()
+        logger.info("elastic rank %d: flightrec %s", rank, flightrec)
     # ONE harness for the whole rank lifetime: fault-plan visit counters
     # (chaos CI's rank_kill AFTER offsets) and retry accounting must
     # span ranges, not reset at every range boundary
@@ -2969,6 +3025,10 @@ def _run_elastic(
             # journal close would lose its decision line
             coord.flush_progress()
             ctl_thread.stop()
+        if recorder is not None:
+            # drains queued firings into the journal BEFORE
+            # _finish_run closes it — a drained rank keeps its evidence
+            recorder.stop()
         if exporter is not None:
             exporter.stop()
         coord.stop()
@@ -2978,13 +3038,7 @@ def _run_elastic(
         "backend": coord.store.describe(),
         "n_ranges": len(coord.ranges),
         "range_size": range_size,
-        "ranges_run": coord.ranges_run,
-        "ranges_committed": coord.done_count(),
-        "lease_expires_observed": coord.lease_expires_observed,
-        "reassignments": coord.reassignments,
-        "lease_splits": coord.lease_splits,
-        "steals": coord.steals,
-        "cas_conflicts": coord.cas_conflicts,
+        **coord.counters(),
     }
     _finish_run(args, backend, stats, journal)
 
@@ -3224,6 +3278,18 @@ def cmd_serve(args) -> int:
             "serve --autotune observe|on requires --journal: every "
             "decision must be journaled as evidence"
         )
+    flightrec = getattr(args, "flightrec", "off") or "off"
+    if flightrec != "off" and not args.journal:
+        raise SystemExit(
+            "serve --flightrec observe|on requires --journal: the "
+            "detectors fold the journal stream"
+        )
+    if flightrec == "on" and not getattr(args, "incident_dir", None):
+        raise SystemExit(
+            "serve --flightrec on dumps bundles and therefore "
+            "requires --incident-dir (use 'observe' to journal "
+            "firings without bundles)"
+        )
     autotune_bw = None
     if getattr(args, "autotune_batch_window", None):
         from specpride_tpu.autotune.policy import parse_clamp
@@ -3260,6 +3326,8 @@ def cmd_serve(args) -> int:
         autotune=autotune,
         autotune_interval=getattr(args, "autotune_interval", 1.0),
         autotune_batch_window=autotune_bw,
+        flightrec=flightrec,
+        incident_dir=getattr(args, "incident_dir", None),
     ).run()
 
 
@@ -3409,6 +3477,8 @@ def cmd_fleet(args) -> int:
                 scale_horizon=args.scale_horizon,
                 env=env,
                 autotune=getattr(args, "autotune", "off") or "off",
+                flightrec=getattr(args, "flightrec", "off") or "off",
+                incident_dir=getattr(args, "incident_dir", None),
             )
         except ValueError as e:
             raise SystemExit(str(e))
@@ -3473,10 +3543,12 @@ def cmd_stats(args) -> int:
             args.journals[0], interval=args.interval,
             top_spans=args.top_spans, slo=args.slo,
             autotune=getattr(args, "autotune", False),
+            incidents=getattr(args, "incidents", False),
         )
     return run_stats(
         args.journals, json_out=args.json, top_spans=args.top_spans,
         slo=args.slo, autotune=getattr(args, "autotune", False),
+        incidents=getattr(args, "incidents", False),
     )
 
 
@@ -3495,6 +3567,91 @@ def cmd_autotune_replay(args) -> int:
             fh.write("\n")
     render_replay(result, sys.stdout)
     return 0 if result["ok"] else 1
+
+
+def cmd_incident_replay(args) -> int:
+    """``specpride incident-replay JOURNAL``: the flight recorder's
+    determinism audit — refold the journal stream through the detector
+    set and require every recorded ``incident`` event (id, reason,
+    clock, evidence, trace id, dedup suppression) to re-derive
+    bit-exact.  Exit 0 iff everything reproduces.  See
+    docs/observability.md."""
+    from specpride_tpu.observability.flightrec import (
+        render_incident_replay,
+        replay_incidents,
+    )
+
+    result = replay_incidents(args.journal)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True, default=str)
+            fh.write("\n")
+    render_incident_replay(result, sys.stdout)
+    return 0 if result["ok"] else 1
+
+
+def cmd_incidents(args) -> int:
+    """``specpride incidents list|show|export``: read the atomic
+    bundles a ``--flightrec on`` host dumped under its
+    ``--incident-dir``.  ``list`` is one line per bundle; ``show``
+    prints one bundle's manifest (+ its evidence files with
+    ``--files``); ``export`` tars one bundle (or all of them) for
+    attaching to a report."""
+    from specpride_tpu.observability.flightrec import (
+        find_bundle,
+        list_bundles,
+    )
+
+    bundles, warnings = list_bundles(args.incident_dir)
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    if args.action == "list":
+        if not bundles:
+            print(f"no incident bundles under {args.incident_dir}")
+            return 0
+        for b in bundles:
+            inc = b.get("incident", {})
+            print(
+                f"{inc.get('incident_id', '?'):<18} "
+                f"{inc.get('detector', '?'):<16} "
+                f"clock={inc.get('clock', '?')} "
+                f"mode={inc.get('mode', '?')} "
+                f"suppressed={inc.get('suppressed', 0)}  "
+                f"{inc.get('reason', '')}"
+            )
+        return 0
+    if not args.incident_id:
+        raise SystemExit(f"incidents {args.action} needs an INCIDENT_ID")
+    bundle = find_bundle(args.incident_dir, args.incident_id)
+    if bundle is None:
+        raise SystemExit(
+            f"no unique bundle matches {args.incident_id!r} under "
+            f"{args.incident_dir} (try `specpride incidents list`)"
+        )
+    if args.action == "show":
+        print(json.dumps(bundle, indent=2, sort_keys=True, default=str))
+        if args.files:
+            for fname in sorted(
+                f for f in os.listdir(bundle["dir"])
+                if f != "manifest.json"
+            ):
+                path = os.path.join(bundle["dir"], fname)
+                print(f"\n===== {fname} =====")
+                with open(path, encoding="utf-8",
+                          errors="replace") as fh:
+                    sys.stdout.write(fh.read())
+        return 0
+    # export: one deterministic tarball of the bundle directory
+    import tarfile
+
+    inc = bundle.get("incident", {})
+    out = args.output or (
+        f"incident-{inc.get('incident_id', 'unknown')}.tar.gz"
+    )
+    with tarfile.open(out, "w:gz") as tar:
+        tar.add(bundle["dir"], arcname=os.path.basename(bundle["dir"]))
+    print(out)
+    return 0
 
 
 def cmd_trace(args) -> int:
@@ -4180,6 +4337,24 @@ def build_parser() -> argparse.ArgumentParser:
         "0:50 — the controller never moves --batch-window outside "
         "[LO, HI] (default 0:50)",
     )
+    psv.add_argument(
+        "--flightrec", choices=["off", "observe", "on"], default="off",
+        help="flight recorder: an always-on ring of recent journal "
+        "records plus health detectors (SLO-breach streaks, latency "
+        "spikes vs EWMA, queue saturation, watchdog stalls, retry "
+        "exhaustion, fallback_solo bursts, lease churn).  'observe' "
+        "journals each firing as an `incident` event; 'on' also dumps "
+        "an atomic diagnostic bundle under --incident-dir; 'off' "
+        "constructs no recorder at all.  Requires --journal; audit "
+        "with `specpride incident-replay` (default off; see "
+        "docs/observability.md)",
+    )
+    psv.add_argument(
+        "--incident-dir", metavar="DIR",
+        help="directory for --flightrec on incident bundles (ring "
+        "dump, thread stacks, /metrics snapshot, autotune knob state, "
+        "config digest, journal tail; read with `specpride incidents`)",
+    )
     psv.set_defaults(fn=cmd_serve)
 
     ppr = sub.add_parser(
@@ -4315,6 +4490,19 @@ def build_parser() -> argparse.ArgumentParser:
         "(default off; see docs/autotune.md)",
     )
     pf.add_argument(
+        "--flightrec", choices=["off", "observe", "on"], default="off",
+        help="flight recorder over the supervisor's journal: health "
+        "detectors (lease churn, retry exhaustion, ...) journal "
+        "`incident` events ('observe') and dump atomic bundles under "
+        "--incident-dir ('on').  Requires --journal (default off; see "
+        "docs/observability.md)",
+    )
+    pf.add_argument(
+        "--incident-dir", metavar="DIR",
+        help="directory for --flightrec on incident bundles (read "
+        "with `specpride incidents`)",
+    )
+    pf.add_argument(
         "job", nargs=argparse.REMAINDER,
         help="the rank argv to supervise, after --: consensus|select "
         "INPUT OUTPUT --elastic DIR|URL [flags] (no --process-id — "
@@ -4386,6 +4574,13 @@ def build_parser() -> argparse.ArgumentParser:
         "new, acted, reason) from the journals' autotune events — "
         "works with --follow for a live view",
     )
+    pst.add_argument(
+        "--incidents", action="store_true",
+        help="also render the flight recorder's incident log "
+        "(detector, clock, reason, bundled, dedup suppression) from "
+        "the journals' v6 incident events — works with --follow for "
+        "a live view",
+    )
     pst.set_defaults(fn=cmd_stats)
 
     par = sub.add_parser(
@@ -4406,6 +4601,56 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write the machine-readable replay result here",
     )
     par.set_defaults(fn=cmd_autotune_replay)
+
+    pir = sub.add_parser(
+        "incident-replay",
+        help="refold a recorded journal through the flight recorder's "
+        "detector set and verify every journaled incident re-derives "
+        "bit-exact (same id, reason, clock, evidence, dedup) — the "
+        "determinism audit for the incident plane",
+    )
+    pir.add_argument(
+        "journal",
+        help="journal file from a --flightrec observe|on run (base "
+        "path; rotated segments and .part<rank> shards replay as "
+        "independent per-process streams)",
+    )
+    pir.add_argument(
+        "--json", metavar="FILE",
+        help="also write the machine-readable replay result here",
+    )
+    pir.set_defaults(fn=cmd_incident_replay)
+
+    pin = sub.add_parser(
+        "incidents",
+        help="read the atomic diagnostic bundles a --flightrec on "
+        "host dumped under its --incident-dir",
+    )
+    pin.add_argument(
+        "action", choices=["list", "show", "export"],
+        help="list = one line per bundle; show = print one bundle's "
+        "manifest (+ evidence files with --files); export = tar one "
+        "bundle",
+    )
+    pin.add_argument(
+        "incident_dir", metavar="INCIDENT_DIR",
+        help="the --incident-dir a --flightrec on host wrote into",
+    )
+    pin.add_argument(
+        "incident_id", nargs="?", default=None, metavar="INCIDENT_ID",
+        help="(show/export) the bundle's incident id — any unique "
+        "prefix, as printed by `incidents list`",
+    )
+    pin.add_argument(
+        "--files", action="store_true",
+        help="(show) also print every evidence file in the bundle",
+    )
+    pin.add_argument(
+        "--output", metavar="FILE",
+        help="(export) tarball path (default "
+        "incident-<incident_id>.tar.gz in the current directory)",
+    )
+    pin.set_defaults(fn=cmd_incidents)
 
     pt = sub.add_parser(
         "trace",

@@ -24,12 +24,24 @@ Four pieces:
                  burn accounting (``--slo``), and the strict text-
                  format checker the tests/CI scrape pass share
                  (imported lazily — one-shot runs never pay for it)
+* ``detect``   — the flight recorder's health detectors: pure,
+                 replayable folds of the journal stream (SLO-breach
+                 streaks, latency spikes vs EWMA, queue saturation,
+                 watchdog stalls, retry exhaustion, solo bursts,
+                 lease churn)
+* ``flightrec``— always-on black-box capture (``--flightrec``): a
+                 bounded ring of recent journal records plus the
+                 detector set; firings journal as v6 ``incident``
+                 events and (mode ``on``) dump atomic diagnostic
+                 bundles under ``--incident-dir`` (imported lazily,
+                 like the exporter)
 """
 
 from specpride_tpu.observability.journal import (
     EVENT_FIELDS,
     SCHEMA_VERSION,
     TRACE_EVENT_FIELDS,
+    V6_EVENT_FIELDS,
     Journal,
     NullJournal,
     emit_clock_anchor,
@@ -62,6 +74,7 @@ __all__ = [
     "EVENT_FIELDS",
     "SCHEMA_VERSION",
     "TRACE_EVENT_FIELDS",
+    "V6_EVENT_FIELDS",
     "Journal",
     "MetricsRegistry",
     "NullJournal",

@@ -105,6 +105,7 @@ PRE_REGISTERED_FAMILIES = (
     "specpride_h2d_bytes_total",
     "specpride_d2h_bytes_total",
     "specpride_autotune_*",
+    "specpride_incidents_*",
 )
 
 # the daemon-hosted autotune knobs: their current-value gauges and
@@ -301,6 +302,25 @@ class ServeTelemetry:
             self.autotune_knob.set(0.0, knob=knob)
             self.autotune_decisions.inc(0, knob=knob, acted="true")
             self.autotune_decisions.inc(0, knob=knob, acted="false")
+        # flight-recorder incident plane: one firing counter + one
+        # dedup-suppression counter per detector, pre-registered at 0
+        # for every detector in the catalog so "this detector never
+        # fired" is an auditable 0-valued series
+        from specpride_tpu.observability.detect import DETECTOR_NAMES
+        self.incidents = r.counter(
+            "specpride_incidents_total",
+            "flight-recorder incidents journaled, by detector",
+            labels=("detector",),
+        )
+        self.incidents_suppressed = r.counter(
+            "specpride_incidents_suppressed_total",
+            "detector firings suppressed by the flight recorder's "
+            "per-detector dedup cooldown, by detector",
+            labels=("detector",),
+        )
+        for det in DETECTOR_NAMES:
+            self.incidents.inc(0, detector=det)
+            self.incidents_suppressed.inc(0, detector=det)
         # device transfer rollups (memory-bandwidth campaign): summed
         # across worker-lane backend registries by delta at scrape time
         # (sync_singletons); pre-registered at 0 so a daemon that never
@@ -328,6 +348,17 @@ class ServeTelemetry:
         self.autotune_decisions.inc(
             1, knob=knob, acted="true" if acted else "false"
         )
+
+    def incident(self, *, detector: str, suppressed: int = 0) -> None:
+        """Mirror one journaled ``incident`` event into the live plane
+        (the suppression counter catches up lazily: dedup-suppressed
+        firings are accounted when the NEXT incident on that detector
+        journals, same as the event's ``suppressed`` field)."""
+        self.incidents.inc(1, detector=detector)
+        if suppressed:
+            self.incidents_suppressed.inc(
+                int(suppressed), detector=detector
+            )
 
     def batch_dispatch(
         self, *, n_jobs: int, n_clusters: int, window_wait_s: float,

@@ -15,8 +15,11 @@ per-job events carry it explicitly (``TRACE_EVENT_FIELDS``), and
 spans processes.  v5 adds the **autotune** decision event (the
 closed-loop controller's evidence trail) and requires the elastic
 heartbeat to mirror its EWMA chunk wall (``chunk_s``) — both additive;
-v1–v4 journals (no ``mono`` / no trace fields / no autotune) still
-read and validate.  An operator can ``tail -f`` a live run's journal
+v6 adds the **incident** event (the flight recorder's detector-firing
+record, carrying the incident id + evidence payload that
+``specpride incident-replay`` re-derives from the stream alone).
+v1–v5 journals (no ``mono`` / no trace fields / no autotune / no
+incidents) still read and validate.  An operator can ``tail -f`` a live run's journal
 (every line is flushed as it is written) or feed one or more
 finished/dead journals to ``specpride stats`` for an aggregate
 post-mortem.
@@ -40,16 +43,18 @@ import re
 import threading
 import time
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 # versions read_events accepts: v2 added the monotonic `mono` envelope
 # field and the `span` event; v4 added the trace-context envelope
 # (trace_id / span ids) and the `clock_anchor` event; v5 added the
-# `autotune` decision event and the heartbeat `chunk_s` mirror.  v3 is
-# reserved — the live-telemetry-plane revision was docs-only, with no
-# envelope change, and the journal version skips it to keep the wire
-# and docs version numbers aligned; a v3 journal reads exactly like v2.
-ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, SCHEMA_VERSION})
+# `autotune` decision event and the heartbeat `chunk_s` mirror; v6
+# added the `incident` event (the flight recorder's detector-firing
+# record).  v3 is reserved — the live-telemetry-plane revision was
+# docs-only, with no envelope change, and the journal version skips it
+# to keep the wire and docs version numbers aligned; a v3 journal
+# reads exactly like v2.
+ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, 5, SCHEMA_VERSION})
 
 # event type -> required payload fields (the envelope v/ts/mono/event is
 # implied; extra fields are allowed — the schema is additive within a
@@ -172,6 +177,21 @@ EVENT_FIELDS: dict[str, frozenset] = {
     "autotune": frozenset(
         {"knob", "mode", "old", "new", "reason", "signal", "acted"}
     ),
+    # flight recorder (specpride_tpu.observability.flightrec, v6): one
+    # health-detector firing.  `detector` names the pure fold that
+    # fired; `reason` is its one-line justification; `clock` the
+    # triggering record's mono (the replay key — the event's own
+    # envelope mono is when the recorder thread got to writing it);
+    # `mode` the kill-switch position (observe|on); `bundled` whether
+    # an on-disk incident bundle was written (mode on only — observe
+    # journals the firing without dumping).  v6 gates the id/evidence
+    # payload (V6_EVENT_FIELDS below); optional fields: `bundle_dir`
+    # (the atomic bundle's final path), `suppressed` (firings the
+    # dedup window swallowed since the last journaled incident).
+    # `specpride incident-replay` re-derives every firing and its
+    # dedup decision bit-exact from the preceding stream alone.
+    "incident": frozenset({"detector", "reason", "clock", "mode",
+                           "bundled"}),
     # on-demand device profiling (`specpride profile` against a live
     # daemon): one bounded jax.profiler capture window
     "profile_start": frozenset({"seconds"}),
@@ -203,6 +223,10 @@ TRACE_EVENT_FIELDS: dict[str, frozenset] = {
     # as evidence (possibly empty — e.g. a fleet-spares decision between
     # jobs); the field itself is mandatory from v5 on
     "autotune": frozenset({"trace_ids"}),
+    # an incident joins the causal timeline through the newest evidence
+    # record that carried a trace id (or a content-derived id when none
+    # did — deterministic either way, so replay reproduces it)
+    "incident": frozenset({"trace_id"}),
 }
 
 # v5 additive requirements on PRE-EXISTING events: fields that became
@@ -215,6 +239,16 @@ TRACE_EVENT_FIELDS: dict[str, frozenset] = {
 # (journal-schema) enforces these at every emit site too.
 V5_EVENT_FIELDS: dict[str, frozenset] = {
     "heartbeat": frozenset({"chunk_s"}),
+}
+
+# v6 additive requirements: the `incident` event's identity + evidence
+# payload — gated exactly like the v5 fields above so the validator
+# (and `specpride lint`) treat every additive revision uniformly.
+# `incident_id` is content-derived (detector + trigger clock), so two
+# processes replaying the same stream mint the same id; `evidence` is
+# the detector's recorded state excerpt that incident-replay refolds.
+V6_EVENT_FIELDS: dict[str, frozenset] = {
+    "incident": frozenset({"incident_id", "evidence"}),
 }
 
 _TRACE_ID_RE = re.compile(r"[0-9a-f]{32}")
@@ -261,9 +295,14 @@ class Journal:
     def __init__(self, path: str | os.PathLike, rotate_mb: float = 0.0):
         self.path = str(path)
         self.trace_id: str | None = None
-        # in-process observer of every emitted record (called under the
-        # write lock; must be fast and must never raise into the emit)
-        self._tap = None
+        # in-process observers of every emitted record (called under
+        # the write lock; must be fast and must never raise into the
+        # emit).  A tuple, not a list: emits iterate it lock-free with
+        # respect to attach/detach, which swap the whole tuple under
+        # the lock — an observer set mutation never tears an emit's
+        # iteration.  Fire order is attach order (the autotune signal
+        # fold and the flight recorder may both tap one journal).
+        self._taps: tuple = ()
         self.rotate_bytes = int(max(float(rotate_mb), 0.0) * 1024 * 1024)
         # one journal is shared by the CLI thread, the pipelined executor's
         # packer thread, and the fetch pool; a lock keeps each event line
@@ -292,11 +331,27 @@ class Journal:
         self.trace_id = trace_id
 
     def set_tap(self, tap) -> None:
-        """Install (or clear, with None) the per-record observer.  Tap
-        exceptions are swallowed: a broken observer must never take the
-        journal — and the run — down with it."""
+        """Install (or clear, with None) the per-record observer set.
+        The legacy single-observer seam: REPLACES every installed tap —
+        hosts with more than one observer detach their own via
+        :meth:`detach_tap` instead.  Tap exceptions are swallowed: a
+        broken observer must never take the journal — and the run —
+        down with it."""
         with self._lock:
-            self._tap = tap
+            self._taps = () if tap is None else (tap,)
+
+    def detach_tap(self, tap) -> None:
+        """Remove ONE observer, leaving the others installed — the
+        multi-tap counterpart of ``set_tap(None)`` (the autotune
+        controller and the flight recorder detach independently at
+        drain, in either order).  Unknown taps are ignored.  Matched by
+        equality, not identity: ``obj.method`` mints a fresh bound-
+        method object on every attribute access, so the identity of the
+        attach-time reference is unrecoverable at detach time — bound-
+        method ``==`` compares the underlying (object, function) pair
+        instead."""
+        with self._lock:
+            self._taps = tuple(t for t in self._taps if t != tap)
 
     def attach_tap(self, tap) -> None:
         """Install the per-record observer WITH CATCH-UP: every record
@@ -327,7 +382,7 @@ class Journal:
                             tap(rec)
                         except Exception:
                             pass  # same contract as the live tap
-            self._tap = tap
+            self._taps = self._taps + (tap,)
 
     def _build_rec(self, event: str, fields: dict) -> dict:
         rec = {
@@ -357,9 +412,9 @@ class Journal:
             self._bytes += len(line)
             if self.rotate_bytes and self._bytes >= self.rotate_bytes:
                 self._rotate_locked()
-        if self._tap is not None:
+        for tap in self._taps:
             try:
-                self._tap(rec)
+                tap(rec)
             except Exception:
                 pass
 
@@ -444,6 +499,9 @@ class NullJournal:
         pass
 
     def attach_tap(self, tap) -> None:
+        pass
+
+    def detach_tap(self, tap) -> None:
         pass
 
     def emit(self, event: str, **fields) -> dict:
@@ -533,6 +591,14 @@ def validate_event(rec: object) -> list[str]:
         )
         if missing:
             problems.append(f"{event}: missing v5 fields {missing}")
+    # v6 additive requirements (incident identity + evidence): same
+    # version gate discipline as v5
+    if rec.get("v", 0) >= 6 and required is not None:
+        missing = sorted(
+            V6_EVENT_FIELDS.get(event, frozenset()) - rec.keys()
+        )
+        if missing:
+            problems.append(f"{event}: missing v6 fields {missing}")
     tid = rec.get("trace_id")
     if tid is not None and not (
         isinstance(tid, str) and _TRACE_ID_RE.fullmatch(tid)

@@ -283,6 +283,43 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
                 for e in at_events
             ],
         }
+    # flight recorder (--flightrec): incident rollup + the full log
+    # (rendered per-incident by `stats --incidents`, audited offline by
+    # `specpride incident-replay`)
+    inc_events = [e for e in events if e["event"] == "incident"]
+    if inc_events:
+        by_det: dict[str, dict] = {}
+        for e in inc_events:
+            d = str(e.get("detector"))
+            row = by_det.setdefault(
+                d, {"incidents": 0, "bundled": 0, "suppressed": 0}
+            )
+            row["incidents"] += 1
+            if e.get("bundled"):
+                row["bundled"] += 1
+            row["suppressed"] += int(e.get("suppressed") or 0)
+        run["incidents"] = {
+            "mode": inc_events[-1].get("mode"),
+            "incidents": len(inc_events),
+            "bundled": sum(1 for e in inc_events if e.get("bundled")),
+            "suppressed": sum(
+                int(e.get("suppressed") or 0) for e in inc_events
+            ),
+            "detectors": by_det,
+            "log": [
+                {
+                    "detector": e.get("detector"),
+                    "incident_id": e.get("incident_id"),
+                    "clock": e.get("clock"),
+                    "reason": e.get("reason"),
+                    "bundled": bool(e.get("bundled")),
+                    "suppressed": int(e.get("suppressed") or 0),
+                    **({"bundle_dir": e["bundle_dir"]}
+                       if e.get("bundle_dir") else {}),
+                }
+                for e in inc_events
+            ],
+        }
     if start:
         run.update(
             command=start.get("command"),
@@ -441,6 +478,47 @@ def _render_autotune(run: dict, out, detail: bool = False) -> None:
             )
 
 
+def _render_incidents(run: dict, out, detail: bool = False) -> None:
+    """The flight recorder's at-a-glance line from the journal's v6
+    `incident` events; ``stats --incidents`` adds the per-incident log
+    (detector, clock, reason, bundle) — the human view of the evidence
+    `specpride incident-replay` audits."""
+    inc = run.get("incidents")
+    if not inc:
+        if detail:
+            print(
+                "  incidents: none in this journal (was the run booted "
+                "with --flightrec observe|on?)", file=out,
+            )
+        return
+    per_det = " ".join(
+        f"{d}={row['incidents']}"
+        for d, row in sorted(inc.get("detectors", {}).items())
+    )
+    print(
+        f"  incidents: mode={inc.get('mode')} "
+        f"total={inc.get('incidents', 0)} "
+        f"bundled={inc.get('bundled', 0)} "
+        f"suppressed={inc.get('suppressed', 0)}"
+        + (f" {per_det}" if per_det else ""), file=out,
+    )
+    if detail:
+        for i in inc.get("log", ()):
+            mark = "bundled" if i.get("bundled") else "observed"
+            sup = (
+                f" (+{i['suppressed']} suppressed)"
+                if i.get("suppressed") else ""
+            )
+            where = (
+                f" -> {i['bundle_dir']}" if i.get("bundle_dir") else ""
+            )
+            print(
+                f"    {i.get('incident_id')} {i.get('detector')} "
+                f"@ {i.get('clock')}: {i.get('reason')} "
+                f"[{mark}]{sup}{where}", file=out,
+            )
+
+
 def _render_slo(run: dict, out) -> None:
     """``stats --slo``: the per-method SLO table from a serving
     journal's job_done evaluations (objective vs measured queue-wait +
@@ -500,7 +578,7 @@ def _render_rank_view(view: dict, out) -> None:
 
 
 def _render_run(run: dict, out, slo: bool = False,
-                autotune: bool = False) -> None:
+                autotune: bool = False, incidents: bool = False) -> None:
     head = (
         f"{run['journal']}: {run.get('command', '?')}"
         f"/{run.get('method', '?')} backend={run.get('backend', '?')}"
@@ -526,6 +604,7 @@ def _render_run(run: dict, out, slo: bool = False,
             if slo:
                 _render_slo(run, out)
         _render_autotune(run, out, detail=autotune)
+        _render_incidents(run, out, detail=incidents)
         return
     counters = run.get("counters", {})
     print(
@@ -574,6 +653,7 @@ def _render_run(run: dict, out, slo: bool = False,
         if slo:
             _render_slo(run, out)
     _render_autotune(run, out, detail=autotune)
+    _render_incidents(run, out, detail=incidents)
     ws = run.get("warmstart")
     if ws:
         bits = []
@@ -731,7 +811,7 @@ def _poll_rotated(
 def follow_stats(
     path: str, out=None, interval: float = 1.0, stop=None,
     max_updates: int = 0, top_spans: int = 0, slo: bool = False,
-    autotune: bool = False,
+    autotune: bool = False, incidents: bool = False,
 ) -> int:
     """``specpride stats --follow``: tail ONE live journal (a serving
     daemon's or a running batch job's) and re-render the summary every
@@ -774,7 +854,8 @@ def follow_stats(
                     f"event(s) ---", file=out,
                 )
                 _render_run(_summarize_run(path, segments[-1]), out,
-                            slo=slo, autotune=autotune)
+                            slo=slo, autotune=autotune,
+                            incidents=incidents)
                 from specpride_tpu.parallel.elastic import (
                     summarize_ranks,
                 )
@@ -802,6 +883,7 @@ def follow_stats(
 def run_stats(
     journal_paths: list[str], json_out: str | None = None, out=None,
     top_spans: int = 0, slo: bool = False, autotune: bool = False,
+    incidents: bool = False,
 ) -> int:
     out = out or sys.stdout
     files: list[str] = []
@@ -829,7 +911,8 @@ def run_stats(
             runs.append(_summarize_run(label, seg))
 
     for run in runs:
-        _render_run(run, out, slo=slo, autotune=autotune)
+        _render_run(run, out, slo=slo, autotune=autotune,
+                    incidents=incidents)
     # cross-rank fleet view: elastic liveness/reassignment rollup over
     # ALL the journals read (the per-rank .part shards merge here)
     from specpride_tpu.parallel.elastic import summarize_ranks

@@ -637,6 +637,22 @@ class Coordinator:
     def done_count(self) -> int:
         return sum(self._is_done(r.range_id) for r in self.ranges)
 
+    def counters(self) -> dict:
+        """This rank's lease-state counters as one dict — the view the
+        run summary records in ``stats.elastic`` and the flight
+        recorder snapshots into every incident bundle (``host.json``):
+        store-derived state a dead rank's journal alone cannot
+        reconstruct."""
+        return {
+            "ranges_run": self.ranges_run,
+            "ranges_committed": self.done_count(),
+            "lease_expires_observed": self.lease_expires_observed,
+            "reassignments": self.reassignments,
+            "lease_splits": self.lease_splits,
+            "steals": self.steals,
+            "cas_conflicts": self.cas_conflicts,
+        }
+
     # -- work-stealing (tier 2) -----------------------------------------
 
     def _steal_candidates(self) -> list[tuple[float, ChunkRange, dict]]:

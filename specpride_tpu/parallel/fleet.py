@@ -86,6 +86,8 @@ class FleetSupervisor:
         scale_horizon: float = DEFAULT_SCALE_HORIZON_S,
         env: dict | None = None,
         autotune: str = "off",
+        flightrec: str = "off",
+        incident_dir: str | None = None,
     ):
         spec = extract_flag(job_argv, "--elastic")
         if not spec:
@@ -148,6 +150,55 @@ class FleetSupervisor:
                 set=lambda n: setattr(self, "spares", max(int(n), 0)),
             )
             self.controller = ctl
+        # flight recorder: off constructs nothing (byte-identical to a
+        # recorder-free supervisor); observe/on fold the fleet journal
+        # into health detectors next to the controller's tap
+        if flightrec not in ("off", "observe", "on"):
+            raise ValueError(
+                f"fleet flightrec {flightrec!r} must be off, observe "
+                "or on"
+            )
+        self.flightrec = flightrec
+        self.recorder = None
+        if flightrec != "off":
+            if journal is None or not getattr(journal, "enabled", False):
+                raise ValueError(
+                    "fleet --flightrec observe|on requires --journal: "
+                    "the detectors fold the journal stream"
+                )
+            from specpride_tpu.observability.flightrec import (
+                FlightRecorder,
+            )
+            ctl = self.controller
+            self.recorder = FlightRecorder(
+                journal,
+                mode=flightrec,
+                incident_dir=incident_dir,
+                autotune_fn=(
+                    (lambda: {"status": ctl.status(),
+                              "knobs": ctl.knob_values()})
+                    if ctl is not None else None
+                ),
+                extra_fn=lambda: {
+                    "procs_alive": sum(
+                        1 for p in self.procs if p.poll() is None
+                    ),
+                    "spawned": self.spawned,
+                    "retired": self.retired,
+                    "replaced": self.replaced,
+                    "failures": list(self.failures),
+                },
+                config={
+                    "host": "fleet",
+                    "elastic": self.spec,
+                    "ranks": self.ranks,
+                    "spares": self.spares,
+                    "max_ranks": self.max_ranks,
+                    "ttl_s": self.ttl,
+                    "autotune": autotune,
+                    "flightrec": flightrec,
+                },
+            ).start()
 
     # -- store views -----------------------------------------------------
 
@@ -361,6 +412,10 @@ class FleetSupervisor:
         finally:
             if self.controller is not None:
                 self.controller.close()
+            if self.recorder is not None:
+                # drains queued firings into the journal BEFORE the
+                # caller closes it — a dying fleet keeps its evidence
+                self.recorder.stop()
             for proc in self.procs:
                 if proc.poll() is None:
                     proc.terminate()
@@ -397,5 +452,9 @@ class FleetSupervisor:
                     **self.controller.status(), "spares": self.spares,
                 }}
                 if self.controller is not None else {}
+            ),
+            **(
+                {"flightrec": self.recorder.status()}
+                if self.recorder is not None else {}
             ),
         }

@@ -180,6 +180,8 @@ class ServeDaemon:
         autotune: str = "off",
         autotune_interval: float = 1.0,
         autotune_batch_window: tuple | None = None,
+        flightrec: str = "off",
+        incident_dir: str | None = None,
     ):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.compile_cache = compile_cache
@@ -255,6 +257,19 @@ class ServeDaemon:
         )
         self.controller = None  # autotune.Controller, built at boot
         self._controller_thread = None
+        # flight recorder (observability.flightrec): off = no recorder
+        # object exists at all (byte-identical to a recorder-free
+        # build); observe = detector firings journal as `incident`
+        # events; on = firings also dump atomic bundles under
+        # --incident-dir
+        if flightrec not in ("off", "observe", "on"):
+            raise ValueError(
+                f"flightrec mode {flightrec!r} must be off, observe "
+                "or on"
+            )
+        self.flightrec = flightrec
+        self.incident_dir = incident_dir
+        self.recorder = None  # flightrec.FlightRecorder, built at boot
         # worker parking (autotune workers knob) needs lanes to poll the
         # pop so a parked lane can re-check; every other mode keeps the
         # blocking pop — the exact pre-autotune behavior
@@ -429,6 +444,7 @@ class ServeDaemon:
             ).start()
         self._boot_warmup(state)
         self._boot_autotune()
+        self._boot_flightrec()
         sock_dir = os.path.dirname(self.socket_path)
         if sock_dir:
             os.makedirs(sock_dir, exist_ok=True)
@@ -469,6 +485,10 @@ class ServeDaemon:
                 "autotune_batch_window_ms": list(
                     self.autotune_batch_window)}
                if self.autotune != "off" else {}),
+            **({"flightrec": self.flightrec,
+                **({"incident_dir": self.incident_dir}
+                   if self.incident_dir else {})}
+               if self.flightrec != "off" else {}),
         )
         logger.info(
             "serving on %s (boot %.2fs, %d kernel variants warmed, "
@@ -528,6 +548,59 @@ class ServeDaemon:
             "[%g, %g] ms", self.autotune,
             ",".join(ctl.status()["knobs"]), self.autotune_interval,
             lo_ms, hi_ms,
+        )
+
+    def _boot_flightrec(self) -> None:
+        """Construct the flight recorder (``--flightrec observe|on``):
+        an always-on ring of recent journal records plus the health
+        detector set, tapping the daemon journal next to the autotune
+        controller.  ``off`` builds nothing — the kill switch is the
+        absence of the recorder, so an off daemon is byte-identical to
+        a recorder-free build."""
+        if self.flightrec == "off":
+            return
+        if self.journal is None or not self.journal.enabled:
+            raise SystemExit(
+                "serve --flightrec observe|on requires --journal: the "
+                "detectors fold the journal stream"
+            )
+        from specpride_tpu.observability.flightrec import FlightRecorder
+
+        ctl = self.controller
+        self.recorder = FlightRecorder(
+            self.journal,
+            mode=self.flightrec,
+            incident_dir=self.incident_dir,
+            metrics_fn=self.telemetry.exposition,
+            autotune_fn=(
+                (lambda: {"status": ctl.status(),
+                          "knobs": ctl.knob_values()})
+                if ctl is not None else None
+            ),
+            config={
+                "host": "serve",
+                "socket": self.socket_path,
+                "workers": len(self.slots),
+                "max_queue": self.queue.capacity,
+                "batch_window_s": self.batch_window,
+                "batch_max_clusters": self.batch_max_clusters,
+                "precision": self.precision,
+                "layout": self.layout,
+                "donate": self.donate,
+                "warmup": self.warmup,
+                "watchdog_timeout_s": self.watchdog.timeout_s,
+                "slo": self.slo,
+                "autotune": self.autotune,
+                "flightrec": self.flightrec,
+            },
+            telemetry=self.telemetry,
+        ).start()
+        logger.info(
+            "flightrec %s: %d detectors, ring %d%s", self.flightrec,
+            len(self.recorder.detect.detectors),
+            self.recorder.ring.capacity,
+            f", bundles under {self.incident_dir}"
+            if self.incident_dir else "",
         )
 
     def _sample_live(self, telemetry) -> None:
@@ -1511,6 +1584,13 @@ class ServeDaemon:
                 "its journal events may be dropped"
             )
         self.watchdog.stop()
+        # the flight recorder stops after the workers joined (their
+        # final job/watchdog events still fold and can journal
+        # incidents) and BEFORE the metrics flush + journal close:
+        # stop() drains every queued firing, so no incident evidence
+        # is swallowed by the drain
+        if self.recorder is not None:
+            self.recorder.stop()
         # final telemetry: the exporter stops AFTER the worker joined so
         # the last snapshot carries every job, and --metrics-out flushes
         # the same exposition a scraper would have read — a drained
@@ -1593,7 +1673,30 @@ class ServeDaemon:
                 }}
                 if self.controller is not None else {}
             ),
+            **(
+                {"flightrec": self.recorder.status()}
+                if self.recorder is not None else {}
+            ),
         }
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Block until no job is admitted, queued, batched or in
+        flight — the deterministic seam tests (and scripted probes)
+        use between 'the client got its reply' and 'the daemon's
+        internal accounting settled': a reply is written BEFORE the
+        worker drops the job from ``_inflight_by``, so a scrape right
+        after a reply can otherwise race the residue.  Returns False
+        on timeout."""
+        deadline = time.perf_counter() + max(float(timeout), 0.0)
+        while time.perf_counter() < deadline:
+            if (
+                not self._inflight_by
+                and len(self.queue) == 0
+                and not any(self._batch_backlog.values())
+            ):
+                return True
+            time.sleep(0.002)
+        return False
 
     @staticmethod
     def _close(conn, fh) -> None:

@@ -446,7 +446,7 @@ class TestControllerEndToEnd:
         assert ticked.wait(timeout=10.0)
         t.stop()
         j.close()
-        assert ctl.journal._tap is None  # stop() detached the tap
+        assert ctl.journal._taps == ()  # stop() detached the tap
 
 
 # -- replay: the determinism audit --------------------------------------

@@ -337,6 +337,10 @@ class TestLiveExporter:
         t2.join(timeout=120)
         assert terms["first"]["status"] == "done"
         assert terms["second"]["status"] == "done"
+        # a reply is written BEFORE the worker drops the job from its
+        # in-flight map — wait for the accounting to settle, or this
+        # scrape races the residue on slow 1-core hosts
+        assert d.wait_idle(10.0)
 
         samples, _ = _scrape(d)
         assert _get(

@@ -1,0 +1,660 @@
+"""Flight recorder + incident plane: detector units over synthetic
+streams, the dedup cooldown's replayable accounting, ring-buffer
+overwrite + torn-read hammer, atomic bundle dumps (and the ``.tmp-``
+debris a mid-dump kill leaves), recorder end-to-end through a real
+journal (catch-up included), ``incident-replay`` bit-parity on daemon
+and merged 2-rank elastic journals, and the ``off`` kill switch
+constructing no recorder at all."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from specpride_tpu.observability.detect import (
+    DEFAULT_PARAMS,
+    DETECTOR_NAMES,
+    DetectorSet,
+    derived_trace_id,
+    incident_id,
+)
+from specpride_tpu.observability.flightrec import (
+    FlightRecorder,
+    RingBuffer,
+    config_digest,
+    find_bundle,
+    list_bundles,
+    replay_incidents,
+)
+from specpride_tpu.observability.journal import (
+    Journal,
+    read_events,
+    validate_event,
+)
+from specpride_tpu.serve.daemon import ServeDaemon
+
+TRACE = "ab" * 16  # any 32-hex id satisfies the v4 trace envelope
+
+
+def _fold(recs):
+    """One fresh DetectorSet over a synthetic record list; returns
+    every firing in stream order."""
+    det = DetectorSet()
+    out = []
+    for rec in recs:
+        out.extend(det.observe(rec))
+    return out
+
+
+def _job_done(mono, *, ok=None, wall=0.01, job="j"):
+    rec = {"event": "job_done", "mono": mono, "job_id": job,
+           "status": "done", "wall_s": wall, "trace_id": TRACE}
+    if ok is not None:
+        rec["slo_ok"] = ok
+    return rec
+
+
+# -- detector units: each fires and clears on a synthetic stream --------
+
+
+class TestDetectors:
+    def test_slo_breach_fires_on_streak_and_clears_on_ok(self):
+        streak = DEFAULT_PARAMS["slo_breach"]["streak"]
+        recs = [_job_done(float(i), ok=False) for i in range(streak)]
+        fired = _fold(recs)
+        assert [f["detector"] for f in fired] == ["slo_breach"]
+        assert fired[0]["evidence"]["streak"] == streak
+        # an ok job resets the streak: the same breaches spread around
+        # a success never fire
+        recs = [_job_done(0.0, ok=False), _job_done(1.0, ok=False),
+                _job_done(2.0, ok=True), _job_done(3.0, ok=False)]
+        assert _fold(recs) == []
+
+    def test_slo_breach_ignores_uncovered_jobs(self):
+        # jobs with no objective (slo_ok absent) are not breaches
+        assert _fold([_job_done(float(i)) for i in range(9)]) == []
+
+    def test_latency_spike_after_seeding(self):
+        p = DEFAULT_PARAMS["latency_spike"]
+        recs = [_job_done(float(i), wall=0.1)
+                for i in range(p["min_jobs"])]
+        recs.append(_job_done(99.0, wall=0.1 * p["factor"] * 2))
+        fired = _fold(recs)
+        assert [f["detector"] for f in fired] == ["latency_spike"]
+        assert fired[0]["evidence"]["ratio"] > p["factor"]
+
+    def test_latency_spike_not_before_min_jobs(self):
+        recs = [_job_done(0.0, wall=0.1), _job_done(1.0, wall=100.0)]
+        assert _fold(recs) == []
+
+    def test_queue_sat_needs_announced_capacity(self):
+        queued = [{"event": "job_queued", "mono": float(i), "job_id": i,
+                   "client": "t", "trace_id": TRACE} for i in range(10)]
+        assert _fold(queued) == []  # no serve_start: bound unknown
+        start = {"event": "serve_start", "mono": 0.0,
+                 "socket": "s", "max_queue": 10}
+        fired = _fold([start] + queued)
+        assert fired and fired[0]["detector"] == "queue_sat"
+        assert fired[0]["evidence"]["queue_depth"] == 9  # 0.9 * 10
+
+    def test_queue_sat_drains_on_job_start(self):
+        start = {"event": "serve_start", "mono": 0.0,
+                 "socket": "s", "max_queue": 10}
+        recs = [start]
+        for i in range(20):  # every queued job starts promptly
+            recs.append({"event": "job_queued", "mono": float(i),
+                         "job_id": i, "client": "t", "trace_id": TRACE})
+            recs.append({"event": "job_start", "mono": i + 0.5,
+                         "job_id": i, "trace_id": TRACE})
+        assert _fold(recs) == []
+
+    def test_watchdog_fires_on_every_stall(self):
+        rec = {"event": "watchdog_stall", "mono": 5.0, "lane": 1,
+               "elapsed_s": 31.0, "timeout_s": 30.0}
+        fired = _fold([rec])
+        assert [f["detector"] for f in fired] == ["watchdog"]
+        assert fired[0]["evidence"]["lane"] == 1
+
+    def test_retry_exhaust_on_attempt_threshold(self):
+        need = DEFAULT_PARAMS["retry_exhaust"]["attempts"]
+        recs = [{"event": "retry", "mono": float(i), "site": "dispatch",
+                 "attempt": i, "backoff_s": 0.1} for i in range(need)]
+        fired = _fold(recs)
+        assert [f["detector"] for f in fired] == ["retry_exhaust"]
+        assert fired[0]["evidence"]["attempt"] == need - 1
+
+    def test_solo_burst_counts_only_fallbacks_in_window(self):
+        def dispatch(mono, status):
+            return {"event": "batch_dispatch", "mono": mono,
+                    "batch_id": 1, "jobs": [1], "n_jobs": 1,
+                    "n_clusters": 4, "window_wait_s": 0.0,
+                    "status": status, "trace_ids": [TRACE]}
+        count = DEFAULT_PARAMS["solo_burst"]["count"]
+        window = DEFAULT_PARAMS["solo_burst"]["window_s"]
+        # shared dispatches never count
+        assert _fold([dispatch(float(i), "shared")
+                      for i in range(count * 2)]) == []
+        # fallbacks spread wider than the window never reach the count
+        spread = [dispatch(i * window, "fallback_solo")
+                  for i in range(count * 2)]
+        assert _fold(spread) == []
+        burst = [dispatch(float(i), "fallback_solo")
+                 for i in range(count)]
+        fired = _fold(burst)
+        assert [f["detector"] for f in fired] == ["solo_burst"]
+
+    def test_lease_churn_over_the_lifecycle_events(self):
+        count = DEFAULT_PARAMS["lease_churn"]["count"]
+        recs = []
+        for i in range(count):
+            recs.append({"event": "lease_expire", "mono": float(i),
+                         "rank": 0, "range": i})
+        fired = _fold(recs)
+        assert [f["detector"] for f in fired] == ["lease_churn"]
+        assert fired[0]["evidence"]["churn"] == count
+
+    def test_incident_events_never_feed_back(self):
+        # the recorder's own output must not trigger detectors
+        rec = {"event": "incident", "mono": 1.0, "detector": "watchdog",
+               "reason": "x", "clock": 1.0, "mode": "observe",
+               "bundled": False}
+        det = DetectorSet()
+        assert det.observe(rec) == []
+
+
+# -- dedup: the cooldown window and its replayable accounting -----------
+
+
+class TestDedup:
+    def _stall(self, mono):
+        return {"event": "watchdog_stall", "mono": mono, "lane": 0,
+                "elapsed_s": 1.0, "timeout_s": 0.5}
+
+    def test_cooldown_suppresses_and_rides_next_incident(self):
+        cd = DEFAULT_PARAMS["cooldown_s"]
+        det = DetectorSet()
+        first = det.observe(self._stall(0.0))
+        assert len(first) == 1 and first[0]["suppressed"] == 0
+        # two firings inside the window are swallowed, accounted
+        assert det.observe(self._stall(cd * 0.3)) == []
+        assert det.observe(self._stall(cd * 0.6)) == []
+        assert det.suppressed == 2
+        after = det.observe(self._stall(cd + 1.0))
+        assert len(after) == 1 and after[0]["suppressed"] == 2
+
+    def test_cooldown_is_per_detector(self):
+        det = DetectorSet()
+        assert len(det.observe(self._stall(0.0))) == 1
+        # a different detector inside the watchdog's window still fires
+        need = DEFAULT_PARAMS["retry_exhaust"]["attempts"]
+        fired = det.observe({"event": "retry", "mono": 1.0,
+                             "site": "s", "attempt": need - 1,
+                             "backoff_s": 0.1})
+        assert [f["detector"] for f in fired] == ["retry_exhaust"]
+
+    def test_identity_is_content_derived(self):
+        # two folds of the same stream mint the same ids — the replay
+        # bit-parity contract
+        a = _fold([self._stall(7.5)])[0]
+        b = _fold([self._stall(7.5)])[0]
+        assert a["incident_id"] == b["incident_id"]
+        assert a["incident_id"] == incident_id("watchdog", 7.5)
+        assert a["trace_id"] == derived_trace_id("watchdog", 7.5)
+        assert len(a["trace_id"]) == 32  # v4 envelope shape
+
+    def test_trigger_trace_id_preferred(self):
+        fired = _fold([_job_done(float(i), ok=False)
+                       for i in range(3)])
+        assert fired[0]["trace_id"] == TRACE
+
+
+# -- ring buffer: overwrite + torn-read hammer --------------------------
+
+
+class TestRingBuffer:
+    def test_overwrite_keeps_newest(self):
+        ring = RingBuffer(4)
+        for i in range(10):
+            ring.append({"i": i})
+        assert len(ring) == 4
+        assert ring.appended == 10
+        assert [r["i"] for r in ring.snapshot()] == [6, 7, 8, 9]
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            RingBuffer(0)
+
+    def test_snapshot_under_append_hammer(self):
+        """Concurrent appends must never tear a snapshot: every copy is
+        a contiguous, in-order window of the stream."""
+        ring = RingBuffer(64)
+        stop = threading.Event()
+        errors: list = []
+
+        def _write():
+            i = 0
+            while not stop.is_set():
+                ring.append({"i": i})
+                i += 1
+
+        def _read():
+            try:
+                for _ in range(2000):
+                    snap = ring.snapshot()
+                    assert len(snap) <= 64
+                    seq = [r["i"] for r in snap]
+                    # contiguous window: strictly consecutive ints
+                    assert seq == list(range(seq[0], seq[0] + len(seq))) \
+                        if seq else True
+            except Exception as e:  # noqa: BLE001 - report to main
+                errors.append(e)
+
+        w = threading.Thread(target=_write, daemon=True)
+        readers = [threading.Thread(target=_read, daemon=True)
+                   for _ in range(3)]
+        w.start()
+        for r in readers:
+            r.start()
+        for r in readers:
+            r.join(timeout=60)
+        stop.set()
+        w.join(timeout=60)
+        assert not errors, errors
+
+
+# -- bundles: atomic dumps and the read side ----------------------------
+
+
+class TestBundles:
+    def _recorder(self, tmp_path, **kw):
+        j = Journal(str(tmp_path / "j.jsonl"))
+        rec = FlightRecorder(
+            j, mode="on", incident_dir=str(tmp_path / "incidents"),
+            **kw,
+        ).start()
+        return j, rec
+
+    def _trigger(self, j):
+        j.emit("watchdog_stall", lane=0, elapsed_s=2.0, timeout_s=1.0)
+
+    def test_bundle_layout_and_manifest(self, tmp_path):
+        cfg = {"host": "test", "workers": 2}
+        j, rec = self._recorder(
+            tmp_path,
+            metrics_fn=lambda: "# HELP x\n",
+            autotune_fn=lambda: {"knobs": {"workers": 2}},
+            extra_fn=lambda: {"ranks": 1},
+            config=cfg,
+        )
+        self._trigger(j)
+        rec.stop()
+        j.close()
+        bundles, warnings = list_bundles(str(tmp_path / "incidents"))
+        assert warnings == []
+        assert len(bundles) == 1
+        b = bundles[0]
+        assert b["schema"] == 1
+        assert b["incident"]["detector"] == "watchdog"
+        assert b["incident"]["mode"] == "on"
+        for fname in ("ring.jsonl", "stacks.txt", "journal_tail.jsonl",
+                      "metrics.prom", "autotune.json", "host.json",
+                      "config.json", "manifest.json"):
+            path = os.path.join(b["dir"], fname)
+            assert os.path.exists(path), fname
+            assert fname == "manifest.json" or fname in b["files"]
+        conf = json.loads(
+            open(os.path.join(b["dir"], "config.json")).read()
+        )
+        assert conf["config"] == cfg
+        assert conf["digest"] == config_digest(cfg)
+        # the ring dump holds the trigger record
+        ring = [json.loads(ln) for ln in
+                open(os.path.join(b["dir"], "ring.jsonl"))]
+        assert any(r["event"] == "watchdog_stall" for r in ring)
+        stacks = open(os.path.join(b["dir"], "stacks.txt")).read()
+        assert "--- thread" in stacks
+
+    def test_failing_section_degrades_not_fails(self, tmp_path):
+        def boom():
+            raise RuntimeError("scrape died")
+        j, rec = self._recorder(tmp_path, metrics_fn=boom)
+        self._trigger(j)
+        rec.stop()
+        j.close()
+        bundles, _ = list_bundles(str(tmp_path / "incidents"))
+        assert len(bundles) == 1
+        assert "metrics.error.txt" in bundles[0]["files"]
+        assert "metrics.prom" not in bundles[0]["files"]
+        # the incident still journaled as bundled
+        events, violations = read_events(str(tmp_path / "j.jsonl"))
+        assert violations == []
+        inc = [e for e in events if e["event"] == "incident"]
+        assert inc and inc[0]["bundled"] is True
+
+    def test_tmp_debris_skipped_silently(self, tmp_path):
+        """The atomicity contract: a kill mid-dump leaves only a
+        ``.tmp-`` staging dir, which the read side ignores without
+        even a warning."""
+        inc_dir = tmp_path / "incidents"
+        debris = inc_dir / "deadbeef00000000-watchdog.tmp-12345"
+        debris.mkdir(parents=True)
+        (debris / "ring.jsonl").write_text("{}\n")  # no manifest yet
+        bundles, warnings = list_bundles(str(inc_dir))
+        assert bundles == [] and warnings == []
+
+    def test_manifestless_dir_is_a_warning(self, tmp_path):
+        inc_dir = tmp_path / "incidents"
+        (inc_dir / "odd-dir").mkdir(parents=True)
+        bundles, warnings = list_bundles(str(inc_dir))
+        assert bundles == []
+        assert warnings and "unreadable manifest" in warnings[0]
+
+    def test_future_schema_refused(self, tmp_path):
+        inc_dir = tmp_path / "incidents"
+        d = inc_dir / "aa00-watchdog"
+        d.mkdir(parents=True)
+        (d / "manifest.json").write_text(json.dumps({"schema": 99}))
+        bundles, warnings = list_bundles(str(inc_dir))
+        assert bundles == []
+        assert warnings and "newer than this build" in warnings[0]
+
+    def test_find_bundle_prefix_match(self, tmp_path):
+        j, rec = self._recorder(tmp_path)
+        self._trigger(j)
+        rec.stop()
+        j.close()
+        bundles, _ = list_bundles(str(tmp_path / "incidents"))
+        full = bundles[0]["incident"]["incident_id"]
+        hit = find_bundle(str(tmp_path / "incidents"), full[:6])
+        assert hit is not None
+        assert hit["incident"]["incident_id"] == full
+        assert find_bundle(str(tmp_path / "incidents"), "zzzz") is None
+
+
+# -- recorder end-to-end over a real journal ----------------------------
+
+
+class TestRecorderEndToEnd:
+    def test_observe_journals_schema_valid_incidents(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        rec = FlightRecorder(j, mode="observe").start()
+        j.emit("watchdog_stall", lane=0, elapsed_s=2.0, timeout_s=1.0)
+        rec.stop()  # drains the queued firing before returning
+        j.close()
+        events, violations = read_events(path)
+        assert violations == []
+        inc = [e for e in events if e["event"] == "incident"]
+        assert len(inc) == 1
+        e = inc[0]
+        assert validate_event(e) == []
+        assert e["detector"] == "watchdog"
+        assert e["mode"] == "observe"
+        assert e["bundled"] is False
+        assert "bundle_dir" not in e
+        assert e["incident_id"] == incident_id("watchdog", e["clock"])
+        assert rec.status()["fired"] == 1
+
+    def test_catch_up_folds_pre_attach_records(self, tmp_path):
+        """attach_tap catch-up: breaches journaled BEFORE the recorder
+        started still fire — ring + detector state equal fold(file)
+        from line one."""
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        for i in range(3):
+            j.emit("job_done", job_id=i, status="done", wall_s=0.01,
+                   slo_ok=False, trace_id=TRACE)
+        rec = FlightRecorder(j, mode="observe").start()
+        rec.stop()
+        j.close()
+        events, _ = read_events(path)
+        inc = [e for e in events if e["event"] == "incident"]
+        assert [e["detector"] for e in inc] == ["slo_breach"]
+        assert rec.ring.appended >= 3
+
+    def test_mode_on_requires_incident_dir(self, tmp_path):
+        j = Journal(str(tmp_path / "j.jsonl"))
+        with pytest.raises(ValueError):
+            FlightRecorder(j, mode="on")
+        with pytest.raises(ValueError):
+            FlightRecorder(j, mode="bogus")
+        j.close()
+
+    def test_no_firings_means_no_extra_events(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        rec = FlightRecorder(j, mode="observe").start()
+        for i in range(5):
+            j.emit("job_done", job_id=i, status="done", wall_s=0.01,
+                   trace_id=TRACE)
+        rec.stop()
+        j.close()
+        events, _ = read_events(path)
+        assert [e["event"] for e in events] == ["job_done"] * 5
+        assert rec.status()["fired"] == 0
+
+
+# -- the off kill switch: no recorder object at all ---------------------
+
+
+class TestOffKillSwitch:
+    def test_daemon_default_builds_no_recorder(self, tmp_path):
+        d = ServeDaemon(str(tmp_path / "s.sock"))
+        assert d.flightrec == "off"
+        assert d.recorder is None
+        d._boot_flightrec()  # off: a no-op, constructs nothing
+        assert d.recorder is None
+        assert "flightrec" not in d.status()
+
+    def test_daemon_validates_mode(self, tmp_path):
+        with pytest.raises(ValueError):
+            ServeDaemon(str(tmp_path / "s.sock"), flightrec="bogus")
+
+    def test_daemon_observe_requires_journal(self, tmp_path):
+        d = ServeDaemon(str(tmp_path / "s.sock"), flightrec="observe")
+        with pytest.raises(SystemExit):
+            d._boot_flightrec()
+
+
+# -- incident-replay: the determinism audit -----------------------------
+
+
+def _daemon_style_journal(tmp_path, mode="observe"):
+    """A serving-shaped journal with two incidents (slo_breach +
+    watchdog) recorded live by a real recorder."""
+    path = str(tmp_path / "serve.jsonl")
+    j = Journal(path)
+    kw = {}
+    if mode == "on":
+        kw["incident_dir"] = str(tmp_path / "incidents")
+    rec = FlightRecorder(j, mode=mode, **kw).start()
+    j.emit("serve_start", socket="s", max_queue=16)
+    for i in range(3):
+        j.emit("job_done", job_id=i, status="done", wall_s=0.01,
+               slo_ok=False, trace_id=TRACE)
+    j.emit("watchdog_stall", lane=0, elapsed_s=2.0, timeout_s=1.0)
+    rec.stop()
+    j.close()
+    return path
+
+
+class TestIncidentReplay:
+    def test_daemon_journal_reproduces_bit_exact(self, tmp_path):
+        path = _daemon_style_journal(tmp_path)
+        res = replay_incidents(path)
+        assert res["ok"], res
+        assert res["incidents"] == 2
+        assert res["reproduced"] == 2
+        assert res["mismatches"] == []
+        assert res["unjournaled"] == []
+        assert res["by_detector"] == {"slo_breach": 1, "watchdog": 1}
+
+    def test_bundled_mode_reproduces_too(self, tmp_path):
+        path = _daemon_style_journal(tmp_path, mode="on")
+        res = replay_incidents(path)
+        assert res["ok"], res
+        assert res["bundled"] == 2
+
+    def test_flapping_dedup_accounting_replays(self, tmp_path):
+        """A flapping detector journals ONE incident per cooldown
+        window; the suppressed count is part of the bit-parity."""
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        rec = FlightRecorder(j, mode="observe").start()
+        for _ in range(5):  # well inside one 30s cooldown window
+            j.emit("watchdog_stall", lane=0, elapsed_s=2.0,
+                   timeout_s=1.0)
+        rec.stop()
+        j.close()
+        events, _ = read_events(path)
+        inc = [e for e in events if e["event"] == "incident"]
+        assert len(inc) == 1  # no bundle storm
+        assert rec.status()["suppressed"] == 4
+        res = replay_incidents(path)
+        assert res["ok"], res
+        assert res["incidents"] == 1
+
+    def test_tampered_incident_fails_replay(self, tmp_path):
+        path = _daemon_style_journal(tmp_path)
+        lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        for rec in lines:
+            if rec.get("event") == "incident":
+                rec["incident_id"] = "0" * 16  # forge the identity
+                break
+        with open(path, "w", encoding="utf-8") as fh:
+            for rec in lines:
+                fh.write(json.dumps(rec) + "\n")
+        res = replay_incidents(path)
+        assert not res["ok"]
+        assert any("incident_id" in m for m in res["mismatches"])
+
+    def test_observe_mode_claiming_bundled_fails(self, tmp_path):
+        path = _daemon_style_journal(tmp_path)
+        lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        for rec in lines:
+            if rec.get("event") == "incident":
+                rec["bundled"] = True  # observe mode must never bundle
+                break
+        with open(path, "w", encoding="utf-8") as fh:
+            for rec in lines:
+                fh.write(json.dumps(rec) + "\n")
+        res = replay_incidents(path)
+        assert not res["ok"]
+        assert any("bundled=true in observe mode" in m
+                   for m in res["mismatches"])
+
+    def test_dead_recorder_is_a_warning_not_a_failure(self, tmp_path):
+        """Triggers with no incident events (a recorder killed before
+        draining, or an off run) refold as `unjournaled` warnings —
+        the stream holds MORE evidence than the recorder wrote."""
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        j.emit("watchdog_stall", lane=0, elapsed_s=2.0, timeout_s=1.0)
+        j.close()
+        res = replay_incidents(path)
+        assert res["ok"]
+        assert res["incidents"] == 0
+        assert len(res["unjournaled"]) == 1
+
+    def test_two_rank_elastic_shards_replay_independently(self, tmp_path):
+        """Merged ``.part<rank>`` journals: each rank's stream refolds
+        through its own fresh DetectorSet — rank 0's churn must not
+        leak into rank 1's fold."""
+        base = str(tmp_path / "el.jsonl")
+        count = DEFAULT_PARAMS["lease_churn"]["count"]
+        for rank in range(2):
+            j = Journal(f"{base}.part{rank:05d}")
+            rec = FlightRecorder(j, mode="observe").start()
+            j.emit("heartbeat", rank=rank, chunk_s=0.5)
+            n = count if rank == 0 else count - 1  # rank 1: below bar
+            for i in range(n):
+                j.emit("lease_expire", rank=rank, range=i)
+            rec.stop()
+            j.close()
+        res = replay_incidents(base)
+        assert res["ok"], res
+        assert res["streams"] == 2
+        assert res["incidents"] == 1  # rank 0 only
+        assert res["by_detector"] == {"lease_churn": 1}
+
+
+# -- CLI surface ---------------------------------------------------------
+
+
+class TestCli:
+    def test_incident_replay_exit_codes(self, tmp_path, capsys):
+        from specpride_tpu.cli import main as cli_main
+
+        path = _daemon_style_journal(tmp_path)
+        assert cli_main(["incident-replay", path]) == 0
+        out = capsys.readouterr().out
+        assert "reproduced: 2/2" in out and "ok" in out
+        # tamper -> exit 1
+        lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        for rec in lines:
+            if rec.get("event") == "incident":
+                rec["reason"] = "forged"
+                break
+        with open(path, "w", encoding="utf-8") as fh:
+            for rec in lines:
+                fh.write(json.dumps(rec) + "\n")
+        assert cli_main(["incident-replay", path]) == 1
+
+    def test_incidents_list_show_export(self, tmp_path, capsys,
+                                        monkeypatch):
+        from specpride_tpu.cli import main as cli_main
+
+        _daemon_style_journal(tmp_path, mode="on")
+        inc_dir = str(tmp_path / "incidents")
+        assert cli_main(["incidents", "list", inc_dir]) == 0
+        out = capsys.readouterr().out
+        assert "watchdog" in out and "slo_breach" in out
+        iid = out.split()[0]
+        assert cli_main(["incidents", "show", inc_dir, iid]) == 0
+        shown = capsys.readouterr().out
+        assert json.loads(shown)["incident"]["incident_id"] == iid
+        monkeypatch.chdir(tmp_path)
+        assert cli_main(["incidents", "export", inc_dir, iid]) == 0
+        tarball = capsys.readouterr().out.strip()
+        assert os.path.exists(tarball)
+
+    def test_stats_renders_incidents(self, tmp_path, capsys):
+        from specpride_tpu.cli import main as cli_main
+
+        path = _daemon_style_journal(tmp_path)
+        assert cli_main(["stats", path, "--incidents"]) == 0
+        out = capsys.readouterr().out
+        assert "incidents:" in out
+        assert "watchdog" in out
+
+
+# -- telemetry: the incident metric families ----------------------------
+
+
+class TestIncidentMetrics:
+    def test_counters_pre_registered_per_detector(self):
+        from specpride_tpu.observability.exporter import ServeTelemetry
+
+        t = ServeTelemetry()
+        text = t.exposition()
+        for det in DETECTOR_NAMES:
+            assert (
+                f'specpride_incidents_total{{detector="{det}"}} 0'
+                in text
+            ), det
+        assert "specpride_incidents_suppressed_total" in text
+
+    def test_recorder_bumps_the_counters(self, tmp_path):
+        from specpride_tpu.observability.exporter import ServeTelemetry
+
+        t = ServeTelemetry()
+        j = Journal(str(tmp_path / "j.jsonl"))
+        rec = FlightRecorder(j, mode="observe", telemetry=t).start()
+        j.emit("watchdog_stall", lane=0, elapsed_s=2.0, timeout_s=1.0)
+        rec.stop()
+        j.close()
+        text = t.exposition()
+        assert 'specpride_incidents_total{detector="watchdog"} 1' in text

@@ -168,12 +168,15 @@ def test_renewal_resets_the_skewed_window(tmp_path):
     real_now = time.time
     try:
         observer.store._now = lambda: real_now() + 0.5
-        # the holder's heartbeat thread renews every 0.1s: repeated
-        # scans across > TTL+grace of wall time never find it expired
+        # drive the renewal synchronously via flush_progress (the same
+        # _beat the heartbeat thread runs): on a loaded 1-core host the
+        # background thread can be starved past TTL+grace, which would
+        # test the scheduler, not the renewal semantics
         deadline = time.perf_counter() + 1.5
         while time.perf_counter() < deadline:
+            holder.flush_progress()
             assert observer.claim_next() is None
-            time.sleep(0.1)
+            time.sleep(0.05)
         holder.check_lease(0)
     finally:
         holder.stop()
