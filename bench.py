@@ -2245,6 +2245,196 @@ def bench_flightrec_overhead(
     return out
 
 
+def bench_result_cache(
+    clusters, workdir: str, n_serving_clusters: int = 512,
+    repeats: int = 4, jobs_per_batch: int = 3,
+) -> dict:
+    """Content-addressed result cache (docs/performance.md, PR 18
+    acceptance): repeat-job throughput through a live daemon with
+    ``--result-cache`` vs one without, per method, with QC armed — a
+    warm cache hit skips BOTH the consensus compute and the QC cosine
+    pass, so the measured delta is the compute the cache deletes.
+
+    Both daemons boot up front against ONE shared compile cache and the
+    measured batches ALTERNATE between arms (the flightrec idiom: slow
+    host-load drift hits both equally).  Per method one unmeasured
+    warmup job per arm pays the compiles — on the cached arm it is also
+    the cold populate, so every measured cached job runs warm.  The
+    acceptance bars asserted here: warm jobs/sec >= 2x cache-off per
+    method, hit rate >= 0.9 across every cached-arm job (cold warmups
+    included), warm p99 job wall no worse than cache-off, and BYTE
+    PARITY for every output + QC report of every job in every cell."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+
+    from specpride_tpu.io.mgf import write_mgf
+    from specpride_tpu.serve import client as sc
+
+    sub = clusters[: min(n_serving_clusters, len(clusters))]
+    src = os.path.join(workdir, "rc_clustered.mgf")
+    write_mgf([s for c in sub for s in c.members], src)
+    cache = os.path.join(workdir, "rc_compile_cache")  # shared: both warm
+    arms = {
+        "off": [],
+        "cached": ["--result-cache", os.path.join(workdir, "rc_tier")],
+    }
+    procs: dict[str, tuple] = {}
+    batch_walls: dict = {}  # (method, tag) -> [batch wall, ...]
+    job_walls: dict = {}    # (method, tag) -> [job wall, ...]
+    cached_journal = os.path.join(workdir, "rc_cached.jsonl")
+    try:
+        for tag, extra in arms.items():
+            sock = os.path.join(workdir, f"rc_{tag}.sock")
+            argv = [
+                sys.executable, "-m", "specpride_tpu", "serve",
+                "--socket", sock, "--compile-cache", cache,
+                "--layout", "bucketized", "--force-device",
+                "--max-queue", "32",
+                "--journal", os.path.join(workdir, f"rc_{tag}.jsonl"),
+            ] + extra
+            procs[tag] = (
+                subprocess.Popen(
+                    argv, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                ),
+                sock,
+            )
+        for tag, (_, sock) in procs.items():
+            assert sc.wait_for_socket(sock, timeout=300), \
+                f"{tag} daemon never booted"
+
+        def one_job(tag, method, command, name):
+            out = os.path.join(workdir, f"rc_{tag}_{name}.mgf")
+            qc = os.path.join(workdir, f"rc_{tag}_{name}.qc.json")
+            t0 = time.perf_counter()
+            term = sc.submit_wait(
+                procs[tag][1],
+                [command, src, out, "--method", method,
+                 "--qc-report", qc],
+                timeout=600,
+            )
+            wall = time.perf_counter() - t0
+            assert term["status"] == "done", (tag, method, term)
+            return wall, out, qc
+
+        golden: dict = {}  # method -> (output bytes, qc bytes)
+        for method, command in _SWEEP_METHODS:
+            tagm = method.replace("-", "_")
+            for tag in procs:
+                # unmeasured: pays the compiles; cold-populates the tier
+                _, out, qc = one_job(tag, method, command,
+                                     f"{tagm}_warmup")
+                with open(out, "rb") as fh:
+                    body = fh.read()
+                with open(qc, "rb") as fh:
+                    qc_body = fh.read()
+                if method not in golden:
+                    golden[method] = (body, qc_body)
+                assert (body, qc_body) == golden[method], \
+                    f"{tag} warmup diverged for {method}"
+            for key in ((method, "off"), (method, "cached")):
+                batch_walls[key] = []
+                job_walls[key] = []
+            seq = 0
+            for _ in range(repeats):
+                for tag in procs:
+                    t0 = time.perf_counter()
+                    for _ in range(jobs_per_batch):
+                        w, out, qc = one_job(
+                            tag, method, command, f"{tagm}_{seq}"
+                        )
+                        seq += 1
+                        job_walls[(method, tag)].append(w)
+                        # byte parity EVERY cell: output + QC both arms
+                        with open(out, "rb") as fh:
+                            assert fh.read() == golden[method][0], \
+                                (tag, method, out)
+                        with open(qc, "rb") as fh:
+                            assert fh.read() == golden[method][1], \
+                                (tag, method, qc)
+                    batch_walls[(method, tag)].append(
+                        time.perf_counter() - t0
+                    )
+        for tag, (proc, _) in procs.items():
+            proc.send_signal(_signal.SIGTERM)
+            rc = proc.wait(timeout=300)
+            assert rc == 0, f"{tag} daemon SIGTERM drain exited {rc}"
+    finally:
+        for proc, _ in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # hit attribution from the cached daemon's own journal: every job
+    # after the per-method cold warmup must have served every cluster
+    # from the tier
+    with open(cached_journal) as fh:
+        events = [json.loads(line) for line in fh]
+    done = [e for e in events if e.get("event") == "job_done"]
+    hits = sum(e.get("result_cache_hits", 0) for e in done)
+    hit_rate = hits / (len(done) * len(sub))
+    assert hit_rate >= 0.9, \
+        f"hit rate {hit_rate:.3f} < 0.9 over {len(done)} cached-arm jobs"
+
+    def p99(ws):
+        s = sorted(ws)
+        return s[min(len(s) - 1, int(0.99 * (len(s) - 1)))]
+
+    rows = []
+    for method, _ in _SWEEP_METHODS:
+        off_best = min(batch_walls[(method, "off")])
+        cached_best = min(batch_walls[(method, "cached")])
+        row = {
+            "method": method,
+            "off_batch_walls_s": [
+                round(w, 3) for w in batch_walls[(method, "off")]
+            ],
+            "cached_batch_walls_s": [
+                round(w, 3) for w in batch_walls[(method, "cached")]
+            ],
+            "off_jobs_per_sec": round(jobs_per_batch / off_best, 3),
+            "cached_jobs_per_sec": round(
+                jobs_per_batch / cached_best, 3
+            ),
+            "warm_speedup": round(off_best / cached_best, 3),
+            "off_p99_job_wall_s": round(
+                p99(job_walls[(method, "off")]), 3
+            ),
+            "cached_p99_job_wall_s": round(
+                p99(job_walls[(method, "cached")]), 3
+            ),
+        }
+        assert row["warm_speedup"] >= 2.0, \
+            f"{method}: warm cache only {row['warm_speedup']}x"
+        assert row["cached_p99_job_wall_s"] <= \
+            row["off_p99_job_wall_s"], \
+            f"{method}: cached p99 regressed: {row}"
+        rows.append(row)
+        eprint(
+            f"[result_cache:{method}] off "
+            f"{row['off_jobs_per_sec']} jobs/s -> cached "
+            f"{row['cached_jobs_per_sec']} jobs/s = "
+            f"{row['warm_speedup']}x, p99 "
+            f"{row['off_p99_job_wall_s']}s -> "
+            f"{row['cached_p99_job_wall_s']}s"
+        )
+    eprint(
+        f"[result_cache] hit rate {hit_rate:.3f} over {len(done)} "
+        f"cached-arm jobs x {len(sub)} clusters; parity held every cell"
+    )
+    return {
+        "n_serving_clusters": len(sub),
+        "repeats": repeats,
+        "jobs_per_batch": jobs_per_batch,
+        "methods": rows,
+        "cached_arm_jobs": len(done),
+        "hit_rate": round(hit_rate, 4),
+        "parity": "output + QC byte-identical, every job, both arms",
+    }
+
+
 def bench_medoid_d2h(clusters) -> dict:
     """Medoid device path D2H bytes: index-only selection
     (``medoid_device_select``, the default) vs the count-matrix fetch it
@@ -2487,7 +2677,8 @@ def main() -> None:
         "(default all): methods,flat,sweep,medoid_d2h,end_to_end,"
         "prefetch_sweep,worker_sweep,fault_overhead,warm_start,serving,"
         "serving_concurrency,serving_batching,autotune,telemetry,"
-        "flightrec_overhead,elastic,elastic_steal,pallas,bandwidth",
+        "flightrec_overhead,result_cache,elastic,elastic_steal,pallas,"
+        "bandwidth",
     )
     ap.add_argument(
         "--sync-timing", action="store_true",
@@ -2513,7 +2704,8 @@ def main() -> None:
         "methods,flat,sweep,medoid_d2h,end_to_end,prefetch_sweep,"
         "worker_sweep,fault_overhead,warm_start,serving,"
         "serving_concurrency,serving_batching,autotune,telemetry,"
-        "flightrec_overhead,elastic,elastic_steal,pallas,bandwidth"
+        "flightrec_overhead,result_cache,elastic,elastic_steal,pallas,"
+        "bandwidth"
     )
     secs = set((args.sections or all_sections).split(","))
     unknown = secs - set(all_sections.split(","))
@@ -2677,6 +2869,10 @@ def main() -> None:
                 if "flightrec_overhead" in secs:
                     report["flightrec_overhead"] = \
                         bench_flightrec_overhead(clusters, workdir)
+                if "result_cache" in secs:
+                    report["result_cache"] = bench_result_cache(
+                        clusters, workdir
+                    )
                 if "elastic" in secs:
                     report["elastic"] = bench_elastic(clusters, workdir)
                 if "elastic_steal" in secs:

@@ -36,6 +36,23 @@ print(f"lint OK: {len(report['checks'])} checkers, "
 EOF
 rm -rf "$lint_tmp"
 
+echo "== hygiene: no committed or orphan __pycache__ =="
+# bytecode dirs must never land in the index, and a __pycache__ whose
+# parent package no longer holds any .py sources is debris from a
+# moved/deleted module — stale .pyc files there can shadow imports
+if git ls-files | grep -q "__pycache__"; then
+    git ls-files | grep "__pycache__"
+    echo "__pycache__ artifacts are committed; git rm them"
+    exit 1
+fi
+find specpride_tpu tests -type d -name __pycache__ | while read -r d; do
+    if ! ls "$(dirname "$d")"/*.py >/dev/null 2>&1; then
+        echo "orphan __pycache__: $d (parent has no .py sources)"
+        exit 1
+    fi
+done
+echo "hygiene OK"
+
 echo "== generic lint: ruff (pyflakes-equivalent) =="
 # config lives in pyproject.toml ([tool.ruff]); the container may not
 # ship ruff — skip with a notice rather than fail on the toolchain
@@ -514,6 +531,114 @@ print("specpride warmup OK: first-ever run after standalone warmup "
       "journals 0 fresh compiles")
 EOF
 rm -rf "$ws_tmp"
+
+echo "== result cache: off/cold/warm parity + shared tier + exposition =="
+# the content-addressed consensus result cache (docs/performance.md):
+# per method, a cache-off run is the byte bar; a cold run against a
+# fresh tier must recompute (misses == populated, hits == 0) and a warm
+# rerun must serve every cluster from the tier (hits > 0, misses == 0)
+# — output bytes AND QC report cmp-identical across all three.  Then
+# the shared tier: a rank populates the in-tree CAS server through one
+# local tier, and a "different host" (fresh local tier, same store URL)
+# serves everything as shared hits with the same bytes.
+rc_tmp=$(mktemp -d)
+RC_IN=tests/data/golden_clustered.mgf
+rc_run() { # $1 method; $2 command; $3 phase; rest = cache flags
+    M="$1"; CMD="$2"; PHASE="$3"; shift 3
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+        "$CMD" "$RC_IN" "$rc_tmp/${M}_${PHASE}.mgf" --method "$M" \
+        --qc-report "$rc_tmp/${M}_${PHASE}.qc.json" \
+        --journal "$rc_tmp/${M}_${PHASE}.jsonl" "$@"
+}
+for spec in "bin-mean:consensus" "gap-average:consensus" "medoid:select"; do
+    M=${spec%%:*}; CMD=${spec#*:}
+    rc_run "$M" "$CMD" off
+    rc_run "$M" "$CMD" cold --result-cache "$rc_tmp/tier:64"
+    rc_run "$M" "$CMD" warm --result-cache "$rc_tmp/tier:64"
+    cmp "$rc_tmp/${M}_off.mgf" "$rc_tmp/${M}_cold.mgf"
+    cmp "$rc_tmp/${M}_off.mgf" "$rc_tmp/${M}_warm.mgf"
+    cmp "$rc_tmp/${M}_off.qc.json" "$rc_tmp/${M}_cold.qc.json"
+    cmp "$rc_tmp/${M}_off.qc.json" "$rc_tmp/${M}_warm.qc.json"
+done
+python - "$rc_tmp" <<'EOF'
+import json, sys
+tmp = sys.argv[1]
+for m in ("bin-mean", "gap-average", "medoid"):
+    def rc_of(phase):
+        ev = [json.loads(l) for l in open(f"{tmp}/{m}_{phase}.jsonl")]
+        got = [e for e in ev if e["event"] == "result_cache"]
+        return got[-1] if got else None
+    assert rc_of("off") is None, \
+        f"{m}: cache-off journal must stay byte-identical by absence"
+    cold, warm = rc_of("cold"), rc_of("warm")
+    assert cold["hits"] == 0 and cold["misses"] > 0, (m, cold)
+    assert cold["populated"] == cold["misses"], (m, cold)
+    assert warm["misses"] == 0 and warm["hits"] == cold["misses"], \
+        (m, warm)
+print("result cache OK: 3 methods, cold populates, "
+      "warm serves every cluster, bytes + QC identical to cache-off")
+EOF
+# shared tier against the in-tree CAS server
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    cas-server --url-file "$rc_tmp/cas.url" & RC_CAS=$!
+for _ in $(seq 50); do test -s "$rc_tmp/cas.url" && break; sleep 0.1; done
+RC_URL=$(cat "$rc_tmp/cas.url")
+rc_run bin-mean consensus scold \
+    --result-cache "$rc_tmp/tierA" --result-store "$RC_URL"
+rc_run bin-mean consensus swarm \
+    --result-cache "$rc_tmp/tierB" --result-store "$RC_URL"
+kill $RC_CAS 2>/dev/null || true
+wait $RC_CAS 2>/dev/null || true
+cmp "$rc_tmp/bin-mean_off.mgf" "$rc_tmp/bin-mean_swarm.mgf"
+cmp "$rc_tmp/bin-mean_off.qc.json" "$rc_tmp/bin-mean_swarm.qc.json"
+python - "$rc_tmp" <<'EOF'
+import json, sys
+tmp = sys.argv[1]
+ev = [json.loads(l) for l in open(f"{tmp}/bin-mean_swarm.jsonl")]
+rc = [e for e in ev if e["event"] == "result_cache"][-1]
+assert rc["misses"] == 0 and rc["hits"] > 0, rc
+assert rc["shared_hits"] == rc["hits"], \
+    f"fresh local tier: every hit must cross the store, got {rc}"
+print(f"shared tier OK: {rc['hits']} hit(s), all via the CAS store")
+EOF
+# the specpride_result_cache_* families are pre-registered at 0 on a
+# fresh telemetry plane and the exposition stays strictly valid once
+# the process totals move
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+from specpride_tpu.cache import result_cache as rc_mod
+from specpride_tpu.observability.exporter import (
+    ServeTelemetry, validate_exposition,
+)
+rc_mod.reset()
+t = ServeTelemetry()
+text = t.exposition()
+assert not validate_exposition(text), validate_exposition(text)
+families = (
+    "specpride_result_cache_hits_total",
+    "specpride_result_cache_misses_total",
+    "specpride_result_cache_populated_total",
+    "specpride_result_cache_evictions_total",
+    "specpride_result_cache_bytes_saved_total",
+    "specpride_result_cache_shared_hits_total",
+    "specpride_result_cache_corrupt_total",
+)
+for name in families:
+    assert f"{name} 0" in text, f"{name} not pre-registered at 0"
+rc_mod._totals.add("hits", 3)
+rc_mod._totals.add("bytes_saved", 4096)
+text = t.exposition()
+assert not validate_exposition(text), validate_exposition(text)
+assert "specpride_result_cache_hits_total 3" in text
+assert "specpride_result_cache_bytes_saved_total 4096" in text
+rc_mod.reset()
+print("result-cache exposition OK: 7 families, strict, delta-mirrored")
+EOF
+# `specpride stats` renders the result-cache line (captured to a file:
+# `grep -q` would close the pipe before stats finishes rendering)
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m specpride_tpu \
+    stats "$rc_tmp/bin-mean_warm.jsonl" > "$rc_tmp/stats.txt"
+grep -q "result-cache:" "$rc_tmp/stats.txt"
+rm -rf "$rc_tmp"
 
 echo "== serve: warm-kernel daemon (boot, parity, warm requests, drain) =="
 # boot the daemon against a FRESH compile cache — with the live

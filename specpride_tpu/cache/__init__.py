@@ -1,0 +1,42 @@
+"""Content-addressed consensus result cache (two tiers: local LRU +
+optional shared Store).  See :mod:`specpride_tpu.cache.result_cache`."""
+
+from specpride_tpu.cache.digest import (
+    cluster_digest,
+    file_digest,
+    result_key,
+)
+from specpride_tpu.cache.result_cache import (
+    CACHEABLE_METHODS,
+    CODE_VERSION,
+    DEFAULT_MAX_MB,
+    LocalTier,
+    ResultCache,
+    RunContext,
+    SharedTier,
+    active,
+    configure,
+    make_entry,
+    reset,
+    runtime_for,
+    totals,
+)
+
+__all__ = [
+    "CACHEABLE_METHODS",
+    "CODE_VERSION",
+    "DEFAULT_MAX_MB",
+    "LocalTier",
+    "ResultCache",
+    "RunContext",
+    "SharedTier",
+    "active",
+    "cluster_digest",
+    "configure",
+    "file_digest",
+    "make_entry",
+    "reset",
+    "result_key",
+    "runtime_for",
+    "totals",
+]

@@ -284,6 +284,23 @@ def _add_execution(p: argparse.ArgumentParser) -> None:
         "dead rank's committed prefix instead of recomputing",
     )
     p.add_argument(
+        "--result-cache", metavar="DIR[:MB]",
+        help="content-addressed consensus result cache: per-cluster "
+        "results keyed by (cluster content digest, method, config "
+        "digest, precision, schema rev) in a bounded local LRU tier "
+        "(default cap 256 MB; DIR:MB overrides).  Hits replay the "
+        "stored representative + QC cosine — output bytes and the QC "
+        "report stay identical to an uncached run; corrupt entries are "
+        "quarantined and recomputed (see docs/performance.md)",
+    )
+    p.add_argument(
+        "--result-store", metavar="DIR|URL",
+        help="(with --result-cache) shared second tier: a directory or "
+        "http(s):// conditional-put object store (`specpride "
+        "cas-server`) every rank/host populates and consults, so a "
+        "fleet warms itself",
+    )
+    p.add_argument(
         "--autotune", choices=["off", "observe", "on"], default="off",
         help="(with --elastic) closed-loop controller re-sizing "
         "SPLIT-OFF ranges from the heartbeat EWMA chunk walls (ROADMAP "
@@ -659,7 +676,8 @@ class _ChunkItem:
     of the pipelined chunk executor (or yielded inline when serial)."""
 
     __slots__ = (
-        "index", "idxs", "part", "prepared", "pack_stats", "error", "wait_s"
+        "index", "idxs", "part", "prepared", "pack_stats", "error",
+        "wait_s", "cached",
     )
 
     def __init__(self, index: int, idxs: list[int]):
@@ -670,6 +688,7 @@ class _ChunkItem:
         self.pack_stats = None  # packer-thread RunStats to merge at handoff
         self.error = None  # exception raised while packing
         self.wait_s = 0.0  # consumer starvation waiting for this item
+        self.cached = None  # result-cache consult map (None = not consulted)
 
 
 def _serial_chunks(clusters, worklist):
@@ -684,7 +703,7 @@ def _serial_chunks(clusters, worklist):
 def _pack_chunk(
     clusters, chunk_index: int, idxs: list, prepare, method: str, config,
     cos_config, span_name: str, harness: Harness | None = None,
-    **span_labels,
+    rc=None, **span_labels,
 ):
     """THE per-chunk pack stage — the one copy the dedicated packer and
     every pool worker run, so the ``--pack-workers 0`` and ``>= 1`` paths
@@ -722,9 +741,19 @@ def _pack_chunk(
                 rb_faults.check("parse")
                 item.part = [clusters[i] for i in idxs]
             rb_faults.check("pack")
-            if prepare is not None:
+            if rc is not None and item.cached is None:
+                # result-cache consult rides the pack lane so digesting
+                # overlaps dispatch; retries keep the first verdict
+                item.cached = rc.consult(item.part)
+            to_pack = item.part
+            if item.cached:
+                hit = rc.hit_ids(item.cached)
+                to_pack = [
+                    c for c in item.part if c.cluster_id not in hit
+                ]
+            if prepare is not None and to_pack:
                 item.prepared = prepare(
-                    method, item.part, config,
+                    method, to_pack, config,
                     cos_config=cos_config, stats=pack_stats,
                 )
 
@@ -818,6 +847,7 @@ def _pipelined_chunks(
         _cosine_config(args) if want_qc and method == "bin-mean" else None
     )
     prepare = getattr(backend, "prepare_chunk", None)
+    rc = getattr(args, "_result_cache", None)
     busy = [0.0]
     lanes["pack_busy_s"] = busy
 
@@ -846,7 +876,7 @@ def _pipelined_chunks(
                     return
                 item, elapsed = _pack_chunk(
                     clusters, chunk_index, idxs, prepare, method, config,
-                    cos_config, "pipeline:pack", harness=harness,
+                    cos_config, "pipeline:pack", harness=harness, rc=rc,
                 )
                 busy[0] += elapsed
                 if not _put(item):
@@ -917,6 +947,7 @@ def _pooled_chunks(
         _cosine_config(args) if want_qc and method == "bin-mean" else None
     )
     prepare = getattr(backend, "prepare_chunk", None)
+    rc = getattr(args, "_result_cache", None)
     n_workers = max(1, min(n_workers, len(worklist)))
     depth = max(prefetch, n_workers)
     run_ctx = _capture_lane_context()  # see _pipelined_chunks
@@ -952,7 +983,7 @@ def _pooled_chunks(
                 item, elapsed = _pack_chunk(
                     clusters, chunk_index, idxs, prepare, method, config,
                     cos_config, f"pipeline:pack[{wid}]", harness=harness,
-                    worker=wid,
+                    rc=rc, worker=wid,
                 )
                 busy[wid] += elapsed
                 with cond:
@@ -1157,7 +1188,7 @@ class _CommitItem:
     dispatch lane so commits are byte-identical to serial runs."""
 
     __slots__ = ("index", "reps", "part_ids", "qc_rows", "failed",
-                 "chunk_t0", "max_idx")
+                 "chunk_t0", "max_idx", "populate")
 
     def __init__(self, index, reps, part_ids, qc_rows, failed, chunk_t0,
                  max_idx=None):
@@ -1170,6 +1201,9 @@ class _CommitItem:
         # highest LOCAL cluster index in this chunk — what the elastic
         # commit fence compares against a ratified split cut
         self.max_idx = max_idx
+        # result-cache entries to commit AFTER the append lands:
+        # (key, rep, cluster, cosine) per freshly computed cluster
+        self.populate = None
 
 
 def _commit_chunk(item: _CommitItem, args, journal, stats: RunStats,
@@ -1289,6 +1323,13 @@ def _commit_chunk(item: _CommitItem, args, journal, stats: RunStats,
             "checkpoint_write", n_done=len(done),
             output_bytes=output_bytes,
         )
+    rc = getattr(args, "_result_cache", None)
+    if rc is not None and item.populate:
+        # populate strictly AFTER the bytes landed (and the manifest,
+        # when checkpointing): a crash mid-chunk must never leave cache
+        # entries for output that was truncated away on resume.  The
+        # populate itself is best-effort — failures are contained.
+        rc.populate(item.populate)
 
 
 class _Committer:
@@ -1781,6 +1822,7 @@ def _checkpointed_run_impl(
     loop_t0 = _time.perf_counter()
 
     clip_fn = getattr(args, "_elastic_clip", None)
+    rc = getattr(args, "_result_cache", None)
     try:
         for item in items:
             if clip_fn is not None and item.idxs:
@@ -1825,6 +1867,19 @@ def _checkpointed_run_impl(
                 # lane), so the committer can own "QC finalize" without the
                 # dispatch lane ever racing it on the list
                 chunk_qc: list | None = [] if qc is not None else None
+                # result cache: the pack lane consulted already when
+                # pipelined; the serial path consults here.  miss_part
+                # is what actually computes — hits replay straight into
+                # the commit tail below.
+                if rc is not None and item.cached is None and \
+                        item.error is None and part is not None:
+                    item.cached = rc.consult(part)
+                cached = item.cached
+                hit_ids = rc.hit_ids(cached) if rc is not None else set()
+                miss_part = (
+                    [c for c in part if c.cluster_id not in hit_ids]
+                    if part is not None and hit_ids else part
+                )
                 try:
                     if item.error is not None:
                         # a pack-stage failure surfaces here so --on-error
@@ -1832,10 +1887,13 @@ def _checkpointed_run_impl(
                         # (transient pack errors were already retried on
                         # the pack lane; what arrives is permanent)
                         raise item.error
-                    reps = _dispatch_chunk(
-                        backend, method, item, part, args, stats, scores,
-                        chunk_qc, harness,
-                    )
+                    if miss_part:
+                        reps = _dispatch_chunk(
+                            backend, method, item, miss_part, args, stats,
+                            scores, chunk_qc, harness,
+                        )
+                    else:
+                        reps = []  # every cluster was a cache hit
                 except (ValueError, RuntimeError, OSError) as e:
                     # OSError joins the policy catch so a persistent I/O
                     # failure that exhausted its retries (incl.
@@ -1854,13 +1912,14 @@ def _checkpointed_run_impl(
                         # the packer died while materializing this chunk; the
                         # serial retry below needs the clusters themselves
                         part = [clusters[i] for i in item.idxs]
+                        miss_part = part
                     logger.warning(
                         "chunk of %d clusters failed (%s); retrying one by one",
-                        len(part), e,
+                        len(miss_part), e,
                     )
                     reps, bad_part = [], []
                     with stats.phase("compute"):
-                        for c in part:
+                        for c in miss_part:
                             try:
                                 reps.extend(
                                     _run_method(
@@ -1886,7 +1945,9 @@ def _checkpointed_run_impl(
                     # rows ride to the committer.
                     try:
                         by_id = {r.cluster_id: r for r in reps}
-                        kept = [c for c in part if c.cluster_id in by_id]
+                        kept = [
+                            c for c in miss_part if c.cluster_id in by_id
+                        ]
 
                         def _qc_pass(kept=kept, by_id=by_id):
                             with stats.phase("compute"), tracing.span(
@@ -1912,24 +1973,75 @@ def _checkpointed_run_impl(
                         logger.warning(
                             "QC cosines failed for a %d-cluster chunk (%s); "
                             "their rows are omitted from the report",
-                            len(part), e,
+                            len(miss_part), e,
                         )
                         # machine-readable trace for the report summary:
                         # consumers must be able to tell "row dropped by the
                         # method" from "QC itself failed" (advisor r4)
                         qc_failed.update(
-                            dict.fromkeys(c.cluster_id for c in part)
+                            dict.fromkeys(c.cluster_id for c in miss_part)
                         )
                         journal.emit(
                             "qc_failure",
-                            cluster_ids=[c.cluster_id for c in part],
+                            cluster_ids=[c.cluster_id for c in miss_part],
                             error=str(e),
                         )
+                populate = None
+                if rc is not None and part is not None:
+                    # cosines for the freshly computed clusters, so the
+                    # populated entries under a QC-on key always carry
+                    # the QC verdict a future hit will replay
+                    qc_by_id = (
+                        {row["cluster_id"]: row["avg_cosine"]
+                         for row in chunk_qc}
+                        if chunk_qc is not None else None
+                    )
+                    got = {r.cluster_id: r for r in reps}
+                    populate = []
+                    for c in miss_part:
+                        r = got.get(c.cluster_id)
+                        if r is None:
+                            continue  # dropped by the method / skipped
+                        cos = None
+                        if qc_by_id is not None:
+                            cos = qc_by_id.get(c.cluster_id)
+                            if cos is None:
+                                continue  # QC failed: no partial entry
+                        key = (cached or {}).get(c.cluster_id)
+                        populate.append((
+                            key[2] if key is not None else rc.key_of(c),
+                            r, c, cos,
+                        ))
+                    if hit_ids:
+                        # scatter the stored representatives (and their
+                        # QC rows) into the commit tail at their input
+                        # positions — the report writer re-sorts by
+                        # input order, so the bytes match cache-off
+                        reps = [
+                            cached[c.cluster_id][0]
+                            if c.cluster_id in hit_ids
+                            else got[c.cluster_id]
+                            for c in part
+                            if c.cluster_id in hit_ids
+                            or c.cluster_id in got
+                        ]
+                        if chunk_qc is not None:
+                            for c in part:
+                                if c.cluster_id not in hit_ids:
+                                    continue
+                                cos = cached[c.cluster_id][1]
+                                if cos is not None:
+                                    chunk_qc.append({
+                                        "cluster_id": c.cluster_id,
+                                        "n_members": c.n_members,
+                                        "avg_cosine": float(cos),
+                                    })
                 commit_item = _CommitItem(
                     chunk_index, reps, [c.cluster_id for c in part],
                     chunk_qc, sorted(failed) if failed else None, chunk_t0,
                     max_idx=item.idxs[-1] if item.idxs else None,
                 )
+                commit_item.populate = populate
                 if committer is not None:
                     # ordered write lane: the whole commit tail (QC finalize,
                     # MGF append, manifest replace, chunk_done heartbeat)
@@ -2056,12 +2168,17 @@ def _load_clusters_served(args, stats: RunStats, quarantine):
     if cacheable:
         from specpride_tpu.serve import ingest_cache
 
-        got = ingest_cache.get(args.input)
+        got, kind = ingest_cache.lookup(args.input)
         if got is not None:
             clusters, n_spectra, n_peaks = got
             stats.count("spectra_in", n_spectra)
             stats.count("peaks_in", n_peaks)
             stats.count("ingest_cache_hits", 1)
+            if kind == "content":
+                # same bytes under a new stat identity: still a skipped
+                # parse, but attributed separately so operators can see
+                # the fallback working
+                stats.count("ingest_cache_content_hits", 1)
             return clusters
     clusters = _load_clusters(
         args.input, stats, getattr(args, "stream_clusters", "off"),
@@ -2638,6 +2755,25 @@ def _finish_run(args, backend, stats: RunStats, journal) -> None:
         }
     else:
         shape_classes = None
+    rc = args.__dict__.pop("_result_cache", None)
+    if rc is not None:
+        # per-run cache accounting: its own additive event (cache-off
+        # journals stay byte-identical by absence) AND counters folded
+        # into run_end so job summaries / job_done attribution see hits
+        # without re-reading the journal
+        rc_snap = rc.snapshot()
+        journal.emit(
+            "result_cache",
+            hits=rc_snap["hits"], misses=rc_snap["misses"],
+            populated=rc_snap["populated"],
+            evictions=rc_snap["evictions"],
+            bytes_saved=rc_snap["bytes_saved"],
+            shared_hits=rc_snap["shared_hits"],
+            corrupt=rc_snap["corrupt"],
+            entries=rc_snap["entries"], bytes=rc_snap["bytes"],
+        )
+        stats.count("result_cache_hits", rc_snap["hits"])
+        stats.count("result_cache_misses", rc_snap["misses"])
     journal.emit(
         "run_end",
         counters=dict(stats.counters),
@@ -3083,6 +3219,15 @@ def _run_pipeline_command(args, command: str, backend=None) -> dict:
             clusters = [Cluster(args.output, spectra)] if spectra else []
         if backend is None:
             backend = _get_backend(args)
+        from specpride_tpu.cache import result_cache as _result_cache
+
+        # the content-addressed result cache: per-run context over the
+        # tiers named by --result-cache/--result-store, or the serving
+        # daemon's boot-owned singleton; None when the cache is off or
+        # this run is ineligible (non-cacheable method, batch member)
+        args._result_cache = _result_cache.runtime_for(
+            args, command, backend=backend
+        )
         scores = (
             _load_scores(args)
             if command == "select" and args.method == "best" else None
@@ -3290,6 +3435,13 @@ def cmd_serve(args) -> int:
             "requires --incident-dir (use 'observe' to journal "
             "firings without bundles)"
         )
+    if getattr(args, "result_store", None) and not \
+            getattr(args, "result_cache", None):
+        raise SystemExit(
+            "serve --result-store is the SHARED tier of the result "
+            "cache; it requires --result-cache DIR[:MB] for the local "
+            "tier"
+        )
     autotune_bw = None
     if getattr(args, "autotune_batch_window", None):
         from specpride_tpu.autotune.policy import parse_clamp
@@ -3328,6 +3480,8 @@ def cmd_serve(args) -> int:
         autotune_batch_window=autotune_bw,
         flightrec=flightrec,
         incident_dir=getattr(args, "incident_dir", None),
+        result_cache=getattr(args, "result_cache", None),
+        result_store=getattr(args, "result_store", None),
     ).run()
 
 
@@ -4307,6 +4461,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--metrics-out", metavar="FILE",
         help="flush a final Prometheus textfile snapshot of the serving "
         "metrics at SIGTERM drain (same exposition /metrics serves)",
+    )
+    psv.add_argument(
+        "--result-cache", metavar="DIR[:MB]",
+        help="content-addressed consensus result cache shared by every "
+        "worker lane (boot-owned — jobs cannot carry their own): "
+        "repeat submissions of already-computed clusters replay the "
+        "stored representative + QC cosine instead of recomputing, "
+        "with output bytes identical to an uncached run (see "
+        "consensus --help and docs/performance.md)",
+    )
+    psv.add_argument(
+        "--result-store", metavar="DIR|URL",
+        help="(with --result-cache) shared second cache tier: a "
+        "directory or http(s):// conditional-put object store "
+        "(`specpride cas-server`) the whole fleet populates and "
+        "consults",
     )
     psv.add_argument(
         "--slo", metavar="METHOD=SECONDS,...",

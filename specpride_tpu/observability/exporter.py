@@ -106,6 +106,7 @@ PRE_REGISTERED_FAMILIES = (
     "specpride_d2h_bytes_total",
     "specpride_autotune_*",
     "specpride_incidents_*",
+    "specpride_result_cache_*",
 )
 
 # the daemon-hosted autotune knobs: their current-value gauges and
@@ -333,6 +334,41 @@ class ServeTelemetry:
             "specpride_d2h_bytes_total",
             "bytes fetched device->host across all worker lanes",
         ).inc(0)
+        # content-addressed result cache: process-lifetime counters
+        # mirrored from cache.result_cache.totals() by delta at scrape
+        # time (sync_singletons); pre-registered at 0 so a daemon booted
+        # without --result-cache still exposes an auditable all-zero
+        # cache surface
+        r.counter(
+            "specpride_result_cache_hits_total",
+            "consensus clusters served from the result cache "
+            "(compute skipped)",
+        ).inc(0)
+        r.counter(
+            "specpride_result_cache_misses_total",
+            "consulted clusters the result cache could not serve",
+        ).inc(0)
+        r.counter(
+            "specpride_result_cache_populated_total",
+            "result-cache entries written after QC",
+        ).inc(0)
+        r.counter(
+            "specpride_result_cache_evictions_total",
+            "local-tier LRU evictions forced by the size cap",
+        ).inc(0)
+        r.counter(
+            "specpride_result_cache_bytes_saved_total",
+            "peak bytes result-cache hits did not recompute",
+        ).inc(0)
+        r.counter(
+            "specpride_result_cache_shared_hits_total",
+            "result-cache hits served by the shared store tier",
+        ).inc(0)
+        r.counter(
+            "specpride_result_cache_corrupt_total",
+            "result-cache entries quarantined on digest mismatch "
+            "(served as misses, never as results)",
+        ).inc(0)
 
     # -- event hooks (worker / reader threads) -------------------------
 
@@ -445,6 +481,7 @@ class ServeTelemetry:
         plan-cache traffic.  The singletons are already monotone, so the
         mirror incs by delta since the last scrape — never a set, which
         Counter (correctly) refuses."""
+        from specpride_tpu.cache import result_cache as rc_mod
         from specpride_tpu.data.packed import plan_cache_info
         from specpride_tpu.serve import ingest_cache
         from specpride_tpu.warmstart import cache as ws_cache
@@ -452,6 +489,7 @@ class ServeTelemetry:
         cc = ws_cache.counters_snapshot()
         pc = plan_cache_info()
         ic = ingest_cache.info()
+        rc = rc_mod.totals()
         totals = {
             "specpride_compile_cache_hits_total": (
                 cc["hits"], "persistent compile-cache hits"),
@@ -474,6 +512,27 @@ class ServeTelemetry:
             "specpride_serve_ingest_cache_misses_total": (
                 ic["misses"], "served eager parses that populated the "
                 "ingest cache"),
+            "specpride_result_cache_hits_total": (
+                rc["hits"], "consensus clusters served from the result "
+                "cache (compute skipped)"),
+            "specpride_result_cache_misses_total": (
+                rc["misses"], "consulted clusters the result cache "
+                "could not serve"),
+            "specpride_result_cache_populated_total": (
+                rc["populated"], "result-cache entries written after "
+                "QC"),
+            "specpride_result_cache_evictions_total": (
+                rc["evictions"], "local-tier LRU evictions forced by "
+                "the size cap"),
+            "specpride_result_cache_bytes_saved_total": (
+                rc["bytes_saved"], "peak bytes result-cache hits did "
+                "not recompute"),
+            "specpride_result_cache_shared_hits_total": (
+                rc["shared_hits"], "result-cache hits served by the "
+                "shared store tier"),
+            "specpride_result_cache_corrupt_total": (
+                rc["corrupt"], "result-cache entries quarantined on "
+                "digest mismatch (served as misses, never as results)"),
         }
         # device transfer totals: the per-lane backend registries each
         # count H2D/D2H bytes (specpride_bytes_*_total); mirror their

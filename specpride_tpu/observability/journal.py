@@ -43,18 +43,19 @@ import re
 import threading
 import time
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 # versions read_events accepts: v2 added the monotonic `mono` envelope
 # field and the `span` event; v4 added the trace-context envelope
 # (trace_id / span ids) and the `clock_anchor` event; v5 added the
 # `autotune` decision event and the heartbeat `chunk_s` mirror; v6
 # added the `incident` event (the flight recorder's detector-firing
-# record).  v3 is reserved — the live-telemetry-plane revision was
-# docs-only, with no envelope change, and the journal version skips it
-# to keep the wire and docs version numbers aligned; a v3 journal
-# reads exactly like v2.
-ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, 5, SCHEMA_VERSION})
+# record); v7 added the `result_cache` event (the content-addressed
+# consensus result cache's per-run accounting).  v3 is reserved — the
+# live-telemetry-plane revision was docs-only, with no envelope change,
+# and the journal version skips it to keep the wire and docs version
+# numbers aligned; a v3 journal reads exactly like v2.
+ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, 5, 6, SCHEMA_VERSION})
 
 # event type -> required payload fields (the envelope v/ts/mono/event is
 # implied; extra fields are allowed — the schema is additive within a
@@ -192,6 +193,19 @@ EVENT_FIELDS: dict[str, frozenset] = {
     # dedup decision bit-exact from the preceding stream alone.
     "incident": frozenset({"detector", "reason", "clock", "mode",
                            "bundled"}),
+    # content-addressed result cache (specpride_tpu.cache, v7): one
+    # per-run accounting record emitted just before run_end when a run
+    # consulted the cache.  `hits`/`misses` partition the consulted
+    # clusters; `populated` counts entries written post-QC;
+    # `evictions` the local-tier LRU evictions this run forced;
+    # `bytes_saved` the peak bytes the hits did not recompute.
+    # Optional fields: `shared_hits` (hits served by the shared Store
+    # tier), `corrupt` (entries quarantined on digest mismatch —
+    # served as misses, never as results), `entries`/`bytes` (local
+    # tier occupancy after the run).
+    "result_cache": frozenset(
+        {"hits", "misses", "populated", "evictions", "bytes_saved"}
+    ),
     # on-demand device profiling (`specpride profile` against a live
     # daemon): one bounded jax.profiler capture window
     "profile_start": frozenset({"seconds"}),

@@ -283,6 +283,29 @@ def _summarize_run(path: str, events: list[dict]) -> dict:
                 for e in at_events
             ],
         }
+    # content-addressed result cache (v7): the per-run accounting
+    # record emitted just before run_end — rendered as the
+    # `result-cache:` line (hit rate derived here so --json carries it)
+    rc_ev = next(
+        (e for e in reversed(events) if e["event"] == "result_cache"),
+        None,
+    )
+    if rc_ev is not None:
+        hits = int(rc_ev.get("hits") or 0)
+        misses = int(rc_ev.get("misses") or 0)
+        consulted = hits + misses
+        rc: dict = {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / consulted, 4) if consulted else 0.0,
+            "populated": int(rc_ev.get("populated") or 0),
+            "evictions": int(rc_ev.get("evictions") or 0),
+            "bytes_saved": int(rc_ev.get("bytes_saved") or 0),
+        }
+        for opt in ("shared_hits", "corrupt", "entries", "bytes"):
+            if rc_ev.get(opt) is not None:
+                rc[opt] = rc_ev[opt]
+        run["result_cache"] = rc
     # flight recorder (--flightrec): incident rollup + the full log
     # (rendered per-incident by `stats --incidents`, audited offline by
     # `specpride incident-replay`)
@@ -519,6 +542,29 @@ def _render_incidents(run: dict, out, detail: bool = False) -> None:
             )
 
 
+def _render_result_cache(run: dict, out) -> None:
+    """The result cache's at-a-glance line from the journal's v7
+    `result_cache` event: how much consensus work the run did NOT
+    redo, and what the local tier's LRU had to give up for it."""
+    rc = run.get("result_cache")
+    if not rc:
+        return
+    bits = [
+        f"hits={rc.get('hits', 0)}",
+        f"misses={rc.get('misses', 0)}",
+        f"hit_rate={rc.get('hit_rate', 0.0):.1%}",
+        f"evictions={rc.get('evictions', 0)}",
+        f"bytes_saved={rc.get('bytes_saved', 0)}",
+    ]
+    if rc.get("shared_hits"):
+        bits.append(f"shared_hits={rc['shared_hits']}")
+    if rc.get("corrupt"):
+        bits.append(f"corrupt={rc['corrupt']}")
+    if rc.get("entries") is not None:
+        bits.append(f"entries={rc['entries']}")
+    print(f"  result-cache: {' '.join(bits)}", file=out)
+
+
 def _render_slo(run: dict, out) -> None:
     """``stats --slo``: the per-method SLO table from a serving
     journal's job_done evaluations (objective vs measured queue-wait +
@@ -605,6 +651,7 @@ def _render_run(run: dict, out, slo: bool = False,
                 _render_slo(run, out)
         _render_autotune(run, out, detail=autotune)
         _render_incidents(run, out, detail=incidents)
+        _render_result_cache(run, out)
         return
     counters = run.get("counters", {})
     print(
@@ -654,6 +701,7 @@ def _render_run(run: dict, out, slo: bool = False,
             _render_slo(run, out)
     _render_autotune(run, out, detail=autotune)
     _render_incidents(run, out, detail=incidents)
+    _render_result_cache(run, out)
     ws = run.get("warmstart")
     if ws:
         bits = []

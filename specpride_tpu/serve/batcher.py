@@ -184,6 +184,7 @@ def compute_shared(backend, args0, entries) -> dict:
     Raises on any failure — the daemon then runs every member solo, so
     a poisoned batch degrades to exactly the unbatched behavior."""
     from specpride_tpu import cli
+    from specpride_tpu.cache import result_cache as rc_mod
 
     method = args0.method
     config = cli._method_config(method, args0)
@@ -198,12 +199,70 @@ def compute_shared(backend, args0, entries) -> dict:
         if key not in part_of:
             part_of[key] = len(parts)
             parts.append(clusters)
-    results = backend.run_shared(
-        method, parts, config, cos_config=cos_config
+    # result cache: every member's clusters are checked BEFORE joining
+    # the shared dispatch — only the misses ride run_shared, and the
+    # freshly computed results populate the tiers for the next batch.
+    # The consult happens once, on the leader's lane, against the REAL
+    # resident backend (member pipelines see the BatchResultBackend
+    # view and skip their own consult).
+    rc = rc_mod.runtime_for(
+        args0, getattr(entries[0][0], "command", "consensus"),
+        backend=backend,
+    ) if entries else None
+    consulted = [
+        rc.consult(p) if rc is not None else None for p in parts
+    ]
+    miss_parts: list = []
+    miss_of: list = []  # per part: its index into miss_parts, or None
+    for p, con in zip(parts, consulted):
+        if con is None:
+            miss = p
+        else:
+            hit = rc.hit_ids(con)
+            miss = [c for c in p if c.cluster_id not in hit]
+        if miss:
+            miss_of.append(len(miss_parts))
+            miss_parts.append(miss)
+        else:
+            miss_of.append(None)  # every cluster was a cache hit
+    results = (
+        backend.run_shared(
+            method, miss_parts, config, cos_config=cos_config
+        )
+        if miss_parts else []
     )
+    full: list = []
+    for p, con, mi in zip(parts, consulted, miss_of):
+        if con is None:
+            full.append(results[mi])
+            continue
+        reps_m, cos_m = results[mi] if mi is not None else ([], None)
+        if mi is not None:
+            rc.populate(
+                (con[c.cluster_id][2], reps_m[j], c,
+                 None if cos_m is None else float(cos_m[j]))
+                for j, c in enumerate(miss_parts[mi])
+            )
+        got = (
+            {c.cluster_id: j for j, c in enumerate(miss_parts[mi])}
+            if mi is not None else {}
+        )
+        reps, cos = [], []
+        for c in p:
+            hit = con.get(c.cluster_id)
+            if hit is not None and hit[0] is not None:
+                reps.append(hit[0])
+                cos.append(hit[1])
+            else:
+                j = got[c.cluster_id]
+                reps.append(reps_m[j])
+                cos.append(
+                    None if cos_m is None else float(cos_m[j])
+                )
+        full.append((reps, cos if cos_config is not None else None))
     out: dict = {}
     for job, clusters in entries:
-        reps, cosines = results[part_of[id(clusters)]]
+        reps, cosines = full[part_of[id(clusters)]]
         out[job.job_id] = SharedResults(
             reps_by_id={
                 c.cluster_id: r for c, r in zip(clusters, reps)
@@ -233,6 +292,11 @@ class BatchResultBackend:
     ``supports_prepare`` is False: with results precomputed there is
     nothing for the pack lane to run ahead of, and output stays
     byte-identical because it is chunk-invariant by contract."""
+
+    # class-level marker (found before __getattr__ forwards): the
+    # result cache skips member-pipeline consults behind this view —
+    # the leader consulted for the whole batch in compute_shared
+    is_batch_view = True
 
     def __init__(self, inner, shared: SharedResults):
         object.__setattr__(self, "_inner", inner)

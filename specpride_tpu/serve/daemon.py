@@ -182,8 +182,15 @@ class ServeDaemon:
         autotune_batch_window: tuple | None = None,
         flightrec: str = "off",
         incident_dir: str | None = None,
+        result_cache: str | None = None,
+        result_store: str | None = None,
     ):
         self.socket_path = socket_path or protocol.default_socket_path()
+        # content-addressed result cache (specpride_tpu.cache): boot
+        # configures the process-wide tiers once; every worker lane's
+        # jobs consult and populate them under per-run counters
+        self.result_cache = result_cache
+        self.result_store = result_store
         self.compile_cache = compile_cache
         self.routing_table = routing_table
         self.layout = layout
@@ -376,6 +383,17 @@ class ServeDaemon:
             reason=state.reason, source=state.source,
         )
         self.watchdog.journal = self.journal
+        if self.result_cache:
+            from specpride_tpu.cache import result_cache as rc_mod
+
+            cache = rc_mod.configure(self.result_cache, self.result_store)
+            logger.info(
+                "result cache: local %s (cap %d MB)%s",
+                cache.local.root,
+                cache.local.max_bytes // (1024 * 1024),
+                ", shared " + cache.shared.describe()
+                if cache.shared is not None else "",
+            )
         routing = RoutingTable.load(self.routing_table)
         # the worker pool: one resident backend per execution lane,
         # placed by serve.placement (distinct local devices on
@@ -1438,6 +1456,16 @@ class ServeDaemon:
             trace_id=job.trace_id,
             **batch_fields,
             **slo_fields,
+            # result-cache hit attribution: ride the terminal event so
+            # `stats` and operators see which jobs were served warm
+            # without opening the job's own journal
+            **(
+                {"result_cache_hits":
+                 summary["counters"]["result_cache_hits"]}
+                if isinstance(summary, dict)
+                and "result_cache_hits" in summary.get("counters", {})
+                else {}
+            ),
             **({"error": err} if err else {}),
         )
         job.ack.wait(timeout=10.0)  # admission line strictly first
@@ -1627,6 +1655,13 @@ class ServeDaemon:
             os.unlink(self.socket_path)
         except OSError:
             pass
+        if self.result_cache:
+            # release the boot-owned tiers: in-flight RunContexts hold
+            # their own reference, future in-process daemons (tests)
+            # configure their own
+            from specpride_tpu.cache import result_cache as rc_mod
+
+            rc_mod.configure(None)
         logger.info(
             "drained: %d done, %d failed, %d rejected",
             self.jobs_done, self.jobs_failed, self.jobs_rejected,
@@ -1677,7 +1712,17 @@ class ServeDaemon:
                 {"flightrec": self.recorder.status()}
                 if self.recorder is not None else {}
             ),
+            **self._result_cache_status(),
         }
+
+    @staticmethod
+    def _result_cache_status() -> dict:
+        from specpride_tpu.cache import result_cache as rc_mod
+
+        cache = rc_mod.active()
+        if cache is None:
+            return {}
+        return {"result_cache": {**cache.info(), **rc_mod.totals()}}
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
         """Block until no job is admitted, queued, batched or in

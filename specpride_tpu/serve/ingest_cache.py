@@ -23,10 +23,18 @@ Safety contract:
   (``--on-error skip``) must re-see malformed blocks — both bypass.
 * Keyed on size + mtime_ns: rewriting the input invalidates; same
   bytes re-written in place (same mtime resolution caveat as make).
+* Content-digest fallback: a stat-key miss against a non-empty cache
+  hashes the file's bytes (``cache.digest.file_digest`` — the same
+  helper the result cache keys clusters with) and matches them against
+  resident entries, so a copied/touched/re-uploaded identical input
+  still skips its parse.  The entry is re-keyed under the new stat
+  identity, making the next lookup a plain stat hit.
 
 Hit/miss counters ride each job's ``run_end.counters``
-(``ingest_cache_hits`` / ``ingest_cache_misses``) and the daemon's
-``/metrics`` exposition (``specpride_serve_ingest_cache_*_total``).
+(``ingest_cache_hits`` / ``ingest_cache_misses``, with content-digest
+matches attributed separately as ``ingest_cache_content_hits``) and
+the daemon's ``/metrics`` exposition
+(``specpride_serve_ingest_cache_*_total``).
 """
 
 from __future__ import annotations
@@ -42,7 +50,11 @@ DEFAULT_MAX_ENTRIES = 4
 
 _lock = threading.Lock()
 _cache: "OrderedDict[tuple, tuple]" = OrderedDict()
-_counts = {"hits": 0, "misses": 0}
+_counts = {"hits": 0, "misses": 0, "content_hits": 0}
+# content-digest fallback index: file sha256 -> the stat key holding
+# those bytes' parse (and the reverse map, so LRU eviction cleans both)
+_by_content: dict = {}
+_content_of: dict = {}
 
 
 def _max_entries() -> int:
@@ -64,22 +76,53 @@ def _key(path: str) -> tuple | None:
 def get(path: str) -> "tuple | None":
     """``(clusters, n_spectra, n_peaks)`` for an unchanged ``path``, or
     None (miss / disabled / unstattable)."""
+    return lookup(path)[0]
+
+
+def lookup(path: str) -> "tuple[tuple | None, str]":
+    """``(entry, kind)``: kind is ``"stat"`` (unchanged path), or
+    ``"content"`` (stat identity changed but the bytes matched a
+    resident entry — re-keyed so the next lookup is a stat hit), or
+    ``"miss"``."""
     if _max_entries() <= 0:
-        return None
+        return None, "miss"
     key = _key(path)
     if key is None:
-        return None
+        return None, "miss"
     with _lock:
         entry = _cache.get(key)
+        if entry is not None:
+            _counts["hits"] += 1
+            _cache.move_to_end(key)
+            return entry, "stat"
+        populated = bool(_by_content)
+    if not populated:
+        # the miss is counted at put() time: a lookup whose parse
+        # then FAILS never populates, and the exported miss total
+        # must match its help text ("parses that populated") and
+        # the per-job run_end counter
+        return None, "miss"
+    # stat-key miss with resident entries: one sequential read of the
+    # candidate file (far cheaper than its parse) decides whether it is
+    # the SAME BYTES under a new identity — a copy, a touch, a re-upload
+    from specpride_tpu.cache.digest import file_digest
+
+    digest = file_digest(path)
+    if digest is None:
+        return None, "miss"
+    with _lock:
+        old_key = _by_content.get(digest)
+        entry = _cache.get(old_key) if old_key is not None else None
         if entry is None:
-            # the miss is counted at put() time: a lookup whose parse
-            # then FAILS never populates, and the exported miss total
-            # must match its help text ("parses that populated") and
-            # the per-job run_end counter
-            return None
+            return None, "miss"
+        del _cache[old_key]
+        _content_of.pop(old_key, None)
+        _cache[key] = entry
+        _by_content[digest] = key
+        _content_of[key] = digest
         _counts["hits"] += 1
-        _cache.move_to_end(key)
-        return entry
+        _counts["content_hits"] += 1
+        return entry, "content"
 
 
 def put(path: str, clusters: list, n_spectra: int, n_peaks: int) -> None:
@@ -92,12 +135,21 @@ def put(path: str, clusters: list, n_spectra: int, n_peaks: int) -> None:
     key = _key(path)
     if key is None:
         return
+    from specpride_tpu.cache.digest import file_digest
+
+    digest = file_digest(path)  # outside the lock: one sequential read
     with _lock:
         _counts["misses"] += 1
         _cache[key] = (clusters, int(n_spectra), int(n_peaks))
         _cache.move_to_end(key)
+        if digest is not None:
+            _by_content[digest] = key
+            _content_of[key] = digest
         while len(_cache) > limit:
-            _cache.popitem(last=False)
+            old, _ = _cache.popitem(last=False)
+            d = _content_of.pop(old, None)
+            if d is not None and _by_content.get(d) == old:
+                del _by_content[d]
 
 
 def info() -> dict:
@@ -109,4 +161,6 @@ def info() -> dict:
 def clear() -> None:
     with _lock:
         _cache.clear()
-        _counts.update(hits=0, misses=0)
+        _by_content.clear()
+        _content_of.clear()
+        _counts.update(hits=0, misses=0, content_hits=0)

@@ -119,6 +119,11 @@ DAEMON_ONLY_FLAGS = (
     # the closed-loop controller is a process-wide plane (the daemon
     # boots its own via serve --autotune); a job cannot carry one
     "--autotune",
+    # the result cache's tiers are boot-owned process-wide state every
+    # worker lane shares — a job building its own tiers inside the
+    # daemon would fork the cache the fleet is warming
+    "--result-cache",
+    "--result-store",
 )
 
 # `specpride submit` exit code for a retriable non-success (BSD
@@ -180,7 +185,7 @@ _DAEMON_OWNED_DESTS = (
     "precision", "no_donate",
     "mesh", "coordinator", "num_processes", "process_id", "metrics_out",
     "elastic", "elastic_steal", "elastic_local", "metrics_port",
-    "trace_dir", "autotune",
+    "trace_dir", "autotune", "result_cache", "result_store",
 )
 
 _daemon_owned_defaults: dict | None = None

@@ -1,0 +1,713 @@
+"""Content-addressed consensus result cache: digest canonicalization,
+local-tier LRU/atomic-commit/quarantine semantics, the singleton
+lifecycle, and the machine-checked byte-parity matrix — 3 methods x
+cache {off, cold, warm} for one-shot runs, plus served, batched, and
+2-rank elastic shared-tier runs, plus a concurrency hammer."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from specpride_tpu.cache import digest as cd
+from specpride_tpu.cache import result_cache as rc
+from specpride_tpu.cli import build_parser, main as cli_main
+from specpride_tpu.data.peaks import Cluster, Spectrum
+from specpride_tpu.io.mgf import write_mgf
+from specpride_tpu.observability.journal import read_events
+from specpride_tpu.serve import client as sc
+from specpride_tpu.serve.daemon import ServeDaemon
+
+from conftest import make_cluster
+
+METHODS = [
+    ("bin-mean", "consensus"),
+    ("gap-average", "consensus"),
+    ("medoid", "select"),
+]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singleton():
+    rc.reset()
+    yield
+    rc.reset()
+
+
+def _respell(s: Spectrum, perm) -> Spectrum:
+    return Spectrum(
+        mz=np.asarray(s.mz)[perm],
+        intensity=np.asarray(s.intensity)[perm],
+        precursor_mz=s.precursor_mz,
+        precursor_charge=s.precursor_charge,
+        rt=s.rt,
+        title=s.title,
+        extra=dict(s.extra),
+    )
+
+
+# -- digest canonicalization ---------------------------------------------
+
+
+class TestDigest:
+    def test_peak_order_invariant(self, rng):
+        c = make_cluster(rng, "c-1", n_members=3, n_peaks=20)
+        base = cd.cluster_digest(c)
+        shuffled = Cluster(c.cluster_id, [
+            _respell(s, rng.permutation(len(s.mz))) for s in c.members
+        ])
+        assert cd.cluster_digest(shuffled) == base
+
+    def test_member_order_is_content(self, rng):
+        """Float reduction order shows in the output bits, so reordered
+        members are a DIFFERENT input, not the same one respelled."""
+        c = make_cluster(rng, "c-1", n_members=3, n_peaks=10)
+        flipped = Cluster(c.cluster_id, list(reversed(c.members)))
+        assert cd.cluster_digest(flipped) != cd.cluster_digest(c)
+
+    def test_titles_and_values_are_content(self, rng):
+        c = make_cluster(rng, "c-1", n_members=2, n_peaks=10)
+        base = cd.cluster_digest(c)
+        retitled = Cluster(c.cluster_id, [
+            Spectrum(
+                mz=c.members[0].mz, intensity=c.members[0].intensity,
+                precursor_mz=c.members[0].precursor_mz,
+                precursor_charge=c.members[0].precursor_charge,
+                rt=c.members[0].rt, title="other-title",
+            ),
+            c.members[1],
+        ])
+        assert cd.cluster_digest(retitled) != base
+        bumped = Cluster(c.cluster_id, [
+            _respell(c.members[0], np.arange(len(c.members[0].mz))),
+            Spectrum(
+                mz=c.members[1].mz,
+                intensity=np.asarray(c.members[1].intensity) * 2.0,
+                precursor_mz=c.members[1].precursor_mz,
+                precursor_charge=c.members[1].precursor_charge,
+                rt=c.members[1].rt, title=c.members[1].title,
+            ),
+        ])
+        assert cd.cluster_digest(bumped) != base
+
+    def test_result_key_splits_every_axis(self):
+        base = cd.result_key("c", "bin-mean", "cfg", "f32", "rc1")
+        assert cd.result_key("d", "bin-mean", "cfg", "f32", "rc1") != base
+        assert cd.result_key("c", "medoid", "cfg", "f32", "rc1") != base
+        assert cd.result_key("c", "bin-mean", "cfg2", "f32", "rc1") != base
+        assert cd.result_key("c", "bin-mean", "cfg", "bf16", "rc1") != base
+        assert cd.result_key("c", "bin-mean", "cfg", "f32", "rc2") != base
+
+    def test_file_digest_is_content_only(self, tmp_path):
+        a = tmp_path / "a.bin"
+        b = tmp_path / "sub" / "b.bin"
+        b.parent.mkdir()
+        a.write_bytes(b"same bytes")
+        b.write_bytes(b"same bytes")
+        assert cd.file_digest(str(a)) == cd.file_digest(str(b))
+        assert cd.file_digest(str(tmp_path / "missing")) is None
+
+
+# -- local tier ----------------------------------------------------------
+
+
+def _entry_for(rng, key, cid="c-e"):
+    c = make_cluster(rng, cid, n_members=2, n_peaks=10)
+    return c, rc.make_entry(key, c.members[0], c, 0.99)
+
+
+class TestLocalTier:
+    def test_roundtrip_and_decode(self, tmp_path, rng):
+        tier = rc.LocalTier(str(tmp_path))
+        key = "a" * 64
+        c, entry = _entry_for(rng, key)
+        tier.put(key, entry)
+        got = tier.get(key)
+        assert got is not None and got is not rc.CORRUPT
+        rep = rc.decode_rep(got["rep"])
+        np.testing.assert_array_equal(rep.mz, c.members[0].mz)
+        np.testing.assert_array_equal(rep.intensity,
+                                      c.members[0].intensity)
+        assert rep.title == c.members[0].title
+        assert tier.info()["entries"] == 1
+
+    def test_tmp_debris_never_parses_as_entry(self, tmp_path, rng):
+        """Atomic-commit crash sim: a killed writer leaves only private
+        tmp files, which neither serve nor count nor survive a cap
+        sweep as entries."""
+        tier = rc.LocalTier(str(tmp_path))
+        key = "b" * 64
+        _, entry = _entry_for(rng, key)
+        # a torn half-write the way mkstemp+replace would leave it
+        debris = tmp_path / ".tmp-dead1234.part"
+        debris.write_text(json.dumps(entry)[: 40])
+        assert tier.get(key) is None
+        assert tier.info()["entries"] == 0
+        tier.put(key, entry)
+        assert tier.get(key) is not rc.CORRUPT
+        assert tier.info()["entries"] == 1  # debris still not counted
+
+    def test_corrupt_entry_quarantined_as_miss(self, tmp_path, rng):
+        tier = rc.LocalTier(str(tmp_path))
+        key = "c" * 64
+        _, entry = _entry_for(rng, key)
+        tier.put(key, entry)
+        path = tmp_path / (key + ".json")
+        body = path.read_text()
+        path.write_text(body.replace('"cosine":0.99', '"cosine":0.5'))
+        assert tier.get(key) is rc.CORRUPT
+        assert not path.exists(), "failed entry must move aside"
+        assert (tmp_path / (key + ".json.corrupt")).exists(), \
+            "quarantine keeps the evidence"
+        assert tier.get(key) is None  # now a plain miss
+        # a ResultCache reports it as a corrupt-counted miss
+        cache = rc.ResultCache(rc.LocalTier(str(tmp_path)))
+        tier.put(key, entry)
+        (tmp_path / (key + ".json")).write_text("{not json")
+        doc, tiername = cache.lookup(key)
+        assert doc is None and tiername == "corrupt"
+
+    def test_wrong_key_entry_is_corrupt(self, tmp_path, rng):
+        """An entry filed under the wrong key (or a digest collision in
+        a copied tier) must never be served for that key."""
+        tier = rc.LocalTier(str(tmp_path))
+        key = "d" * 64
+        _, entry = _entry_for(rng, key)
+        tier.put(key, entry)
+        other = "e" * 64
+        os.replace(tmp_path / (key + ".json"),
+                   tmp_path / (other + ".json"))
+        assert tier.get(other) is rc.CORRUPT
+
+    def test_lru_bound_and_eviction_accounting(self, tmp_path, rng):
+        tier = rc.LocalTier(str(tmp_path))
+        keys = [ch * 64 for ch in "fghi"]
+        entries = {k: _entry_for(rng, k, cid=f"c-{k[0]}")[1]
+                   for k in keys}
+        tier.put(keys[0], entries[keys[0]])
+        size = os.path.getsize(tmp_path / (keys[0] + ".json"))
+        tier.max_bytes = int(size * 2.5)  # room for two entries
+        # pin recency explicitly: mtime IS the LRU axis
+        for i, k in enumerate(keys[1:], 1):
+            tier.put(k, entries[k])
+            os.utime(tmp_path / (k + ".json"), (i, i))
+        os.utime(tmp_path / (keys[0] + ".json"), (0, 0))
+        tier.put(keys[0], entries[keys[0]])  # re-put touches: newest
+        info = tier.info()
+        assert info["bytes"] <= tier.max_bytes
+        assert info["entries"] == 2
+        assert tier.evictions == 2 and tier.evicted_bytes > 0
+        assert tier.get(keys[1]) is None, "oldest mtime evicts first"
+        assert tier.get(keys[0]) not in (None, rc.CORRUPT)
+
+
+# -- singleton lifecycle + runtime gating --------------------------------
+
+
+class TestRuntime:
+    def test_parse_spec(self):
+        assert rc.parse_spec("/tmp/x") == ("/tmp/x", rc.DEFAULT_MAX_MB)
+        assert rc.parse_spec("/tmp/x:64") == ("/tmp/x", 64)
+
+    def test_configure_active_reset(self, tmp_path):
+        assert rc.active() is None
+        cache = rc.configure(str(tmp_path / "t"))
+        assert rc.active() is cache
+        rc.configure(None)
+        assert rc.active() is None
+
+    def test_runtime_for_gates(self, tmp_path):
+        tier = str(tmp_path / "t")
+
+        def _args(extra):
+            return build_parser().parse_args(
+                ["consensus", "in.mgf", "out.mgf"] + extra
+            )
+
+        cached = _args(["--method", "bin-mean", "--result-cache", tier])
+        assert rc.runtime_for(cached, "evaluate") is None
+        best = _args(["--method", "bin-mean", "--result-cache", tier])
+        best.method = "best"  # per-job score table: never cacheable
+        assert rc.runtime_for(best, "consensus") is None
+
+        class BatchView:
+            is_batch_view = True
+
+        assert rc.runtime_for(cached, "consensus",
+                              backend=BatchView()) is None
+        bare = _args(["--method", "bin-mean"])
+        assert rc.runtime_for(bare, "consensus") is None, \
+            "no flag, no singleton: cache off"
+        ctx = rc.runtime_for(cached, "consensus")
+        assert ctx is not None and ctx.method == "bin-mean"
+
+    def test_qc_config_splits_keys(self, tmp_path, rng):
+        """QC-on and QC-off runs key differently, so an entry cached
+        without a cosine can never satisfy a QC-on lookup."""
+        tier = str(tmp_path / "t")
+        base = ["consensus", "in.mgf", "out.mgf", "--method", "bin-mean",
+                "--result-cache", tier]
+        ctx_off = rc.runtime_for(
+            build_parser().parse_args(base), "consensus"
+        )
+        ctx_on = rc.runtime_for(
+            build_parser().parse_args(
+                base + ["--qc-report", str(tmp_path / "qc.json")]
+            ),
+            "consensus",
+        )
+        c = make_cluster(rng, "c-1", n_members=2, n_peaks=10)
+        assert ctx_off.key_of(c) != ctx_on.key_of(c)
+
+
+# -- shared tier ---------------------------------------------------------
+
+
+class TestSharedTier:
+    def test_fs_store_roundtrip_and_backfill(self, tmp_path, rng):
+        from specpride_tpu.parallel.store import FsStore
+
+        shared = rc.SharedTier(FsStore(str(tmp_path / "store")))
+        key = "a" * 64
+        c, entry = _entry_for(rng, key)
+        shared.put(key, entry)
+        assert shared.get(key)["cluster_id"] == c.cluster_id
+        # a fresh local tier backfills from shared on lookup
+        cache = rc.ResultCache(rc.LocalTier(str(tmp_path / "l")), shared)
+        doc, tier = cache.lookup(key)
+        assert tier == "shared" and doc is not None
+        doc2, tier2 = cache.lookup(key)
+        assert tier2 == "local", "shared hit must backfill local"
+
+    def test_shared_corrupt_is_miss(self, tmp_path, rng):
+        from specpride_tpu.parallel.store import FsStore
+
+        store = FsStore(str(tmp_path / "store"))
+        shared = rc.SharedTier(store)
+        key = "b" * 64
+        _, entry = _entry_for(rng, key)
+        entry = dict(entry, seal="0" * 64)  # bad seal
+        store.put_new("rc-" + key, entry)
+        assert shared.get(key) is rc.CORRUPT
+        cache = rc.ResultCache(rc.LocalTier(str(tmp_path / "l")), shared)
+        assert cache.lookup(key) == (None, "corrupt")
+
+
+# -- one-shot CLI parity matrix ------------------------------------------
+
+
+N_CLUSTERS = 6
+
+
+@pytest.fixture(scope="module")
+def workload(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("rc_wl")
+    rng = np.random.default_rng(424)
+    clusters = [
+        make_cluster(rng, f"cluster-{i}", n_members=3, n_peaks=20)
+        for i in range(N_CLUSTERS)
+    ]
+    src = tmp / "clustered.mgf"
+    write_mgf([s for c in clusters for s in c.members], src)
+    return str(src)
+
+
+def _rc_event(journal_path):
+    events, violations = read_events(journal_path)
+    assert not violations, violations
+    got = [e for e in events if e["event"] == "result_cache"]
+    return events, (got[-1] if got else None)
+
+
+class TestOneShotParity:
+    @pytest.mark.parametrize("method,command", METHODS)
+    def test_off_cold_warm_bytes_and_qc(
+        self, tmp_path, workload, method, command
+    ):
+        tier = tmp_path / "tier"
+        outs, qcs = {}, {}
+        for mode in ("off", "cold", "warm"):
+            out = tmp_path / f"{mode}.mgf"
+            qc = tmp_path / f"{mode}.qc.json"
+            jp = tmp_path / f"{mode}.jsonl"
+            argv = [
+                command, workload, str(out), "--method", method,
+                "--qc-report", str(qc), "--journal", str(jp),
+            ]
+            if mode != "off":
+                argv += ["--result-cache", str(tier)]
+            assert cli_main(argv) == 0
+            outs[mode], qcs[mode] = out.read_bytes(), qc.read_bytes()
+        assert outs["cold"] == outs["off"], method
+        assert outs["warm"] == outs["off"], method
+        assert qcs["cold"] == qcs["off"] and qcs["warm"] == qcs["off"]
+        # cache-off is parity by ABSENCE: no result_cache event at all
+        off_events, off_rc = _rc_event(str(tmp_path / "off.jsonl"))
+        assert off_rc is None
+        _, cold = _rc_event(str(tmp_path / "cold.jsonl"))
+        assert cold["misses"] == N_CLUSTERS and cold["hits"] == 0
+        assert cold["populated"] == N_CLUSTERS
+        events, warm = _rc_event(str(tmp_path / "warm.jsonl"))
+        assert warm["hits"] == N_CLUSTERS and warm["misses"] == 0
+        assert warm["bytes_saved"] > 0
+        end = [e for e in events if e["event"] == "run_end"][-1]
+        assert end["counters"]["result_cache_hits"] == N_CLUSTERS
+
+    def test_corrupt_tier_recomputes_identical(self, tmp_path, workload):
+        """Garbling every cached entry must turn the warm run into a
+        cold one — counted corrupt, recomputed, byte-identical."""
+        tier = tmp_path / "tier"
+        base = tmp_path / "base.mgf"
+        assert cli_main([
+            "consensus", workload, str(base), "--method", "bin-mean",
+            "--result-cache", str(tier),
+        ]) == 0
+        for name in os.listdir(tier):
+            if name.endswith(".json"):
+                path = tier / name
+                path.write_text(path.read_text()[:-20] + "garbage")
+        out = tmp_path / "after.mgf"
+        jp = tmp_path / "after.jsonl"
+        assert cli_main([
+            "consensus", workload, str(out), "--method", "bin-mean",
+            "--result-cache", str(tier), "--journal", str(jp),
+        ]) == 0
+        assert out.read_bytes() == base.read_bytes()
+        _, ev = _rc_event(str(jp))
+        assert ev["hits"] == 0 and ev["corrupt"] == N_CLUSTERS
+        quarantined = [n for n in os.listdir(tier)
+                       if n.endswith(".corrupt")]
+        assert len(quarantined) == N_CLUSTERS
+
+    def test_stats_renders_result_cache_line(
+        self, tmp_path, workload, capsys
+    ):
+        tier = tmp_path / "tier"
+        jp = tmp_path / "warm.jsonl"
+        for p in ("one.mgf", "two.mgf"):
+            assert cli_main([
+                "consensus", workload, str(tmp_path / p),
+                "--method", "bin-mean", "--result-cache", str(tier),
+                "--journal", str(jp),
+            ]) == 0
+        capsys.readouterr()
+        assert cli_main(["stats", str(jp)]) == 0
+        text = capsys.readouterr().out
+        assert "result-cache:" in text
+        assert f"hits={N_CLUSTERS}" in text and "hit_rate=100.0%" in text
+        agg = tmp_path / "agg.json"
+        assert cli_main(["stats", str(jp), "--json", str(agg)]) == 0
+        doc = json.loads(agg.read_text())
+        rc_doc = doc["runs"][-1]["result_cache"]
+        assert rc_doc["hits"] == N_CLUSTERS and rc_doc["hit_rate"] == 1.0
+
+
+# -- served + batched ----------------------------------------------------
+
+
+def _start(daemon):
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    assert sc.wait_for_socket(daemon.socket_path, timeout=120)
+    return t
+
+
+def _stop(daemon, thread):
+    daemon.drain()
+    thread.join(timeout=60)
+    assert not thread.is_alive()
+
+
+class TestServed:
+    def test_repeat_served_job_hits_and_matches_cli(
+        self, tmp_path, workload
+    ):
+        cli_out = tmp_path / "cli.mgf"
+        assert cli_main([
+            "consensus", workload, str(cli_out), "--method", "bin-mean",
+        ]) == 0
+        d = ServeDaemon(
+            str(tmp_path / "serve.sock"),
+            compile_cache=str(tmp_path / "cc"),
+            journal_path=str(tmp_path / "serve.jsonl"),
+            result_cache=str(tmp_path / "tier") + ":64",
+        )
+        t = _start(d)
+        try:
+            assert rc.active() is not None, "boot owns the singleton"
+            terms = []
+            for tag in ("first", "second"):
+                out = tmp_path / f"{tag}.mgf"
+                term = sc.submit_wait(d.socket_path, [
+                    "consensus", workload, str(out), "--method",
+                    "bin-mean", "--journal", str(tmp_path / f"{tag}.jsonl"),
+                ])
+                assert term["status"] == "done", term
+                assert out.read_bytes() == cli_out.read_bytes()
+                terms.append(term)
+            # hit attribution on the daemon's job_done events
+            events, violations = read_events(d.journal_path)
+            assert not violations, violations
+            done = [e for e in events if e["event"] == "job_done"]
+            assert done[0].get("result_cache_hits", 0) == 0
+            assert done[1].get("result_cache_hits") == N_CLUSTERS
+            # live status carries tier occupancy + process totals
+            status = d.status()
+            assert status["result_cache"]["entries"] == N_CLUSTERS
+            assert status["result_cache"]["hits"] >= N_CLUSTERS
+        finally:
+            _stop(d, t)
+        assert rc.active() is None, "drain clears the singleton"
+
+    def test_job_carrying_result_cache_flag_rejected(
+        self, tmp_path, workload
+    ):
+        d = ServeDaemon(
+            str(tmp_path / "serve.sock"),
+            compile_cache=str(tmp_path / "cc"),
+            journal_path=str(tmp_path / "serve.jsonl"),
+        )
+        t = _start(d)
+        try:
+            term = sc.submit_wait(d.socket_path, [
+                "consensus", workload, str(tmp_path / "o.mgf"),
+                "--method", "bin-mean",
+                "--result-cache", str(tmp_path / "job_tier"),
+            ])
+            assert term["status"] == "rejected", term
+            assert "--result-cache" in term["reason"]
+        finally:
+            _stop(d, t)
+
+    def test_batched_members_share_cache(self, tmp_path, workload):
+        """Two concurrent tenants coalesced into one shared dispatch:
+        outputs byte-identical to solo CLI, and a SECOND batched pair
+        is served from the cache (leader-side consult)."""
+        cli_out = tmp_path / "cli.mgf"
+        assert cli_main([
+            "consensus", workload, str(cli_out), "--method", "bin-mean",
+        ]) == 0
+        d = ServeDaemon(
+            str(tmp_path / "serve.sock"),
+            compile_cache=str(tmp_path / "cc"),
+            journal_path=str(tmp_path / "serve.jsonl"),
+            workers=1,
+            batch_window=0.25,
+            result_cache=str(tmp_path / "tier"),
+        )
+        d._gate.clear()  # admit both jobs before any executes
+        t = _start(d)
+        try:
+            for round_no in range(2):
+                terms = {}
+
+                def _submit(tag):
+                    out = tmp_path / f"r{round_no}_{tag}.mgf"
+                    terms[tag] = (sc.submit_wait(d.socket_path, [
+                        "consensus", workload, str(out), "--method",
+                        "bin-mean",
+                    ], client=f"tenant-{tag}"), out)
+
+                threads = [
+                    threading.Thread(target=_submit, args=(tag,))
+                    for tag in ("a", "b")
+                ]
+                for th in threads:
+                    th.start()
+                deadline = time.time() + 30
+                while len(d.queue) < 2 and time.time() < deadline:
+                    time.sleep(0.01)
+                d._gate.set()
+                for th in threads:
+                    th.join(timeout=120)
+                for tag, (term, out) in terms.items():
+                    assert term["status"] == "done", (tag, term)
+                    assert out.read_bytes() == cli_out.read_bytes()
+                d._gate.clear()
+            totals = rc.totals()
+            assert totals["hits"] >= N_CLUSTERS, totals
+            assert totals["populated"] >= N_CLUSTERS, totals
+            events, _ = read_events(d.journal_path)
+            assert any(e["event"] == "batch_dispatch" and
+                       e.get("status") == "shared" for e in events)
+        finally:
+            d._gate.set()
+            _stop(d, t)
+
+
+# -- elastic 2-rank shared tier ------------------------------------------
+
+
+def _elastic_env():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    return env
+
+def _elastic_rank_argv(src, out, coord, rank, tier, store, journal):
+    return [
+        sys.executable, "-m", "specpride_tpu",
+        "consensus", str(src), str(out), "--method", "bin-mean",
+        "--elastic", str(coord), "--process-id", str(rank),
+        "--elastic-range", "2", "--checkpoint-every", "1",
+        "--qc-report", f"{out}.qc.json", "--journal", str(journal),
+        "--result-cache", str(tier), "--result-store", str(store),
+    ]
+
+
+@pytest.mark.slow
+def test_elastic_two_ranks_share_store(tmp_path, workload):
+    """Cold 2-rank elastic run populates the shared tier; a warm rerun
+    with FRESH local tiers and a fresh coordinator serves every cluster
+    from the store — merged bytes + QC identical to serial both times."""
+    serial = tmp_path / "serial.mgf"
+    assert cli_main([
+        "consensus", workload, str(serial), "--method", "bin-mean",
+        "--qc-report", str(tmp_path / "serial.qc.json"),
+    ]) == 0
+    store = tmp_path / "store"
+    env = _elastic_env()
+
+    def _run_pair(phase):
+        out = tmp_path / f"{phase}.mgf"
+        coord = tmp_path / f"coord_{phase}"
+        journal = tmp_path / f"{phase}.jsonl"
+        procs = [
+            subprocess.Popen(
+                _elastic_rank_argv(
+                    workload, out, coord, rank,
+                    tmp_path / f"tier_{phase}_{rank}", store, journal,
+                ),
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+            )
+            for rank in (0, 1)
+        ]
+        for p in procs:
+            _, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()
+        assert cli_main([
+            "merge-parts", str(out), "--elastic", str(coord),
+            "--qc-report", f"{out}.qc.json",
+        ]) == 0
+        snaps = []
+        for rank in (0, 1):
+            events, violations = read_events(
+                f"{journal}.part{rank:05d}"
+            )
+            assert not violations, violations
+            snaps += [e for e in events if e["event"] == "result_cache"]
+        return out, snaps
+
+    cold_out, cold = _run_pair("cold")
+    assert cold_out.read_bytes() == serial.read_bytes()
+    assert (tmp_path / "cold.mgf.qc.json").read_bytes() == \
+        (tmp_path / "serial.qc.json").read_bytes()
+    assert sum(e["populated"] for e in cold) >= N_CLUSTERS
+    warm_out, warm = _run_pair("warm")
+    assert warm_out.read_bytes() == serial.read_bytes()
+    assert (tmp_path / "warm.mgf.qc.json").read_bytes() == \
+        (tmp_path / "serial.qc.json").read_bytes()
+    # fresh local tiers: every warm hit came over the shared store
+    assert sum(e["hits"] for e in warm) == N_CLUSTERS
+    assert sum(e.get("shared_hits", 0) for e in warm) == N_CLUSTERS
+
+
+# -- ingest-cache content fallback ---------------------------------------
+
+
+class TestIngestContentFallback:
+    def test_copied_input_content_hits(self, tmp_path):
+        from specpride_tpu.serve import ingest_cache as ic
+
+        ic.clear()
+        a = tmp_path / "a.mgf"
+        a.write_text("BEGIN IONS\nTITLE=x\nEND IONS\n")
+        ic.put(str(a), ["parsed"], n_spectra=1, n_peaks=2)
+        entry, kind = ic.lookup(str(a))
+        assert kind == "stat" and entry == (["parsed"], 1, 2)
+        # the same bytes under a new path: content fallback serves the
+        # resident parse and re-keys it
+        b = tmp_path / "copy.mgf"
+        b.write_bytes(a.read_bytes())
+        entry, kind = ic.lookup(str(b))
+        assert kind == "content" and entry == (["parsed"], 1, 2)
+        assert ic.info()["content_hits"] == 1
+        entry, kind = ic.lookup(str(b))
+        assert kind == "stat", "content hit re-keys to a stat hit"
+        # different bytes stay a miss
+        c = tmp_path / "other.mgf"
+        c.write_text("BEGIN IONS\nTITLE=y\nEND IONS\n")
+        assert ic.lookup(str(c)) == (None, "miss")
+        ic.clear()
+
+    def test_eviction_drops_content_index(self, tmp_path):
+        from specpride_tpu.serve import ingest_cache as ic
+
+        ic.clear()
+        paths = []
+        for i in range(6):  # cap is 4 entries
+            p = tmp_path / f"f{i}.mgf"
+            p.write_text(f"content-{i}")
+            ic.put(str(p), [i], n_spectra=1, n_peaks=1)
+            paths.append(p)
+        # f0/f1 evicted: a copy of f0's bytes must MISS, not resolve a
+        # dangling index entry
+        copy = tmp_path / "f0_copy.mgf"
+        copy.write_bytes(paths[0].read_bytes())
+        assert ic.lookup(str(copy)) == (None, "miss")
+        copy5 = tmp_path / "f5_copy.mgf"
+        copy5.write_bytes(paths[5].read_bytes())
+        assert ic.lookup(str(copy5))[1] == "content"
+        ic.clear()
+
+
+# -- concurrency hammer --------------------------------------------------
+
+
+def test_concurrency_hammer(tmp_path, rng):
+    """Many threads putting/getting against one capped tier: no
+    exceptions, every served entry verifies for its own key, and the
+    cap holds once the dust settles."""
+    tier = rc.LocalTier(str(tmp_path), max_mb=1)
+    keys, entries = [], {}
+    for i in range(12):
+        key = f"{i:02d}" + "0" * 62
+        keys.append(key)
+        entries[key] = _entry_for(rng, key, cid=f"c-{i}")[1]
+    tier.put(keys[0], entries[keys[0]])
+    size = os.path.getsize(tmp_path / (keys[0] + ".json"))
+    tier.max_bytes = size * 5  # constant eviction pressure
+    errors = []
+
+    def worker(seed):
+        r = np.random.default_rng(seed)
+        try:
+            for _ in range(150):
+                k = keys[int(r.integers(len(keys)))]
+                if r.random() < 0.5:
+                    tier.put(k, entries[k])
+                else:
+                    got = tier.get(k)
+                    if got is not None and got is not rc.CORRUPT:
+                        assert got["key"] == k
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert tier.info()["bytes"] <= tier.max_bytes
+    assert not [n for n in os.listdir(tmp_path)
+                if n.endswith(".corrupt")], \
+        "atomic commits must never yield a torn entry"
